@@ -1,0 +1,10 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+Capabilities mirror NVIDIA Dynamo (see SURVEY.md): OpenAI-compatible frontend,
+KV-cache-aware routing, disaggregated prefill/decode, multi-tier KV block
+management, SLA planner — but the compute engine is owned: a JAX/XLA serving
+engine (pjit-sharded models, Pallas paged attention, continuous batching) on
+TPU, with KV transfer over ICI/DCN collectives instead of NIXL/RDMA.
+"""
+
+__version__ = "0.1.0"

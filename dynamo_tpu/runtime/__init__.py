@@ -1,0 +1,24 @@
+"""Distributed runtime: the service framework every other layer builds on.
+
+TPU-native analog of the reference's `lib/runtime` (Rust, tokio): an asyncio
+event loop hosting components, a lease-based key-value store as the control
+plane (reference: etcd, `lib/runtime/src/transports/etcd.rs`), and a direct
+TCP streaming message plane (reference: NATS request + TCP response stream,
+`lib/runtime/src/pipeline/network/`).
+"""
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.store import KeyValueStore, MemoryStore, StoreEvent
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "DistributedRuntime",
+    "KeyValueStore",
+    "MemoryStore",
+    "RuntimeConfig",
+    "StoreEvent",
+]

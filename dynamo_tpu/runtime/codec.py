@@ -1,0 +1,43 @@
+"""Wire framing: length-prefixed msgpack messages over asyncio streams.
+
+Reference analog: the two-part codec in `lib/runtime/src/pipeline/network/codec.rs`.
+Frame = 4-byte big-endian length + msgpack body. A single codec is shared by
+the store protocol and the request/response message plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # hard cap against corrupt length prefixes
+
+_LEN = struct.Struct(">I")
+
+
+def pack(obj: Any) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    """Read one frame; raises ConnectionError on EOF/oversize."""
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError) as e:
+        raise ConnectionError("stream closed") from e
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError) as e:
+        raise ConnectionError("stream closed mid-frame") from e
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(pack(obj))

@@ -1,0 +1,71 @@
+"""Layered runtime configuration.
+
+Reference: `lib/runtime/src/config.rs` (figment: defaults < file < DYN_* env).
+Here: dataclass defaults < optional JSON/TOML file < ``DYN_*`` environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+ENV_PREFIX = "DYN_"
+
+
+@dataclass
+class RuntimeConfig:
+    """Knobs for a single process's runtime (reference `config.rs:75-167`)."""
+
+    # Control-plane store: "memory" (single-process / tests) or "tcp://host:port"
+    # pointing at a `StoreServer` coordinator.
+    store_url: str = "memory"
+    # Address this process binds its transport server to; port 0 = ephemeral.
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0
+    # Advertised host (what other nodes dial); defaults to listen_host.
+    advertise_host: Optional[str] = None
+    # Lease TTL for instance liveness, seconds (reference etcd lease).
+    lease_ttl: float = 10.0
+    # System status HTTP server (health/metrics); disabled when port is None.
+    system_port: Optional[int] = None
+    system_host: str = "0.0.0.0"
+    # Health-check manager.
+    health_check_enabled: bool = False
+    health_check_interval: float = 5.0
+    health_check_timeout: float = 3.0
+    # Graceful shutdown drain timeout.
+    shutdown_timeout: float = 30.0
+    # Arbitrary extra engine/component settings.
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_env(cls, path: Optional[str] = None) -> "RuntimeConfig":
+        """defaults < json file (path or DYN_CONFIG) < DYN_<FIELD> env vars."""
+        values: dict[str, Any] = {}
+        cfg_path = path or os.environ.get(ENV_PREFIX + "CONFIG")
+        if cfg_path and os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                values.update(json.load(f))
+        for f_ in dataclasses.fields(cls):
+            env_key = ENV_PREFIX + f_.name.upper()
+            if env_key in os.environ:
+                raw = os.environ[env_key]
+                values[f_.name] = _coerce(raw, f_.type)
+        known = {f_.name for f_ in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in values.items() if k in known})
+
+
+def _coerce(raw: str, type_hint: Any) -> Any:
+    hint = str(type_hint)
+    if "int" in hint:
+        return int(raw)
+    if "float" in hint:
+        return float(raw)
+    if "bool" in hint:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if "dict" in hint:
+        return json.loads(raw)
+    return raw

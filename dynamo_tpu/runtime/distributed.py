@@ -1,0 +1,107 @@
+"""DistributedRuntime: the per-process cluster handle.
+
+Reference: `lib/runtime/src/distributed.rs:43-191` — holds the etcd client
+(here: store), NATS client (here: transport server/client), component
+registry, metrics registries, and the system status server. Static mode
+(`distributed.rs:48-56`): store_url="memory" runs everything in-process with
+no coordinator, the analog of the reference's MemoryStore static mode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Optional
+
+from dynamo_tpu.runtime.component import Namespace
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.store import KeyValueStore, MemoryStore, connect_store
+from dynamo_tpu.runtime.transport import TransportClient, TransportServer
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedRuntime:
+    def __init__(self, config: RuntimeConfig, store: KeyValueStore,
+                 transport_server: TransportServer, lease_id: int) -> None:
+        self.config = config
+        self.store = store
+        self.transport_server = transport_server
+        self.transport_client = TransportClient()
+        self.lease_id = lease_id
+        self.metrics = MetricsRegistry("dynamo")
+        self._local_engines: dict[str, AsyncEngine] = {}
+        self._shutdown = asyncio.Event()
+        self._status_server = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    async def create(cls, config: Optional[RuntimeConfig] = None
+                     ) -> "DistributedRuntime":
+        config = config or RuntimeConfig.from_env()
+        store = await connect_store(config.store_url)
+        server = TransportServer(config.listen_host, config.listen_port)
+        await server.start()
+        if config.advertise_host:
+            server.host = config.advertise_host
+        lease_id = await store.create_lease(config.lease_ttl)
+        rt = cls(config, store, server, lease_id)
+        if config.system_port is not None:
+            from dynamo_tpu.runtime.status import SystemStatusServer
+
+            rt._status_server = SystemStatusServer(rt, config.system_host,
+                                                   config.system_port)
+            await rt._status_server.start()
+        logger.info("runtime up: transport=%s store=%s",
+                    server.address, config.store_url)
+        return rt
+
+    # -- component model ---------------------------------------------------
+
+    def namespace(self, name: str) -> Namespace:
+        return Namespace(self, name)
+
+    @property
+    def transport_address(self) -> str:
+        return self.transport_server.address
+
+    # -- local engine registry (in-proc fast path) -------------------------
+
+    def register_local(self, subject: str, engine: AsyncEngine) -> None:
+        self._local_engines[subject] = engine
+
+    def unregister_local(self, subject: str) -> None:
+        self._local_engines.pop(subject, None)
+
+    def local_engine(self, subject: str) -> Optional[AsyncEngine]:
+        return self._local_engines.get(subject)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    async def wait_shutdown(self) -> None:
+        await self._shutdown.wait()
+
+    async def close(self) -> None:
+        self.shutdown()
+        if self._status_server is not None:
+            await self._status_server.stop()
+        try:
+            await self.store.revoke_lease(self.lease_id)
+        except Exception:
+            pass
+        await self.transport_client.close()
+        await self.transport_server.stop()
+        await self.store.close()
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

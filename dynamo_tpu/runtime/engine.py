@@ -1,0 +1,68 @@
+"""AsyncEngine: the single hop abstraction the whole framework is built on.
+
+Reference invariant (`lib/runtime/src/pipeline.rs:54-56`): every hop —
+local operator or network edge — is `AsyncEngine<SingleIn<T>, ManyOut<U>>`:
+one request in, a stream of responses out. Here that is an object with
+
+    async def generate(request, context) -> AsyncIterator[response]
+
+Because local stages and network hops share the trait, a pipeline can be cut
+at any edge and the halves run in different processes (SegmentSource/Sink in
+the reference; `push.py` here).
+
+`Operator` is a pipeline stage that transforms the request on the way down
+and the response stream on the way up (reference `pipeline/nodes.rs:339`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Awaitable, Callable, Optional, Protocol, runtime_checkable
+
+from dynamo_tpu.runtime.context import Context
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        ...
+
+
+class FnEngine:
+    """Adapt a plain async-generator function into an AsyncEngine."""
+
+    def __init__(self, fn: Callable[[Any, Context], AsyncIterator[Any]]):
+        self._fn = fn
+
+    def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        return self._fn(request, context)
+
+
+class Operator:
+    """A bidirectional pipeline stage. Subclasses override `forward` (request
+    transform + downstream call) — the default is pass-through."""
+
+    def __init__(self, inner: Optional[AsyncEngine] = None) -> None:
+        self.inner = inner
+
+    def link(self, inner: AsyncEngine) -> "Operator":
+        self.inner = inner
+        return self
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        assert self.inner is not None, f"{type(self).__name__} not linked"
+        async for item in self.forward(request, context):
+            yield item
+
+    async def forward(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        assert self.inner is not None
+        async for item in self.inner.generate(request, context):
+            yield item
+
+
+def build_pipeline(*stages: Operator, sink: AsyncEngine) -> AsyncEngine:
+    """Chain operators front-to-back onto a sink engine; returns the front."""
+    engine: AsyncEngine = sink
+    for stage in reversed(stages):
+        stage.link(engine)
+        engine = stage
+    return engine

@@ -1,0 +1,90 @@
+"""PushRouter: select an instance of an endpoint and stream the request to it.
+
+Reference: `lib/runtime/src/pipeline/network/egress/push_router.rs` — modes
+RoundRobin/Random/Direct/KV (`push_router.rs:76-86,137-196`) with
+busy-threshold gating via a load monitor (`push_router.rs:31-38`). The KV
+mode lives in `dynamo_tpu.router` (it needs the radix index); this module
+provides the address-and-push machinery everything shares.
+
+In-process fast path: if the chosen instance is served by this process, the
+handler is invoked directly — no socket, no serialisation (the reference
+gets the same effect from pipeline segments living in one process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.runtime.component import EndpointClient, Instance
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+ROUND_ROBIN = "round_robin"
+RANDOM = "random"
+DIRECT = "direct"
+
+
+class NoInstancesError(ConnectionError):
+    pass
+
+
+class PushRouter:
+    """AsyncEngine over a set of instances of one endpoint."""
+
+    def __init__(self, client: EndpointClient, mode: str = ROUND_ROBIN,
+                 busy_filter: Optional[Callable[[Instance], bool]] = None) -> None:
+        self.client = client
+        self.mode = mode
+        self._rr = 0
+        # busy_filter returns True if the instance should be skipped
+        # (reference WorkerLoadMonitor busy-threshold gating).
+        self.busy_filter = busy_filter
+
+    @property
+    def _runtime(self):
+        return self.client.endpoint.runtime
+
+    def _candidates(self) -> list[Instance]:
+        instances = self.client.instances()
+        if self.busy_filter is not None:
+            free = [i for i in instances if not self.busy_filter(i)]
+            if free:
+                return free
+        return instances
+
+    def select(self, instance_id: Optional[int] = None) -> Instance:
+        instances = self._candidates()
+        if instance_id is not None:
+            for inst in self.client.instances():
+                if inst.instance_id == instance_id:
+                    return inst
+            raise NoInstancesError(f"instance {instance_id:x} not found")
+        if not instances:
+            raise NoInstancesError(
+                f"no instances for {self.client.endpoint.instance_prefix}")
+        if self.mode == RANDOM:
+            return random.choice(instances)
+        self._rr = (self._rr + 1) % len(instances)
+        return instances[self._rr]
+
+    async def generate(self, request: Any, context: Optional[Context] = None
+                       ) -> AsyncIterator[Any]:
+        async for item in self.direct(request, None, context):
+            yield item
+
+    async def direct(self, request: Any, instance_id: Optional[int],
+                     context: Optional[Context] = None) -> AsyncIterator[Any]:
+        ctx = context or Context()
+        inst = self.select(instance_id)
+        rt = self._runtime
+        local = rt.local_engine(inst.subject)
+        if local is not None:
+            async for item in local.generate(request, ctx):
+                ctx.raise_if_cancelled()
+                yield item
+            return
+        async for item in rt.transport_client.request(
+                inst.address, inst.subject, request, ctx):
+            yield item

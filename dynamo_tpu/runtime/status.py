@@ -1,0 +1,54 @@
+"""System status HTTP server: /live /health /metrics.
+
+Reference: `lib/runtime/src/system_status_server.rs` (axum server on
+DYN_SYSTEM_PORT aggregating health + hierarchical metric registries).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+class SystemStatusServer:
+    def __init__(self, runtime: "DistributedRuntime", host: str, port: int) -> None:
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self.health_checks: dict[str, bool] = {}
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]  # type: ignore
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _health(self, request: web.Request) -> web.Response:
+        unhealthy = [k for k, ok in self.health_checks.items() if not ok]
+        status = "unhealthy" if unhealthy else "healthy"
+        return web.json_response(
+            {"status": status, "failing": unhealthy},
+            status=503 if unhealthy else 200,
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.runtime.metrics.render(),
+                            content_type="text/plain")

@@ -1,0 +1,335 @@
+"""TCP-served control-plane store: the etcd-equivalent coordinator.
+
+One process (usually the frontend or a dedicated coordinator) runs
+`StoreServer` around a `MemoryStore`; every other process connects with
+`StoreClient`, which implements the same `KeyValueStore` API over the wire,
+including prefix watches (server-push) and lease keepalive.
+
+Reference analog: etcd itself plus `lib/runtime/src/transports/etcd.rs`.
+A single coordinator (no raft) is an accepted availability trade-off for
+this framework's control plane; the data plane never touches it.
+
+Protocol: length-prefixed msgpack frames (codec.py). Requests carry an `id`;
+responses echo it. Watch events are server-initiated frames with the watch id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Optional
+
+from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.store import (
+    DELETE,
+    PUT,
+    KeyValue,
+    KeyValueStore,
+    MemoryStore,
+    StoreEvent,
+    Watch,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StoreServer:
+    """Serves a MemoryStore over TCP. Lease lifetime is tied to server-side
+    TTL timers refreshed by client keepalives — a client that dies stops
+    refreshing, its leases expire, its keys vanish, watchers see DELETEs."""
+
+    def __init__(self, store: Optional[MemoryStore] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.store = store or MemoryStore()
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_writers: set[asyncio.StreamWriter] = set()
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._conn_writers):
+            w.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+        await self.store.close()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        watches: dict[int, tuple[Watch, asyncio.Task]] = {}
+        conn_leases: set[int] = set()
+        write_lock = asyncio.Lock()
+        self._conn_writers.add(writer)
+
+        async def send(obj: dict) -> None:
+            async with write_lock:
+                codec.write_frame(writer, obj)
+                await writer.drain()
+
+        async def pump_watch(watch_id: int, watch: Watch) -> None:
+            async for ev in watch:
+                await send({
+                    "watch": watch_id, "kind": ev.kind, "key": ev.key,
+                    "value": ev.value, "rev": ev.revision,
+                })
+
+        try:
+            while True:
+                try:
+                    msg = await codec.read_frame(reader)
+                except ConnectionError:
+                    break
+                try:
+                    reply = await self._dispatch(msg, watches, conn_leases, pump_watch)
+                except Exception as e:  # per-request fault isolation
+                    reply = {"id": msg.get("id"), "error": repr(e)}
+                if reply is not None:
+                    await send(reply)
+        finally:
+            self._conn_writers.discard(writer)
+            for watch, task in watches.values():
+                watch.cancel()
+                task.cancel()
+            # Connection death revokes this connection's leases immediately —
+            # faster failure detection than waiting out the TTL.
+            for lease_id in conn_leases:
+                await self.store.revoke_lease(lease_id)
+            writer.close()
+
+    async def _dispatch(self, msg, watches, conn_leases, pump_watch):
+        op = msg["op"]
+        mid = msg.get("id")
+        s = self.store
+        if op == "put":
+            rev = await s.put(msg["key"], msg["value"], msg.get("lease", 0))
+            return {"id": mid, "rev": rev}
+        if op == "create":
+            ok = await s.create(msg["key"], msg["value"], msg.get("lease", 0))
+            return {"id": mid, "ok": ok}
+        if op == "get":
+            kv = await s.get(msg["key"])
+            return {"id": mid, "kv": _kv_to_wire(kv)}
+        if op == "get_prefix":
+            kvs = await s.get_prefix(msg["prefix"])
+            return {"id": mid, "kvs": [_kv_to_wire(kv) for kv in kvs]}
+        if op == "delete":
+            ok = await s.delete(msg["key"])
+            return {"id": mid, "ok": ok}
+        if op == "delete_prefix":
+            n = await s.delete_prefix(msg["prefix"])
+            return {"id": mid, "n": n}
+        if op == "lease_create":
+            lease_id = await s.create_lease(msg["ttl"])
+            conn_leases.add(lease_id)
+            return {"id": mid, "lease": lease_id}
+        if op == "lease_keepalive":
+            ok = await s.keep_alive(msg["lease"])
+            return {"id": mid, "ok": ok}
+        if op == "lease_revoke":
+            await s.revoke_lease(msg["lease"])
+            conn_leases.discard(msg["lease"])
+            return {"id": mid, "ok": True}
+        if op == "watch":
+            watch = s.watch_prefix(msg["prefix"], replay=msg.get("replay", True))
+            task = asyncio.get_running_loop().create_task(
+                pump_watch(msg["wid"], watch)
+            )
+            watches[msg["wid"]] = (watch, task)
+            return {"id": mid, "ok": True}
+        if op == "watch_cancel":
+            entry = watches.pop(msg["wid"], None)
+            if entry:
+                entry[0].cancel()
+                entry[1].cancel()
+            return {"id": mid, "ok": True}
+        return {"id": mid, "error": f"unknown op {op!r}"}
+
+
+def _kv_to_wire(kv: Optional[KeyValue]):
+    if kv is None:
+        return None
+    return {"key": kv.key, "value": kv.value, "rev": kv.revision,
+            "lease": kv.lease_id}
+
+
+def _kv_from_wire(w) -> Optional[KeyValue]:
+    if w is None:
+        return None
+    return KeyValue(w["key"], w["value"], w["rev"], w.get("lease", 0))
+
+
+class StoreClient(KeyValueStore):
+    """KeyValueStore over a StoreServer connection, with auto lease keepalive."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, Watch] = {}
+        self._ids = itertools.count(1)
+        self._wids = itertools.count(1)
+        self._rx_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._leases: dict[int, float] = {}  # lease_id -> ttl
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await codec.read_frame(self._reader)
+                if "watch" in msg and "op" not in msg:
+                    watch = self._watches.get(msg["watch"])
+                    if watch is not None and not watch._cancelled:
+                        watch.queue.put_nowait(StoreEvent(
+                            msg["kind"], msg["key"], msg.get("value", b""),
+                            msg.get("rev", 0),
+                        ))
+                    continue
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut is not None and not fut.done():
+                    if "error" in msg:
+                        fut.set_exception(RuntimeError(msg["error"]))
+                    else:
+                        fut.set_result(msg)
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # ConnectionError or a corrupt/undecodable frame
+            logger.exception("store client rx loop died")
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("store connection lost"))
+            self._pending.clear()
+            for watch in list(self._watches.values()):
+                watch.cancel()
+            self._watches.clear()
+
+    async def _call(self, msg: dict) -> dict:
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        mid = next(self._ids)
+        msg["id"] = mid
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        async with self._write_lock:
+            codec.write_frame(self._writer, msg)
+            await self._writer.drain()
+        return await fut
+
+    # -- KeyValueStore -----------------------------------------------------
+
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        r = await self._call({"op": "put", "key": key, "value": value,
+                              "lease": lease_id})
+        return r["rev"]
+
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        r = await self._call({"op": "create", "key": key, "value": value,
+                              "lease": lease_id})
+        return r["ok"]
+
+    async def get(self, key: str) -> Optional[KeyValue]:
+        r = await self._call({"op": "get", "key": key})
+        return _kv_from_wire(r["kv"])
+
+    async def get_prefix(self, prefix: str) -> list[KeyValue]:
+        r = await self._call({"op": "get_prefix", "prefix": prefix})
+        return [_kv_from_wire(w) for w in r["kvs"]]
+
+    async def delete(self, key: str) -> bool:
+        return (await self._call({"op": "delete", "key": key}))["ok"]
+
+    async def delete_prefix(self, prefix: str) -> int:
+        return (await self._call({"op": "delete_prefix", "prefix": prefix}))["n"]
+
+    async def create_lease(self, ttl: float) -> int:
+        r = await self._call({"op": "lease_create", "ttl": ttl})
+        lease_id = r["lease"]
+        self._leases[lease_id] = ttl
+        if self._keepalive_task is None:
+            self._keepalive_task = asyncio.get_running_loop().create_task(
+                self._keepalive_loop()
+            )
+        return lease_id
+
+    async def _keepalive_loop(self) -> None:
+        while not self._closed:
+            interval = min(self._leases.values(), default=5.0) / 3.0
+            await asyncio.sleep(max(interval, 0.5))
+            for lease_id in list(self._leases):
+                try:
+                    ok = await self.keep_alive(lease_id)
+                except ConnectionError:
+                    return
+                if not ok:
+                    self._leases.pop(lease_id, None)
+
+    async def keep_alive(self, lease_id: int) -> bool:
+        return (await self._call({"op": "lease_keepalive", "lease": lease_id}))["ok"]
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        self._leases.pop(lease_id, None)
+        await self._call({"op": "lease_revoke", "lease": lease_id})
+
+    def watch_prefix(self, prefix: str, replay: bool = True) -> Watch:
+        watch = Watch()
+        wid = next(self._wids)
+        self._watches[wid] = watch
+        orig_cancel = watch.cancel
+
+        def cancel() -> None:
+            orig_cancel()
+            self._watches.pop(wid, None)
+            if not self._closed:
+                asyncio.get_running_loop().create_task(
+                    self._call({"op": "watch_cancel", "wid": wid})
+                )
+
+        watch.cancel = cancel  # type: ignore[method-assign]
+
+        async def register() -> None:
+            try:
+                await self._call({"op": "watch", "prefix": prefix, "wid": wid,
+                                  "replay": replay})
+            except Exception:
+                # Fail loudly: end the watch stream instead of hanging its
+                # consumer on a subscription the server never saw.
+                logger.exception("watch registration failed prefix=%s", prefix)
+                orig_cancel()
+                self._watches.pop(wid, None)
+
+        asyncio.get_running_loop().create_task(register())
+        return watch
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._keepalive_task is not None:
+            self._keepalive_task.cancel()
+        if self._rx_task is not None:
+            self._rx_task.cancel()
+        if self._writer is not None:
+            self._writer.close()

@@ -1,0 +1,268 @@
+"""Message plane: multiplexed request → response-stream over TCP.
+
+Reference analog: NATS service request + TCP response stream with prologue /
+sentinel framing (`lib/runtime/src/pipeline/network/{egress,ingress}/`,
+`tcp.rs`). We collapse the two transports into one: a worker process runs a
+`TransportServer`; routers hold pooled `TransportClient` connections and
+multiplex many in-flight requests per connection.
+
+Frames (codec.py msgpack):
+  client→server: {t:"req", rid, subject, payload, headers}
+                 {t:"cancel", rid}
+  server→client: {t:"data", rid, payload}
+                 {t:"end", rid} | {t:"err", rid, error}
+
+Cancellation propagates: context cancel on the client side sends a cancel
+frame; the server cancels the handler task (reference: context.rs kill signal).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+logger = logging.getLogger(__name__)
+
+STREAM_ERR_MSG = "stream disconnected"  # matched by Migration retry logic
+
+
+class TransportServer:
+    """Serves registered engines (by subject) to remote callers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, AsyncEngine] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._conn_writers: set[asyncio.StreamWriter] = set()
+
+    def register(self, subject: str, engine: AsyncEngine) -> None:
+        self._handlers[subject] = engine
+
+    def unregister(self, subject: str) -> None:
+        self._handlers.pop(subject, None)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Force-close live connections: wait_closed() blocks on connection
+        # handlers, which block on reads from clients that may never close.
+        for w in list(self._conn_writers):
+            w.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        inflight: dict[str, tuple[asyncio.Task, Context]] = {}
+        write_lock = asyncio.Lock()
+        self._conn_writers.add(writer)
+
+        async def send(obj: dict) -> None:
+            async with write_lock:
+                codec.write_frame(writer, obj)
+                await writer.drain()
+
+        async def run_request(rid: str, subject: str, payload: Any,
+                              headers: dict) -> None:
+            ctx = inflight[rid][1]
+            try:
+                engine = self._handlers.get(subject)
+                if engine is None:
+                    await send({"t": "err", "rid": rid,
+                                "error": f"no such endpoint: {subject}"})
+                    return
+                async for item in engine.generate(payload, ctx):
+                    await send({"t": "data", "rid": rid, "payload": item})
+                await send({"t": "end", "rid": rid})
+            except asyncio.CancelledError:
+                if not ctx.is_cancelled():  # server shutdown, not user cancel
+                    try:
+                        await send({"t": "err", "rid": rid, "error": STREAM_ERR_MSG})
+                    except Exception:
+                        pass
+                raise
+            except ConnectionError:
+                pass  # client went away; nothing to report to
+            except Exception as e:
+                logger.exception("handler error subject=%s rid=%s", subject, rid)
+                try:
+                    await send({"t": "err", "rid": rid, "error": repr(e)})
+                except Exception:
+                    pass
+            finally:
+                inflight.pop(rid, None)
+
+        try:
+            while True:
+                try:
+                    msg = await codec.read_frame(reader)
+                except ConnectionError:
+                    break
+                t = msg.get("t")
+                if t == "req":
+                    rid = msg["rid"]
+                    ctx = Context(request_id=rid, headers=msg.get("headers") or {})
+                    task = asyncio.get_running_loop().create_task(
+                        run_request(rid, msg["subject"], msg.get("payload"),
+                                    msg.get("headers") or {})
+                    )
+                    inflight[rid] = (task, ctx)
+                    self._conn_tasks.add(task)
+                    task.add_done_callback(self._conn_tasks.discard)
+                elif t == "cancel":
+                    entry = inflight.get(msg["rid"])
+                    if entry is not None:
+                        entry[1].cancel()
+                        entry[0].cancel()
+        finally:
+            self._conn_writers.discard(writer)
+            for task, ctx in list(inflight.values()):
+                ctx.cancel()
+                task.cancel()
+            writer.close()
+
+
+class _Connection:
+    """One pooled client connection; demultiplexes response streams."""
+
+    def __init__(self, address: str) -> None:
+        self.address = address
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._streams: dict[str, asyncio.Queue] = {}
+        self._rx_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+        self.closed = False
+
+    async def connect(self) -> None:
+        host, _, port = self.address.rpartition(":")
+        self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await codec.read_frame(self._reader)
+                q = self._streams.get(msg.get("rid"))
+                if q is not None:
+                    q.put_nowait(msg)
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # ConnectionError or a corrupt/undecodable frame
+            pass
+        finally:
+            self.closed = True
+            for q in list(self._streams.values()):
+                q.put_nowait({"t": "err", "error": STREAM_ERR_MSG})
+
+    async def send(self, obj: dict) -> None:
+        if self._writer is None or self.closed:
+            raise ConnectionError("connection closed")
+        async with self._write_lock:
+            codec.write_frame(self._writer, obj)
+            await self._writer.drain()
+
+    def open_stream(self, rid: str) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        return q
+
+    def close_stream(self, rid: str) -> None:
+        self._streams.pop(rid, None)
+
+    def close(self) -> None:
+        self.closed = True
+        if self._rx_task is not None:
+            self._rx_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+
+
+class TransportClient:
+    """Pooled connections keyed by address, with streaming request API."""
+
+    def __init__(self) -> None:
+        self._conns: dict[str, _Connection] = {}
+        self._rids = itertools.count(1)
+        # Per-address locks: a black-holed host must not head-of-line-block
+        # connection setup to healthy addresses.
+        self._locks: dict[str, asyncio.Lock] = {}
+
+    async def _conn(self, address: str) -> _Connection:
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is None or conn.closed:
+                conn = _Connection(address)
+                await conn.connect()
+                self._conns[address] = conn
+            return conn
+
+    async def request(self, address: str, subject: str, payload: Any,
+                      context: Optional[Context] = None) -> AsyncIterator[Any]:
+        """Send one request; yield response payloads until end.
+
+        Raises ConnectionError(STREAM_ERR_MSG) if the stream dies mid-way —
+        the signal the Migration operator retries on.
+        """
+        ctx = context or Context()
+        conn = await self._conn(address)
+        rid = f"{ctx.request_id}.{next(self._rids)}"
+        cancel_task = None
+        try:
+            q = conn.open_stream(rid)
+            await conn.send({"t": "req", "rid": rid, "subject": subject,
+                             "payload": payload, "headers": ctx.headers})
+
+            async def watch_cancel() -> None:
+                await ctx.wait_cancelled()
+                try:
+                    await conn.send({"t": "cancel", "rid": rid})
+                except ConnectionError:
+                    pass
+                q.put_nowait({"t": "end"})
+
+            cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
+            while True:
+                msg = await q.get()
+                t = msg.get("t")
+                if t == "data":
+                    yield msg["payload"]
+                elif t == "end":
+                    return
+                elif t == "err":
+                    raise ConnectionError(msg.get("error", STREAM_ERR_MSG))
+        finally:
+            if cancel_task is not None:
+                cancel_task.cancel()
+            conn.close_stream(rid)
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()

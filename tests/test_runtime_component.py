@@ -1,0 +1,146 @@
+"""Component model e2e: serve endpoints, discover via store, route via
+PushRouter, across two runtimes sharing a TCP store coordinator."""
+
+import asyncio
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push import ROUND_ROBIN, NoInstancesError, PushRouter
+from dynamo_tpu.runtime.store_net import StoreServer
+
+
+async def make_rt(store_url: str) -> DistributedRuntime:
+    return await DistributedRuntime.create(RuntimeConfig(store_url=store_url))
+
+
+async def test_serve_and_route_in_process():
+    rt = await make_rt("memory")
+    try:
+        async def gen(request, context):
+            for t in request["prompt"].split():
+                yield {"token": t}
+
+        ep = rt.namespace("test").component("worker").endpoint("generate")
+        served = await ep.serve(gen)
+        client = await ep.client()
+        await client.start()
+        await client.wait_ready()
+        assert client.instance_ids() == [served.instance.instance_id]
+
+        router = PushRouter(client)
+        out = [x async for x in router.generate({"prompt": "a b c"}, Context())]
+        assert out == [{"token": "a"}, {"token": "b"}, {"token": "c"}]
+    finally:
+        await rt.close()
+
+
+async def test_two_runtimes_cross_process_routing():
+    coordinator = StoreServer()
+    host, port = await coordinator.start()
+    url = f"tcp://{host}:{port}"
+    rt_worker = await make_rt(url)
+    rt_front = await make_rt(url)
+    try:
+        async def gen(request, context):
+            yield {"echo": request["x"], "from": "worker"}
+
+        ep_w = rt_worker.namespace("ns").component("w").endpoint("generate")
+        await ep_w.serve(gen)
+
+        ep_f = rt_front.namespace("ns").component("w").endpoint("generate")
+        client = await ep_f.client()
+        await client.start()
+        await client.wait_ready()
+        assert len(client.instances()) == 1
+
+        router = PushRouter(client)
+        out = [x async for x in router.generate({"x": 42}, Context())]
+        assert out == [{"echo": 42, "from": "worker"}]
+    finally:
+        await rt_front.close()
+        await rt_worker.close()
+        await coordinator.stop()
+
+
+async def test_round_robin_across_instances():
+    rt = await make_rt("memory")
+    try:
+        def mk(tag):
+            async def gen(request, context):
+                yield {"from": tag}
+            return gen
+
+        ep = rt.namespace("ns").component("w").endpoint("gen")
+        await ep.serve(mk("a"), instance_id=1)
+        await ep.serve(mk("b"), instance_id=2)
+        client = await ep.client()
+        await client.start()
+        await client.wait_ready()
+        router = PushRouter(client, mode=ROUND_ROBIN)
+        seen = set()
+        for _ in range(4):
+            async for x in router.generate({}, Context()):
+                seen.add(x["from"])
+        assert seen == {"a", "b"}
+    finally:
+        await rt.close()
+
+
+async def test_worker_death_removes_instance():
+    coordinator = StoreServer()
+    host, port = await coordinator.start()
+    url = f"tcp://{host}:{port}"
+    rt_worker = await make_rt(url)
+    rt_front = await make_rt(url)
+    try:
+        async def gen(request, context):
+            yield {}
+
+        ep_w = rt_worker.namespace("ns").component("w").endpoint("gen")
+        await ep_w.serve(gen)
+        client = await (rt_front.namespace("ns").component("w")
+                        .endpoint("gen").client())
+        await client.start()
+        await client.wait_ready()
+        assert len(client.instances()) == 1
+
+        await rt_worker.close()  # store conn drops -> lease revoked -> DELETE
+        for _ in range(40):
+            if not client.instances():
+                break
+            await asyncio.sleep(0.1)
+        assert client.instances() == []
+
+        router = PushRouter(client)
+        try:
+            async for _ in router.generate({}, Context()):
+                pass
+            raised = False
+        except NoInstancesError:
+            raised = True
+        assert raised
+    finally:
+        await rt_front.close()
+        await coordinator.stop()
+
+
+async def test_direct_mode_targets_instance():
+    rt = await make_rt("memory")
+    try:
+        def mk(tag):
+            async def gen(request, context):
+                yield {"from": tag}
+            return gen
+
+        ep = rt.namespace("ns").component("w").endpoint("gen")
+        await ep.serve(mk("a"), instance_id=0xA)
+        await ep.serve(mk("b"), instance_id=0xB)
+        client = await ep.client()
+        await client.start()
+        await client.wait_ready()
+        router = PushRouter(client)
+        out = [x async for x in router.direct({}, 0xB, Context())]
+        assert out == [{"from": "b"}]
+    finally:
+        await rt.close()

@@ -1,0 +1,62 @@
+"""Config layering, metrics registry, context cancellation."""
+
+import asyncio
+import os
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+
+def test_config_env_layering(monkeypatch):
+    monkeypatch.setenv("DYN_LEASE_TTL", "3.5")
+    monkeypatch.setenv("DYN_STORE_URL", "tcp://1.2.3.4:9")
+    monkeypatch.setenv("DYN_HEALTH_CHECK_ENABLED", "true")
+    cfg = RuntimeConfig.from_env()
+    assert cfg.lease_ttl == 3.5
+    assert cfg.store_url == "tcp://1.2.3.4:9"
+    assert cfg.health_check_enabled is True
+    assert cfg.listen_host == "127.0.0.1"  # default survives
+
+
+def test_metrics_registry_hierarchy_and_render():
+    reg = MetricsRegistry("dynamo")
+    http = reg.child("http")
+    c = http.counter("requests_total", "total requests")
+    c.inc(model="llama")
+    c.inc(model="llama")
+    c.inc(model="qwen")
+    g = reg.gauge("kv_usage", "kv usage")
+    g.set(0.5, worker="w1")
+    h = http.histogram("ttft_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = reg.render()
+    assert 'dynamo_http_requests_total{model="llama"} 2.0' in text
+    assert 'dynamo_kv_usage{worker="w1"} 0.5' in text
+    assert 'dynamo_http_ttft_seconds_bucket{le="0.1"} 1' in text
+    assert 'dynamo_http_ttft_seconds_bucket{le="+Inf"} 3' in text
+    assert h.count == 3
+    assert abs(h.mean() - (0.05 + 0.5 + 5.0) / 3) < 1e-9
+
+
+def test_metrics_scrape_callback():
+    reg = MetricsRegistry("dynamo")
+    g = reg.gauge("queue_depth")
+    reg.on_scrape(lambda: g.set(7.0))
+    text = reg.render()
+    assert "dynamo_queue_depth 7.0" in text
+
+
+async def test_context_cancellation_tree():
+    root = Context()
+    child = root.child()
+    grandchild = child.child()
+    assert not grandchild.is_cancelled()
+    child.cancel()
+    assert grandchild.is_cancelled()
+    assert child.is_cancelled()
+    assert not root.is_cancelled()  # cancel never propagates up
+    await asyncio.wait_for(grandchild.wait_cancelled(), 1)

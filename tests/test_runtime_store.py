@@ -1,0 +1,105 @@
+"""Store semantics: CRUD, leases, watches — in-proc and over TCP."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.store import DELETE, PUT, MemoryStore
+from dynamo_tpu.runtime.store_net import StoreClient, StoreServer
+
+
+async def test_memory_store_crud():
+    s = MemoryStore()
+    rev1 = await s.put("a/b", b"1")
+    rev2 = await s.put("a/c", b"2")
+    assert rev2 > rev1
+    kv = await s.get("a/b")
+    assert kv.value == b"1"
+    assert [kv.key for kv in await s.get_prefix("a/")] == ["a/b", "a/c"]
+    assert await s.create("a/b", b"x") is False
+    assert await s.create("a/d", b"3") is True
+    assert await s.delete("a/b") is True
+    assert await s.get("a/b") is None
+    assert await s.delete_prefix("a/") == 2
+
+
+async def test_memory_store_lease_expiry():
+    s = MemoryStore()
+    lease = await s.create_lease(ttl=0.3)
+    await s.put("inst/x", b"v", lease)
+    assert (await s.get("inst/x")) is not None
+    await asyncio.sleep(0.8)
+    assert (await s.get("inst/x")) is None
+    await s.close()
+
+
+async def test_memory_store_keepalive_preserves():
+    s = MemoryStore()
+    lease = await s.create_lease(ttl=0.4)
+    await s.put("k", b"v", lease)
+    for _ in range(4):
+        await asyncio.sleep(0.2)
+        await s.keep_alive(lease)
+    assert (await s.get("k")) is not None
+    await s.close()
+
+
+async def test_watch_replay_and_live_events():
+    s = MemoryStore()
+    await s.put("p/one", b"1")
+    watch = s.watch_prefix("p/")
+    await s.put("p/two", b"2")
+    await s.delete("p/one")
+    evs = [await asyncio.wait_for(watch.__anext__(), 1) for _ in range(3)]
+    assert (evs[0].kind, evs[0].key) == (PUT, "p/one")
+    assert (evs[1].kind, evs[1].key) == (PUT, "p/two")
+    assert (evs[2].kind, evs[2].key) == (DELETE, "p/one")
+    watch.cancel()
+
+
+async def test_tcp_store_roundtrip():
+    server = StoreServer()
+    host, port = await server.start()
+    c = StoreClient(host, port)
+    await c.connect()
+    try:
+        await c.put("x/a", b"hello")
+        kv = await c.get("x/a")
+        assert kv.value == b"hello"
+        assert await c.create("x/a", b"no") is False
+        kvs = await c.get_prefix("x/")
+        assert len(kvs) == 1
+
+        watch = c.watch_prefix("x/")
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert ev.kind == PUT and ev.key == "x/a"
+        await c.put("x/b", b"2")
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert ev.key == "x/b"
+        watch.cancel()
+    finally:
+        await c.close()
+        await server.stop()
+
+
+async def test_tcp_store_conn_death_revokes_lease():
+    """A client that vanishes takes its registered keys with it."""
+    server = StoreServer()
+    host, port = await server.start()
+    c1 = StoreClient(host, port)
+    await c1.connect()
+    lease = await c1.create_lease(ttl=30.0)  # long TTL: death must not wait for it
+    await c1.put("live/worker1", b"addr", lease)
+
+    c2 = StoreClient(host, port)
+    await c2.connect()
+    watch = c2.watch_prefix("live/")
+    ev = await asyncio.wait_for(watch.__anext__(), 2)
+    assert ev.kind == PUT
+
+    await c1.close()  # connection drop => lease revoked server-side
+    ev = await asyncio.wait_for(watch.__anext__(), 2)
+    assert ev.kind == DELETE and ev.key == "live/worker1"
+    watch.cancel()
+    await c2.close()
+    await server.stop()

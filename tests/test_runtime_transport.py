@@ -1,0 +1,154 @@
+"""Transport: streaming request/response, multiplexing, cancel, errors."""
+
+import asyncio
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import FnEngine
+from dynamo_tpu.runtime.transport import (
+    STREAM_ERR_MSG,
+    TransportClient,
+    TransportServer,
+)
+
+
+async def echo_n(request, context):
+    for i in range(request["n"]):
+        yield {"i": i, "msg": request["msg"]}
+
+
+async def test_stream_roundtrip():
+    server = TransportServer()
+    server.register("ns.comp.echo", FnEngine(echo_n))
+    addr = await server.start()
+    client = TransportClient()
+    try:
+        out = [x async for x in client.request(addr, "ns.comp.echo",
+                                               {"n": 3, "msg": "hi"})]
+        assert out == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"},
+                       {"i": 2, "msg": "hi"}]
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_multiplexed_concurrent_streams():
+    server = TransportServer()
+    server.register("s.c.e", FnEngine(echo_n))
+    addr = await server.start()
+    client = TransportClient()
+
+    async def one(i):
+        return [x["i"] async for x in client.request(
+            addr, "s.c.e", {"n": 5, "msg": str(i)})]
+
+    try:
+        results = await asyncio.gather(*(one(i) for i in range(20)))
+        assert all(r == [0, 1, 2, 3, 4] for r in results)
+        # all multiplexed over one pooled connection
+        assert len(client._conns) == 1
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_unknown_subject_errors():
+    server = TransportServer()
+    addr = await server.start()
+    client = TransportClient()
+    try:
+        got = None
+        try:
+            async for _ in client.request(addr, "nope", {}):
+                pass
+        except ConnectionError as e:
+            got = str(e)
+        assert got and "no such endpoint" in got
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_handler_exception_propagates():
+    async def boom(request, context):
+        yield {"ok": 1}
+        raise ValueError("kaput")
+
+    server = TransportServer()
+    server.register("s.c.boom", FnEngine(boom))
+    addr = await server.start()
+    client = TransportClient()
+    try:
+        items, err = [], None
+        try:
+            async for x in client.request(addr, "s.c.boom", {}):
+                items.append(x)
+        except ConnectionError as e:
+            err = str(e)
+        assert items == [{"ok": 1}]
+        assert err and "kaput" in err
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_cancellation_stops_server_side():
+    started = asyncio.Event()
+    cancelled_server_side = asyncio.Event()
+
+    async def slow(request, context):
+        started.set()
+        try:
+            for i in range(1000):
+                yield {"i": i}
+                await asyncio.sleep(0.05)
+        except asyncio.CancelledError:
+            cancelled_server_side.set()
+            raise
+
+    server = TransportServer()
+    server.register("s.c.slow", FnEngine(slow))
+    addr = await server.start()
+    client = TransportClient()
+    ctx = Context()
+
+    async def consume():
+        async for _ in client.request(addr, "s.c.slow", {}, ctx):
+            pass
+
+    task = asyncio.get_running_loop().create_task(consume())
+    try:
+        await asyncio.wait_for(started.wait(), 2)
+        ctx.cancel()
+        await asyncio.wait_for(cancelled_server_side.wait(), 2)
+        await asyncio.wait_for(task, 2)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_server_death_surfaces_stream_err():
+    """Mid-stream server death must raise STREAM_ERR_MSG (migration hook)."""
+    async def forever(request, context):
+        i = 0
+        while True:
+            yield {"i": i}
+            i += 1
+            await asyncio.sleep(0.02)
+
+    server = TransportServer()
+    server.register("s.c.f", FnEngine(forever))
+    addr = await server.start()
+    client = TransportClient()
+    got = []
+    err = None
+    try:
+        async for x in client.request(addr, "s.c.f", {}):
+            got.append(x)
+            if len(got) == 3:
+                await server.stop()
+    except ConnectionError as e:
+        err = str(e)
+    finally:
+        await client.close()
+    assert len(got) >= 3
+    assert err == STREAM_ERR_MSG

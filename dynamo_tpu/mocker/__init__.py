@@ -1,0 +1,14 @@
+"""Mock engine: a faithful simulation of the TPU serving engine.
+
+Reference: `lib/llm/src/mocker/` (MockVllmEngine, `mocker/engine.rs:48`) —
+the central device for exercising the full distributed stack (router,
+frontend, planner, disaggregation) with zero accelerators: it simulates a
+paged KV cache with prefix reuse, watermark admission, preemption, and
+prefill/decode timing, while publishing *real* KV events and
+ForwardPassMetrics, so every consumer behaves identically to production.
+"""
+
+from dynamo_tpu.mocker.kv_manager import MockKvManager
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+
+__all__ = ["MockEngine", "MockEngineConfig", "MockKvManager"]

@@ -1,0 +1,247 @@
+"""Shared wire/protocol types: KV cache events, worker metrics, internal
+request/response shapes.
+
+Reference: `lib/llm/src/kv_router/protocols.rs` (KvCacheEvent*, WorkerId,
+ForwardPassMetrics) and `lib/llm/src/protocols/common/llm_backend.rs`
+(PreprocessedRequest, LLMEngineOutput, FinishReason). Everything here is a
+plain dataclass with dict (msgpack/json-safe) serialisation — these cross
+process boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+# ---------------------------------------------------------------------------
+# KV cache events (engine → router index)
+# ---------------------------------------------------------------------------
+
+KV_STORED = "stored"
+KV_REMOVED = "removed"
+KV_CLEARED = "cleared"
+
+
+@dataclass(frozen=True)
+class StoredBlock:
+    """One block that entered a worker's KV cache."""
+
+    seq_hash: int     # chained prefix identity (tokens.py)
+    local_hash: int   # content-only hash
+
+
+@dataclass
+class KvCacheEvent:
+    """stored: blocks + parent linkage; removed: seq_hashes; cleared: all."""
+
+    kind: str                       # KV_STORED | KV_REMOVED | KV_CLEARED
+    worker_id: int
+    dp_rank: int = 0
+    event_id: int = 0
+    parent_seq_hash: Optional[int] = None   # stored: parent of blocks[0]
+    blocks: list[StoredBlock] = field(default_factory=list)
+    seq_hashes: list[int] = field(default_factory=list)  # removed
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "worker_id": self.worker_id,
+            "dp_rank": self.dp_rank, "event_id": self.event_id,
+            "parent_seq_hash": self.parent_seq_hash,
+            "blocks": [[b.seq_hash, b.local_hash] for b in self.blocks],
+            "seq_hashes": self.seq_hashes,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvCacheEvent":
+        return cls(
+            kind=d["kind"], worker_id=d["worker_id"],
+            dp_rank=d.get("dp_rank", 0), event_id=d.get("event_id", 0),
+            parent_seq_hash=d.get("parent_seq_hash"),
+            blocks=[StoredBlock(s, l) for s, l in d.get("blocks", [])],
+            seq_hashes=list(d.get("seq_hashes", [])),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Worker load metrics (engine → router scheduler / planner)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class WorkerStats:
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class KvStats:
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    hbm_cache_usage: float = 0.0        # reference: gpu_cache_usage_perc
+    host_cache_usage: float = 0.0
+    prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Published per scheduler iteration (reference publisher.rs:691)."""
+
+    worker_id: int = 0
+    dp_rank: int = 0
+    worker_stats: WorkerStats = field(default_factory=WorkerStats)
+    kv_stats: KvStats = field(default_factory=KvStats)
+
+    def to_dict(self) -> dict:
+        return {
+            "worker_id": self.worker_id, "dp_rank": self.dp_rank,
+            "worker_stats": self.worker_stats.to_dict(),
+            "kv_stats": self.kv_stats.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        def known(klass, dd):
+            return {k: v for k, v in dd.items()
+                    if k in klass.__dataclass_fields__}
+
+        return cls(
+            worker_id=d.get("worker_id", 0), dp_rank=d.get("dp_rank", 0),
+            worker_stats=WorkerStats(**known(WorkerStats,
+                                             d.get("worker_stats", {}))),
+            kv_stats=KvStats(**known(KvStats, d.get("kv_stats", {}))),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Internal request/response shapes (frontend ↔ engine)
+# ---------------------------------------------------------------------------
+
+FINISH_STOP = "stop"
+FINISH_LENGTH = "length"
+FINISH_EOS = "eos"
+FINISH_CANCELLED = "cancelled"
+FINISH_ERROR = "error"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0                      # 0 = disabled
+    min_p: float = 0.0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    seed: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SamplingOptions":
+        known = {k: v for k, v in d.items()
+                 if k in cls.__dataclass_fields__ and v is not None}
+        return cls(**known)
+
+
+@dataclass
+class StopConditions:
+    max_tokens: Optional[int] = None
+    stop: list[str] = field(default_factory=list)          # stop strings
+    stop_token_ids: list[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    min_tokens: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StopConditions":
+        known = {k: v for k, v in d.items()
+                 if k in cls.__dataclass_fields__ and v is not None}
+        return cls(**known)
+
+
+@dataclass
+class PreprocessedRequest:
+    """What leaves the preprocessor: pure token ids + options.
+    Reference: `protocols/common/llm_backend.rs` PreprocessedRequest."""
+
+    token_ids: list[int]
+    model: str = ""
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    # Router annotations
+    dp_rank: Optional[int] = None
+    # Disaggregation: descriptors for remote prefill KV handoff
+    kv_transfer_params: Optional[dict] = None
+    # Request migration: accumulated tokens from a previous attempt
+    accumulated_tokens: list[int] = field(default_factory=list)
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "token_ids": self.token_ids, "model": self.model,
+            "sampling": self.sampling.to_dict(), "stop": self.stop.to_dict(),
+            "dp_rank": self.dp_rank,
+            "kv_transfer_params": self.kv_transfer_params,
+            "accumulated_tokens": self.accumulated_tokens,
+            "extra": self.extra,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d["token_ids"]), model=d.get("model", ""),
+            sampling=SamplingOptions.from_dict(d.get("sampling", {})),
+            stop=StopConditions.from_dict(d.get("stop", {})),
+            dp_rank=d.get("dp_rank"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            accumulated_tokens=list(d.get("accumulated_tokens", [])),
+            extra=d.get("extra", {}),
+        )
+
+
+@dataclass
+class EngineOutput:
+    """One streamed delta from an engine: new token ids (+ optional logprobs),
+    finish reason on the last frame. Reference: LLMEngineOutput."""
+
+    token_ids: list[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    cum_log_prob: Optional[float] = None
+    log_probs: Optional[list[float]] = None
+    kv_transfer_params: Optional[dict] = None   # prefill → decode handoff
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"token_ids": self.token_ids}
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason
+        if self.cum_log_prob is not None:
+            d["cum_log_prob"] = self.cum_log_prob
+        if self.log_probs is not None:
+            d["log_probs"] = self.log_probs
+        if self.kv_transfer_params is not None:
+            d["kv_transfer_params"] = self.kv_transfer_params
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EngineOutput":
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=d.get("finish_reason"),
+            cum_log_prob=d.get("cum_log_prob"),
+            log_probs=d.get("log_probs"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            extra=d.get("extra", {}),
+        )

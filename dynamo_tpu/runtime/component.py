@@ -170,7 +170,7 @@ class EndpointClient:
         # then seed from a get_prefix snapshot. Replayed PUTs arriving via
         # the watch are idempotent overwrites; DELETEs are strictly after
         # the snapshot in event order, so nothing is resurrected.
-        self._watch = store.watch_prefix(self.endpoint.instance_prefix)
+        self._watch = await store.watch_prefix(self.endpoint.instance_prefix)
         for kv in await store.get_prefix(self.endpoint.instance_prefix):
             inst = Instance.from_json(kv.value)
             self._instances[inst.instance_id] = inst

@@ -32,6 +32,13 @@ class DistributedRuntime:
         self.transport_server = transport_server
         self.transport_client = TransportClient()
         self.lease_id = lease_id
+        # Event plane: the StoreClient exposes pub/sub over its connection;
+        # in static (memory) mode a LocalEventBus serves the process.
+        from dynamo_tpu.runtime.events import EventBus, LocalEventBus
+
+        self.events: EventBus = (
+            store if isinstance(store, EventBus) else LocalEventBus()
+        )
         self.metrics = MetricsRegistry("dynamo")
         self._local_engines: dict[str, AsyncEngine] = {}
         self._shutdown = asyncio.Event()

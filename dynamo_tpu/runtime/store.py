@@ -74,9 +74,11 @@ class KeyValueStore:
     async def revoke_lease(self, lease_id: int) -> None:
         raise NotImplementedError
 
-    def watch_prefix(
+    async def watch_prefix(
         self, prefix: str, replay: bool = True
     ) -> "Watch":
+        """Async so remote impls can confirm registration before returning —
+        callers may rely on 'watch registered, then snapshot' ordering."""
         raise NotImplementedError
 
     async def close(self) -> None:
@@ -225,7 +227,7 @@ class MemoryStore(KeyValueStore):
         for key in list(lease.keys):
             await self.delete(key)
 
-    def watch_prefix(self, prefix: str, replay: bool = True) -> Watch:
+    async def watch_prefix(self, prefix: str, replay: bool = True) -> Watch:
         watch = Watch()
         if replay:
             for kv in self._data.values():

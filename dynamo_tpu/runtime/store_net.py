@@ -21,6 +21,7 @@ import logging
 from typing import Optional
 
 from dynamo_tpu.runtime import codec
+from dynamo_tpu.runtime.events import EventBus, LocalEventBus, Subscription
 from dynamo_tpu.runtime.store import (
     DELETE,
     PUT,
@@ -42,6 +43,7 @@ class StoreServer:
     def __init__(self, store: Optional[MemoryStore] = None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.store = store or MemoryStore()
+        self.events = LocalEventBus()
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
@@ -70,6 +72,7 @@ class StoreServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         watches: dict[int, tuple[Watch, asyncio.Task]] = {}
+        subs: dict[int, tuple[Subscription, asyncio.Task]] = {}
         conn_leases: set[int] = set()
         write_lock = asyncio.Lock()
         self._conn_writers.add(writer)
@@ -86,6 +89,11 @@ class StoreServer:
                     "value": ev.value, "rev": ev.revision,
                 })
 
+        async def pump_sub(sid: int, sub: Subscription) -> None:
+            async for msg in sub:
+                await send({"sub": sid, "seq": msg["seq"],
+                            "payload": msg["payload"]})
+
         try:
             while True:
                 try:
@@ -93,7 +101,8 @@ class StoreServer:
                 except ConnectionError:
                     break
                 try:
-                    reply = await self._dispatch(msg, watches, conn_leases, pump_watch)
+                    reply = await self._dispatch(msg, watches, conn_leases,
+                                                 pump_watch, subs, pump_sub)
                 except Exception as e:  # per-request fault isolation
                     reply = {"id": msg.get("id"), "error": repr(e)}
                 if reply is not None:
@@ -103,16 +112,36 @@ class StoreServer:
             for watch, task in watches.values():
                 watch.cancel()
                 task.cancel()
+            for sub, task in subs.values():
+                sub.cancel()
+                task.cancel()
             # Connection death revokes this connection's leases immediately —
             # faster failure detection than waiting out the TTL.
             for lease_id in conn_leases:
                 await self.store.revoke_lease(lease_id)
             writer.close()
 
-    async def _dispatch(self, msg, watches, conn_leases, pump_watch):
+    async def _dispatch(self, msg, watches, conn_leases, pump_watch,
+                        subs, pump_sub):
         op = msg["op"]
         mid = msg.get("id")
         s = self.store
+        if op == "pub":
+            await self.events.publish(msg["subject"], msg["payload"])
+            return {"id": mid, "ok": True}
+        if op == "sub":
+            sub = self.events.subscribe_nowait(
+                msg["subject"], from_start=msg.get("from_start", False))
+            task = asyncio.get_running_loop().create_task(
+                pump_sub(msg["sid"], sub))
+            subs[msg["sid"]] = (sub, task)
+            return {"id": mid, "ok": True}
+        if op == "unsub":
+            entry = subs.pop(msg["sid"], None)
+            if entry:
+                entry[0].cancel()
+                entry[1].cancel()
+            return {"id": mid, "ok": True}
         if op == "put":
             rev = await s.put(msg["key"], msg["value"], msg.get("lease", 0))
             return {"id": mid, "rev": rev}
@@ -143,7 +172,8 @@ class StoreServer:
             conn_leases.discard(msg["lease"])
             return {"id": mid, "ok": True}
         if op == "watch":
-            watch = s.watch_prefix(msg["prefix"], replay=msg.get("replay", True))
+            watch = await s.watch_prefix(msg["prefix"],
+                                         replay=msg.get("replay", True))
             task = asyncio.get_running_loop().create_task(
                 pump_watch(msg["wid"], watch)
             )
@@ -171,8 +201,9 @@ def _kv_from_wire(w) -> Optional[KeyValue]:
     return KeyValue(w["key"], w["value"], w["rev"], w.get("lease", 0))
 
 
-class StoreClient(KeyValueStore):
-    """KeyValueStore over a StoreServer connection, with auto lease keepalive."""
+class StoreClient(KeyValueStore, EventBus):
+    """KeyValueStore + EventBus over one StoreServer connection, with auto
+    lease keepalive."""
 
     def __init__(self, host: str, port: int) -> None:
         self.host = host
@@ -181,8 +212,10 @@ class StoreClient(KeyValueStore):
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
+        self._subs: dict[int, Subscription] = {}
         self._ids = itertools.count(1)
         self._wids = itertools.count(1)
+        self._sids = itertools.count(1)
         self._rx_task: Optional[asyncio.Task] = None
         self._keepalive_task: Optional[asyncio.Task] = None
         self._leases: dict[int, float] = {}  # lease_id -> ttl
@@ -208,6 +241,13 @@ class StoreClient(KeyValueStore):
                             msg.get("rev", 0),
                         ))
                     continue
+                if "sub" in msg and "op" not in msg:
+                    sub = self._subs.get(msg["sub"])
+                    if sub is not None and not sub._cancelled:
+                        sub.queue.put_nowait(
+                            {"seq": msg.get("seq", 0),
+                             "payload": msg.get("payload")})
+                    continue
                 fut = self._pending.pop(msg.get("id"), None)
                 if fut is not None and not fut.done():
                     if "error" in msg:
@@ -227,10 +267,13 @@ class StoreClient(KeyValueStore):
             for watch in list(self._watches.values()):
                 watch.cancel()
             self._watches.clear()
+            for sub in list(self._subs.values()):
+                sub.cancel()
+            self._subs.clear()
 
     async def _call(self, msg: dict) -> dict:
-        if self._writer is None:
-            raise ConnectionError("not connected")
+        if self._writer is None or self._closed:
+            raise ConnectionError("store connection lost")
         mid = next(self._ids)
         msg["id"] = mid
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -295,7 +338,7 @@ class StoreClient(KeyValueStore):
         self._leases.pop(lease_id, None)
         await self._call({"op": "lease_revoke", "lease": lease_id})
 
-    def watch_prefix(self, prefix: str, replay: bool = True) -> Watch:
+    async def watch_prefix(self, prefix: str, replay: bool = True) -> Watch:
         watch = Watch()
         wid = next(self._wids)
         self._watches[wid] = watch
@@ -310,20 +353,40 @@ class StoreClient(KeyValueStore):
                 )
 
         watch.cancel = cancel  # type: ignore[method-assign]
-
-        async def register() -> None:
-            try:
-                await self._call({"op": "watch", "prefix": prefix, "wid": wid,
-                                  "replay": replay})
-            except Exception:
-                # Fail loudly: end the watch stream instead of hanging its
-                # consumer on a subscription the server never saw.
-                logger.exception("watch registration failed prefix=%s", prefix)
-                orig_cancel()
-                self._watches.pop(wid, None)
-
-        asyncio.get_running_loop().create_task(register())
+        # Registration completes before we return, so a subsequent get_prefix
+        # snapshot is guaranteed to be ordered after the watch server-side.
+        try:
+            await self._call({"op": "watch", "prefix": prefix, "wid": wid,
+                              "replay": replay})
+        except Exception:
+            self._watches.pop(wid, None)
+            raise
         return watch
+
+    # -- EventBus (rides the same connection) ------------------------------
+
+    async def publish(self, subject: str, payload: dict) -> None:
+        await self._call({"op": "pub", "subject": subject, "payload": payload})
+
+    async def subscribe(self, subject: str,
+                        from_start: bool = False) -> Subscription:
+        sid = next(self._sids)
+
+        def on_cancel() -> None:
+            self._subs.pop(sid, None)
+            if not self._closed:
+                asyncio.get_running_loop().create_task(
+                    self._call({"op": "unsub", "sid": sid}))
+
+        sub = Subscription(on_cancel=on_cancel)
+        self._subs[sid] = sub
+        try:
+            await self._call({"op": "sub", "subject": subject, "sid": sid,
+                              "from_start": from_start})
+        except Exception:
+            self._subs.pop(sid, None)
+            raise
+        return sub
 
     async def close(self) -> None:
         self._closed = True

@@ -1,0 +1,145 @@
+"""Block-aligned token sequences with chained sequence hashes.
+
+Reference: `lib/llm/src/tokens.rs` (Tokens/TokenBlock/TokenBlockSequence,
+`tokens.rs:33,44,388,479`) and the router-side hash helpers
+(`lib/llm/src/kv_router/indexer.rs:122,149`). The chained "sequence hash" is
+the KV-cache identity used everywhere: two workers computed the same prefix
+iff their blocks have equal sequence hashes.
+
+Definitions (stable across processes — do not change without versioning):
+- local_hash(block)   = H(token bytes)                    (content only)
+- seq_hash(block[0])  = H(SEED ++ local_hash[0])
+- seq_hash(block[i])  = H(seq_hash[i-1] ++ local_hash[i]) (chained prefix)
+
+H = blake2b-64 over little-endian uint32 token ids / uint64 hashes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+# Chain seed for the first block (reference uses a fixed seed hash).
+SEED_HASH = 0xD2B4_5F5E_1A6B_3C79
+
+
+def _h64(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def compute_local_hash(tokens: Sequence[int]) -> int:
+    """Content hash of one block's tokens (indexer.rs compute_block_hash)."""
+    return _h64(struct.pack(f"<{len(tokens)}I", *tokens))
+
+
+def chain_hash(parent_seq_hash: int, local_hash: int) -> int:
+    return _h64(struct.pack("<QQ", parent_seq_hash, local_hash))
+
+
+def compute_block_hashes(tokens: Sequence[int], block_size: int) -> list[int]:
+    """Local hashes for each *complete* block of `tokens`."""
+    n = len(tokens) // block_size
+    return [
+        compute_local_hash(tokens[i * block_size:(i + 1) * block_size])
+        for i in range(n)
+    ]
+
+
+def compute_seq_hashes(tokens: Sequence[int], block_size: int,
+                       parent: int = SEED_HASH) -> list[int]:
+    """Chained sequence hashes for each complete block
+    (indexer.rs compute_seq_hash_for_block)."""
+    out = []
+    h = parent
+    for lh in compute_block_hashes(tokens, block_size):
+        h = chain_hash(h, lh)
+        out.append(h)
+    return out
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """One complete, immutable block of `block_size` tokens."""
+
+    tokens: tuple[int, ...]
+    local_hash: int
+    seq_hash: int
+    parent_seq_hash: int
+    block_index: int
+
+
+class TokenBlockSequence:
+    """Incrementally block-aligns an append-only token stream.
+
+    Engine-side use: as tokens are generated, completed blocks fall out with
+    their sequence hashes (→ KV events, block registry). Router-side use:
+    hash a prompt to query the radix index. (tokens.rs:388 TokenBlockSequence)
+    """
+
+    def __init__(self, block_size: int,
+                 tokens: Optional[Iterable[int]] = None) -> None:
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.block_size = block_size
+        self.blocks: list[TokenBlock] = []
+        self._partial: list[int] = []
+        self._tail_hash = SEED_HASH
+        if tokens is not None:
+            self.extend(tokens)
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self._partial)
+
+    @property
+    def partial_tokens(self) -> list[int]:
+        return list(self._partial)
+
+    @property
+    def tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self._partial)
+        return out
+
+    def append(self, token: int) -> Optional[TokenBlock]:
+        """Append one token; returns the TokenBlock if one just completed."""
+        self._partial.append(token)
+        if len(self._partial) < self.block_size:
+            return None
+        return self._seal()
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        """Append many tokens; returns all blocks completed by this call."""
+        completed = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                completed.append(b)
+        return completed
+
+    def _seal(self) -> TokenBlock:
+        toks = tuple(self._partial)
+        self._partial.clear()
+        lh = compute_local_hash(toks)
+        sh = chain_hash(self._tail_hash, lh)
+        block = TokenBlock(
+            tokens=toks, local_hash=lh, seq_hash=sh,
+            parent_seq_hash=self._tail_hash, block_index=len(self.blocks),
+        )
+        self.blocks.append(block)
+        self._tail_hash = sh
+        return block
+
+    def seq_hashes(self) -> list[int]:
+        return [b.seq_hash for b in self.blocks]
+
+    def truncate_blocks(self, n_blocks: int) -> None:
+        """Drop trailing blocks (and any partial) so n_blocks remain."""
+        if n_blocks > len(self.blocks):
+            raise ValueError("cannot truncate to more blocks than exist")
+        self.blocks = self.blocks[:n_blocks]
+        self._partial.clear()
+        self._tail_hash = self.blocks[-1].seq_hash if self.blocks else SEED_HASH

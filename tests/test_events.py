@@ -1,0 +1,49 @@
+"""Event bus: local + over the store connection, replay semantics."""
+
+import asyncio
+
+from dynamo_tpu.runtime.events import LocalEventBus
+from dynamo_tpu.runtime.store_net import StoreClient, StoreServer
+
+
+async def test_local_bus_pubsub_and_replay():
+    bus = LocalEventBus()
+    await bus.publish("kv", {"n": 1})
+    sub_new = await bus.subscribe("kv")            # no replay
+    sub_replay = await bus.subscribe("kv", from_start=True)
+    await bus.publish("kv", {"n": 2})
+
+    msg = await asyncio.wait_for(sub_replay.__anext__(), 1)
+    assert msg["payload"] == {"n": 1}
+    msg = await asyncio.wait_for(sub_replay.__anext__(), 1)
+    assert msg["payload"] == {"n": 2}
+
+    msg = await asyncio.wait_for(sub_new.__anext__(), 1)
+    assert msg["payload"] == {"n": 2}
+    sub_new.cancel()
+    sub_replay.cancel()
+
+
+async def test_pubsub_over_tcp_two_clients():
+    server = StoreServer()
+    host, port = await server.start()
+    pub = StoreClient(host, port)
+    await pub.connect()
+    consumer = StoreClient(host, port)
+    await consumer.connect()
+    try:
+        await pub.publish("kv_events.ns", {"ev": "early"})
+        sub = await consumer.subscribe("kv_events.ns", from_start=True)
+        await asyncio.sleep(0.05)  # let subscription register
+        await pub.publish("kv_events.ns", {"ev": "late"})
+
+        m1 = await asyncio.wait_for(sub.__anext__(), 2)
+        m2 = await asyncio.wait_for(sub.__anext__(), 2)
+        assert m1["payload"] == {"ev": "early"}
+        assert m2["payload"] == {"ev": "late"}
+        assert m2["seq"] > m1["seq"]
+        sub.cancel()
+    finally:
+        await pub.close()
+        await consumer.close()
+        await server.stop()

@@ -1,0 +1,156 @@
+"""Mock engine: scheduling, KV accounting, events, preemption, echo."""
+
+import asyncio
+
+from dynamo_tpu.engines import EchoEngine
+from dynamo_tpu.mocker import MockEngine, MockEngineConfig, MockKvManager
+from dynamo_tpu.protocols import (
+    KV_REMOVED,
+    KV_STORED,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+def make_req(tokens, max_tokens=8, model="m"):
+    r = PreprocessedRequest(token_ids=list(tokens), model=model)
+    r.stop.max_tokens = max_tokens
+    return r.to_dict()
+
+
+# -- MockKvManager ----------------------------------------------------------
+
+
+def test_kv_manager_prefix_reuse_and_events():
+    events = []
+    kv = MockKvManager(total_blocks=8, block_size=4, event_sink=events.append)
+    seq1 = TokenBlockSequence(4, list(range(8)))
+    assert kv.allocate_sequence(seq1)
+    assert kv.active_blocks == 2
+    assert len(events) == 1 and events[0].kind == KV_STORED
+    assert len(events[0].blocks) == 2
+
+    # same prefix, one extra block: only 1 new stored event-block
+    seq2 = TokenBlockSequence(4, list(range(12)))
+    assert kv.prefix_match_blocks(seq2) == 2
+    assert kv.allocate_sequence(seq2)
+    assert len(events) == 2
+    assert len(events[1].blocks) == 1
+
+    kv.free_sequence(seq1.seq_hashes())
+    kv.free_sequence(seq2.seq_hashes())
+    assert kv.active_blocks == 0
+    assert kv.used_blocks == 3  # cached in inactive pool
+
+
+def test_kv_manager_lru_eviction_emits_removed():
+    events = []
+    kv = MockKvManager(total_blocks=2, block_size=2, event_sink=events.append)
+    a = TokenBlockSequence(2, [1, 2, 3, 4])
+    assert kv.allocate_sequence(a)
+    kv.free_sequence(a.seq_hashes())
+    b = TokenBlockSequence(2, [9, 9, 8, 8])
+    assert kv.allocate_sequence(b)  # must evict both LRU blocks of `a`
+    removed = [e for e in events if e.kind == KV_REMOVED]
+    assert removed and len(removed[0].seq_hashes) == 2
+    assert kv.active_blocks == 2
+
+
+def test_kv_manager_capacity_refusal():
+    kv = MockKvManager(total_blocks=2, block_size=2)
+    big = TokenBlockSequence(2, list(range(10)))  # 5 blocks > 2
+    assert not kv.allocate_sequence(big)
+    assert kv.active_blocks == 0
+
+
+# -- MockEngine -------------------------------------------------------------
+
+
+async def test_mock_engine_echo_then_counts():
+    eng = MockEngine(MockEngineConfig(speedup=100.0, block_size=4))
+    prompt = [10, 11, 12]
+    out = []
+    async for d in eng.generate(make_req(prompt, max_tokens=5), Context()):
+        out.extend(d["token_ids"])
+    assert out[:3] == prompt          # echoes prompt first
+    assert len(out) == 5
+    await eng.close()
+
+
+async def test_mock_engine_concurrent_batching():
+    eng = MockEngine(MockEngineConfig(speedup=200.0, block_size=4,
+                                      total_kv_blocks=64))
+
+    async def one(i):
+        toks = []
+        async for d in eng.generate(make_req([i] * 4, max_tokens=6), Context()):
+            toks.extend(d["token_ids"])
+        return toks
+
+    results = await asyncio.gather(*(one(i) for i in range(8)))
+    assert all(len(r) == 6 for r in results)
+    assert all(r[:4] == [i] * 4 for i, r in enumerate(results))
+    # all requests finished → no active blocks
+    assert eng.kv.active_blocks == 0
+    await eng.close()
+
+
+async def test_mock_engine_publishes_events_and_metrics():
+    events, metrics = [], []
+    eng = MockEngine(
+        MockEngineConfig(speedup=200.0, block_size=2, total_kv_blocks=32),
+        event_sink=events.append, metrics_sink=metrics.append,
+    )
+    async for _ in eng.generate(make_req([1, 2, 3, 4], max_tokens=6), Context()):
+        pass
+    assert any(e.kind == KV_STORED for e in events)
+    assert metrics and metrics[-1].kv_stats.kv_total_blocks == 32
+    await eng.close()
+
+
+async def test_mock_engine_kv_pressure_preemption():
+    """Two long decodes on a tiny cache: at least one must get preempted yet
+    both complete correctly."""
+    eng = MockEngine(MockEngineConfig(
+        speedup=500.0, block_size=2, total_kv_blocks=8, watermark=1.0))
+
+    async def one(i):
+        toks = []
+        async for d in eng.generate(make_req([i, i], max_tokens=10), Context()):
+            toks.extend(d["token_ids"])
+        return toks
+
+    r = await asyncio.gather(one(1), one(2))
+    assert all(len(x) == 10 for x in r)
+    assert eng.kv.active_blocks == 0
+    await eng.close()
+
+
+async def test_mock_engine_cancellation():
+    eng = MockEngine(MockEngineConfig(speedup=1.0, decode_ms_per_iter=20.0))
+    ctx = Context()
+    got = []
+
+    async def run():
+        async for d in eng.generate(make_req([1, 2, 3], max_tokens=1000), ctx):
+            got.append(d)
+            if len(got) == 2:
+                ctx.cancel()
+
+    await asyncio.wait_for(run(), timeout=10)
+    assert 2 <= len(got) <= 4
+    await eng.close()
+
+
+# -- EchoEngine -------------------------------------------------------------
+
+
+async def test_echo_engine():
+    eng = EchoEngine(delay_ms=0.1)
+    out, finish = [], None
+    async for d in eng.generate(make_req([5, 6, 7], max_tokens=3), Context()):
+        out.extend(d["token_ids"])
+        finish = d.get("finish_reason")
+    assert out == [5, 6, 7]
+    assert finish == "length"

@@ -47,7 +47,7 @@ async def test_memory_store_keepalive_preserves():
 async def test_watch_replay_and_live_events():
     s = MemoryStore()
     await s.put("p/one", b"1")
-    watch = s.watch_prefix("p/")
+    watch = await s.watch_prefix("p/")
     await s.put("p/two", b"2")
     await s.delete("p/one")
     evs = [await asyncio.wait_for(watch.__anext__(), 1) for _ in range(3)]
@@ -70,7 +70,7 @@ async def test_tcp_store_roundtrip():
         kvs = await c.get_prefix("x/")
         assert len(kvs) == 1
 
-        watch = c.watch_prefix("x/")
+        watch = await c.watch_prefix("x/")
         ev = await asyncio.wait_for(watch.__anext__(), 2)
         assert ev.kind == PUT and ev.key == "x/a"
         await c.put("x/b", b"2")
@@ -93,7 +93,7 @@ async def test_tcp_store_conn_death_revokes_lease():
 
     c2 = StoreClient(host, port)
     await c2.connect()
-    watch = c2.watch_prefix("live/")
+    watch = await c2.watch_prefix("live/")
     ev = await asyncio.wait_for(watch.__anext__(), 2)
     assert ev.kind == PUT
 

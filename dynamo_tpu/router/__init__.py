@@ -1,0 +1,26 @@
+"""KV-cache-aware router: the flagship scheduler.
+
+Reference: `lib/llm/src/kv_router/` — RadixTree/KvIndexer (indexer.rs),
+ActiveSequences + DefaultWorkerSelector (scheduler.rs, sequence.rs),
+KvRouter/KvPushRouter (kv_router.rs), replica sync (subscriber.rs).
+"""
+
+from dynamo_tpu.router.indexer import (
+    ApproxKvIndexer,
+    KvIndexer,
+    OverlapScores,
+    RadixTree,
+)
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouter, KvRouterConfig
+from dynamo_tpu.router.scheduler import (
+    ActiveSequences,
+    DefaultWorkerSelector,
+    MultiWorkerSequences,
+    WorkerLoad,
+)
+
+__all__ = [
+    "RadixTree", "KvIndexer", "ApproxKvIndexer", "OverlapScores",
+    "ActiveSequences", "MultiWorkerSequences", "DefaultWorkerSelector",
+    "WorkerLoad", "KvRouter", "KvPushRouter", "KvRouterConfig",
+]

@@ -1,0 +1,340 @@
+"""KvRouter: combine the prefix index with the load scheduler; KvPushRouter
+wraps it as an AsyncEngine over a worker endpoint.
+
+Reference: `lib/llm/src/kv_router/kv_router.rs` — `KvRouter.find_best_match`
+(:203-320), `KvPushRouter` AsyncEngine (:479); event consumption
+(subscriber.rs:164 durable consumer); replica sync — routers publish
+AddRequest / MarkPrefillCompleted / Free so replicas' predicted loads
+converge (kv_router.rs:66-68, subscriber.rs); snapshot of the radix tree
+past an event threshold (kv_router.rs:70-74, NATS object store analog is
+the runtime KV store here).
+
+Event subjects (event bus):
+- ``kv_events.{ns}.{component}``     — engine KvCacheEvents → indexer
+- ``metrics.{ns}.{component}``       — ForwardPassMetrics → load correction
+- ``router_sync.{ns}.{component}``   — replica sync between routers
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    PreprocessedRequest,
+)
+from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, WorkerKey
+from dynamo_tpu.router.scheduler import (
+    DefaultWorkerSelector,
+    MultiWorkerSequences,
+    SelectionResult,
+    SelectorConfig,
+    WorkerLoad,
+)
+from dynamo_tpu.runtime.component import EndpointClient, Instance
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.events import EventBus
+from dynamo_tpu.runtime.push import PushRouter
+from dynamo_tpu.runtime.store import DELETE
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_KEY_PREFIX = "v1/router_snapshot/"
+# Events between snapshots (kv_router.rs:70-74). Must stay below the event
+# bus replay retention (events.DEFAULT_RETAIN=4096): a restarting router
+# restores the last snapshot and replays the retained tail, so the gap
+# between snapshots must always fit in the retained buffer.
+SNAPSHOT_THRESHOLD = 2048
+
+
+def kv_events_subject(ns: str, component: str) -> str:
+    return f"kv_events.{ns}.{component}"
+
+
+def metrics_subject(ns: str, component: str) -> str:
+    return f"metrics.{ns}.{component}"
+
+
+def router_sync_subject(ns: str, component: str) -> str:
+    return f"router_sync.{ns}.{component}"
+
+
+@dataclass
+class KvRouterConfig:
+    block_size: int = 16
+    overlap_weight: float = 1.0
+    temperature: float = 0.0
+    use_kv_events: bool = True        # False ⇒ ApproxKvIndexer
+    replica_sync: bool = False
+    snapshot_threshold: int = SNAPSHOT_THRESHOLD
+    ttl_secs: float = 120.0           # approx-indexer TTL
+
+
+class KvRouter:
+    """find_best_match + request lifecycle tracking (kv_router.rs:203)."""
+
+    def __init__(self, config: KvRouterConfig) -> None:
+        self.config = config
+        self.router_id = uuid.uuid4().hex[:8]
+        if config.use_kv_events:
+            self.indexer: Any = KvIndexer(config.block_size)
+        else:
+            self.indexer = ApproxKvIndexer(config.block_size, config.ttl_secs)
+        self.sequences = MultiWorkerSequences(config.block_size)
+        self.selector = DefaultWorkerSelector(SelectorConfig(
+            overlap_weight=config.overlap_weight,
+            temperature=config.temperature,
+            block_size=config.block_size,
+        ))
+        # workers known from instance discovery: worker_id -> set of dp_ranks
+        self._known: dict[int, int] = {}      # worker_id -> dp_size
+        self._metrics: dict[WorkerKey, ForwardPassMetrics] = {}
+
+    # -- worker membership (fed by instance watch) --------------------------
+
+    def add_worker(self, worker_id: int, dp_size: int = 1) -> None:
+        self._known[worker_id] = max(dp_size, 1)
+
+    def remove_worker(self, worker_id: int) -> None:
+        dp = self._known.pop(worker_id, 0)
+        for r in range(dp):
+            w = (worker_id, r)
+            self.indexer.remove_worker(w)
+            self.sequences.remove_worker(w)
+            self._metrics.pop(w, None)
+
+    def worker_keys(self) -> list[WorkerKey]:
+        return [(wid, r) for wid, dp in sorted(self._known.items())
+                for r in range(dp)]
+
+    # -- event ingestion ----------------------------------------------------
+
+    def apply_kv_event(self, ev: KvCacheEvent) -> None:
+        if self.config.use_kv_events:
+            self.indexer.apply_event(ev)
+
+    def apply_metrics(self, m: ForwardPassMetrics) -> None:
+        self._metrics[(m.worker_id, m.dp_rank)] = m
+
+    # -- the decision (kv_router.rs:320 find_best_match) --------------------
+
+    def find_best_match(self, request_id: str, token_ids: list[int],
+                        update_states: bool = True) -> SelectionResult:
+        workers = self.worker_keys()
+        if not workers:
+            raise ConnectionError("no workers registered with KvRouter")
+        overlaps = self.indexer.find_matches_for_tokens(token_ids).scores
+        request_blocks = max(
+            (len(token_ids) + self.config.block_size - 1)
+            // self.config.block_size, 1)
+        candidates = []
+        for w in workers:
+            seqs = self.sequences.worker(w)
+            m = self._metrics.get(w)
+            candidates.append(WorkerLoad(
+                worker=w,
+                overlap_blocks=overlaps.get(w, 0),
+                active_prefill_tokens=seqs.active_prefill_tokens,
+                active_decode_blocks=seqs.active_blocks,
+                total_kv_blocks=(m.kv_stats.kv_total_blocks if m else 0),
+                metrics=m,
+            ))
+        result = self.selector.select(request_blocks, candidates)
+        result.prefill_tokens = max(
+            len(token_ids) - result.overlap_blocks * self.config.block_size, 0)
+        result.total_blocks = request_blocks
+        if update_states:
+            self.sequences.add_request(
+                request_id, result.worker,
+                result.prefill_tokens, result.total_blocks)
+            if not self.config.use_kv_events:
+                self.indexer.process_routing_decision(result.worker, token_ids)
+        return result
+
+    def mark_prefill_completed(self, request_id: str) -> None:
+        self.sequences.mark_prefill_completed(request_id)
+
+    def free(self, request_id: str) -> None:
+        self.sequences.free(request_id)
+
+    # -- snapshot / restore -------------------------------------------------
+
+    def dump_snapshot(self) -> list[dict]:
+        if not self.config.use_kv_events:
+            return []
+        return [e.to_dict() for e in self.indexer.tree.dump_events()]
+
+    def restore_snapshot(self, events: list[dict]) -> None:
+        for d in events:
+            self.apply_kv_event(KvCacheEvent.from_dict(d))
+
+
+class KvPushRouter:
+    """AsyncEngine: route a PreprocessedRequest to the KV-best worker and
+    push it there (kv_router.rs:479). Also runs the background consumers.
+    """
+
+    def __init__(self, client: EndpointClient, bus: EventBus,
+                 config: Optional[KvRouterConfig] = None) -> None:
+        self.client = client
+        self.bus = bus
+        self.config = config or KvRouterConfig()
+        self.router = KvRouter(self.config)
+        self.push = PushRouter(client)
+        ep = client.endpoint
+        self._ns = ep.component.namespace.name
+        self._component = ep.component.name
+        self._tasks: list[asyncio.Task] = []
+        self._started = False
+        self._events_since_snapshot = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "KvPushRouter":
+        if self._started:
+            return self
+        self._started = True
+        await self.client.start()
+        for inst in self.client.instances():
+            self.router.add_worker(
+                inst.instance_id, inst.metadata.get("dp_size", 1))
+        self.client.on_change(self._on_instance_change)
+        await self._restore_snapshot()
+        loop = asyncio.get_running_loop()
+        if self.config.use_kv_events:
+            sub = await self.bus.subscribe(
+                kv_events_subject(self._ns, self._component), from_start=True)
+            self._tasks.append(loop.create_task(self._consume_kv_events(sub)))
+        msub = await self.bus.subscribe(
+            metrics_subject(self._ns, self._component))
+        self._tasks.append(loop.create_task(self._consume_metrics(msub)))
+        if self.config.replica_sync:
+            ssub = await self.bus.subscribe(
+                router_sync_subject(self._ns, self._component))
+            self._tasks.append(loop.create_task(self._consume_sync(ssub)))
+        return self
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+
+    def _on_instance_change(self, kind: str, inst: Instance) -> None:
+        if kind == DELETE:
+            self.router.remove_worker(inst.instance_id)
+        else:
+            self.router.add_worker(
+                inst.instance_id, inst.metadata.get("dp_size", 1))
+
+    # -- background consumers ----------------------------------------------
+
+    async def _consume_kv_events(self, sub) -> None:
+        async for msg in sub:
+            self.router.apply_kv_event(
+                KvCacheEvent.from_dict(msg["payload"]))
+            self._events_since_snapshot += 1
+            if self._events_since_snapshot >= self.config.snapshot_threshold:
+                self._events_since_snapshot = 0
+                await self._save_snapshot()
+
+    async def _consume_metrics(self, sub) -> None:
+        async for msg in sub:
+            self.router.apply_metrics(
+                ForwardPassMetrics.from_dict(msg["payload"]))
+
+    async def _consume_sync(self, sub) -> None:
+        async for msg in sub:
+            p = msg["payload"]
+            if p.get("router_id") == self.router.router_id:
+                continue  # our own publication
+            op = p.get("op")
+            if op == "add":
+                self.router.sequences.add_request(
+                    p["request_id"], tuple(p["worker"]),
+                    p["prefill_tokens"], p["total_blocks"])
+            elif op == "prefill_done":
+                self.router.mark_prefill_completed(p["request_id"])
+            elif op == "free":
+                self.router.free(p["request_id"])
+
+    async def _publish_sync(self, payload: dict) -> None:
+        if not self.config.replica_sync:
+            return
+        payload["router_id"] = self.router.router_id
+        await self.bus.publish(
+            router_sync_subject(self._ns, self._component), payload)
+
+    # -- snapshots ----------------------------------------------------------
+
+    @property
+    def _snapshot_key(self) -> str:
+        return f"{SNAPSHOT_KEY_PREFIX}{self._ns}/{self._component}"
+
+    async def _save_snapshot(self) -> None:
+        store = self.client.endpoint.runtime.store
+        data = json.dumps(self.router.dump_snapshot()).encode()
+        await store.put(self._snapshot_key, data)
+
+    async def _restore_snapshot(self) -> None:
+        store = self.client.endpoint.runtime.store
+        kv = await store.get(self._snapshot_key)
+        if kv is not None:
+            try:
+                self.router.restore_snapshot(json.loads(kv.value))
+            except Exception:
+                logger.exception("router snapshot restore failed; starting cold")
+
+    async def reset_states(self) -> None:
+        """--router-reset-states: wipe the persisted snapshot + local index
+        (both the event-fed tree and approx-mode predictions)."""
+        store = self.client.endpoint.runtime.store
+        await store.delete(self._snapshot_key)
+        idx = self.router.indexer
+        if hasattr(idx, "clear"):
+            idx.clear()          # ApproxKvIndexer: tree + TTL heap
+        else:
+            idx.tree.clear()     # KvIndexer
+
+    # -- engine contract ----------------------------------------------------
+
+    async def best_worker_id(self, token_ids: list[int]
+                             ) -> tuple[int, int, int]:
+        """Query-only endpoint: (worker_id, dp_rank, overlap_blocks)
+        — the standalone `dynamo.router` service's `best_worker_id`."""
+        r = self.router.find_best_match(
+            uuid.uuid4().hex, token_ids, update_states=False)
+        return r.worker[0], r.worker[1], r.overlap_blocks
+
+    async def generate(self, request: dict, context: Optional[Context] = None
+                       ) -> AsyncIterator[dict]:
+        ctx = context or Context()
+        token_ids = list(request.get("token_ids", ()))
+        request_id = ctx.request_id
+        sel = self.router.find_best_match(request_id, token_ids)
+        worker_id, dp_rank = sel.worker
+        await self._publish_sync({
+            "op": "add", "request_id": request_id,
+            "worker": [worker_id, dp_rank],
+            "prefill_tokens": sel.prefill_tokens,
+            "total_blocks": sel.total_blocks,
+        })
+        request = dict(request)
+        request["dp_rank"] = dp_rank
+        first = True
+        try:
+            async for item in self.push.direct(request, worker_id, ctx):
+                if first:
+                    first = False
+                    self.router.mark_prefill_completed(request_id)
+                    await self._publish_sync(
+                        {"op": "prefill_done", "request_id": request_id})
+                yield item
+        finally:
+            self.router.free(request_id)
+            await self._publish_sync({"op": "free", "request_id": request_id})

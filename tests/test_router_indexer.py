@@ -1,0 +1,139 @@
+"""RadixTree / KvIndexer / ApproxKvIndexer unit tests
+(reference: indexer.rs inline tests, approx.rs)."""
+
+from dynamo_tpu.protocols import (
+    KV_CLEARED,
+    KV_REMOVED,
+    KV_STORED,
+    KvCacheEvent,
+    StoredBlock,
+)
+from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, RadixTree
+from dynamo_tpu.tokens import (
+    SEED_HASH,
+    compute_block_hashes,
+    compute_seq_hashes,
+)
+
+BS = 4
+
+
+def stored_event(worker, tokens, dp_rank=0, start_block=0):
+    """Build a stored event covering all complete blocks of `tokens`."""
+    local = compute_block_hashes(tokens, BS)
+    seq = compute_seq_hashes(tokens, BS)
+    parent = SEED_HASH if start_block == 0 else seq[start_block - 1]
+    return KvCacheEvent(
+        kind=KV_STORED, worker_id=worker, dp_rank=dp_rank,
+        parent_seq_hash=parent,
+        blocks=[StoredBlock(s, l) for s, l in
+                zip(seq[start_block:], local[start_block:])],
+    )
+
+
+def test_find_matches_prefix_depth():
+    tree = RadixTree()
+    toks = list(range(12))  # 3 blocks
+    tree.apply_event(stored_event(1, toks))
+    tree.apply_event(stored_event(2, toks[:8]))  # worker 2 has 2 blocks
+
+    scores = tree.find_matches(compute_block_hashes(toks, BS))
+    assert scores.scores == {(1, 0): 3, (2, 0): 2}
+    assert scores.matched_blocks == 3
+
+    # Query with a divergent 3rd block: overlap capped at 2 for both
+    q = toks[:8] + [99, 98, 97, 96]
+    scores = tree.find_matches(compute_block_hashes(q, BS))
+    assert scores.scores == {(1, 0): 2, (2, 0): 2}
+
+
+def test_consecutive_prefix_only():
+    tree = RadixTree()
+    toks = list(range(12))
+    # Worker 1 holds blocks 0..2; worker 2 holds only block 1 via a chain
+    # that shares block 0's content. Insert worker-2 chain 0,1 then remove
+    # block 0 membership so it only sits at depth 2.
+    tree.apply_event(stored_event(1, toks))
+    tree.apply_event(stored_event(2, toks[:8]))
+    seq = compute_seq_hashes(toks, BS)
+    tree.apply_event(KvCacheEvent(
+        kind=KV_REMOVED, worker_id=2, seq_hashes=[seq[0]]))
+    scores = tree.find_matches(compute_block_hashes(toks, BS))
+    # worker 2 lost block 0 => no consecutive prefix => score absent/0
+    assert scores.scores.get((2, 0), 0) == 0
+    assert scores.scores[(1, 0)] == 3
+
+
+def test_removed_and_pruning():
+    tree = RadixTree()
+    toks = list(range(8))
+    tree.apply_event(stored_event(1, toks))
+    seq = compute_seq_hashes(toks, BS)
+    # remove leaf block then root block
+    tree.apply_event(KvCacheEvent(kind=KV_REMOVED, worker_id=1,
+                                  seq_hashes=[seq[1]]))
+    assert tree.find_matches(compute_block_hashes(toks, BS)).scores == {(1, 0): 1}
+    tree.apply_event(KvCacheEvent(kind=KV_REMOVED, worker_id=1,
+                                  seq_hashes=[seq[0]]))
+    assert tree.find_matches(compute_block_hashes(toks, BS)).scores == {}
+    # fully pruned: internal maps empty except root
+    assert tree._by_seq.keys() == {SEED_HASH}
+
+
+def test_cleared_and_remove_worker():
+    tree = RadixTree()
+    tree.apply_event(stored_event(1, list(range(8))))
+    tree.apply_event(stored_event(2, list(range(8))))
+    tree.apply_event(KvCacheEvent(kind=KV_CLEARED, worker_id=1))
+    scores = tree.find_matches(compute_block_hashes(list(range(8)), BS))
+    assert (1, 0) not in scores.scores and (2, 0) in scores.scores
+    tree.remove_worker((2, 0))
+    assert tree.find_matches(
+        compute_block_hashes(list(range(8)), BS)).scores == {}
+
+
+def test_dp_ranks_scored_separately():
+    tree = RadixTree()
+    toks = list(range(8))
+    tree.apply_event(stored_event(1, toks, dp_rank=0))
+    tree.apply_event(stored_event(1, toks[:4], dp_rank=1))
+    scores = tree.find_matches(compute_block_hashes(toks, BS))
+    assert scores.scores == {(1, 0): 2, (1, 1): 1}
+
+
+def test_dump_restore_roundtrip():
+    tree = RadixTree()
+    tree.apply_event(stored_event(1, list(range(12))))
+    tree.apply_event(stored_event(2, list(range(8))))
+    tree.apply_event(stored_event(2, [5, 6, 7, 8, 9, 10, 11, 12]))
+    events = tree.dump_events()
+    tree2 = RadixTree.restore(events)
+    for q in (list(range(12)), [5, 6, 7, 8], list(range(4))):
+        lh = compute_block_hashes(q, BS)
+        assert tree.find_matches(lh).scores == tree2.find_matches(lh).scores
+
+
+def test_orphan_stored_event_dropped():
+    tree = RadixTree()
+    tree.apply_event(KvCacheEvent(
+        kind=KV_STORED, worker_id=1, parent_seq_hash=0xDEAD,
+        blocks=[StoredBlock(1, 2)]))
+    assert tree.workers() == []
+
+
+def test_kv_indexer_tokens_api():
+    idx = KvIndexer(block_size=BS)
+    toks = list(range(16))
+    idx.apply_event(stored_event(3, toks))
+    scores = idx.find_matches_for_tokens(toks + [1, 2])  # partial tail ignored
+    assert scores.scores == {(3, 0): 4}
+
+
+def test_approx_indexer_ttl():
+    now = [0.0]
+    idx = ApproxKvIndexer(block_size=BS, ttl_secs=10.0, clock=lambda: now[0])
+    toks = list(range(8))
+    idx.process_routing_decision((7, 0), toks)
+    assert idx.find_matches_for_tokens(toks).scores == {(7, 0): 2}
+    now[0] = 11.0
+    assert idx.find_matches_for_tokens(toks).scores == {}

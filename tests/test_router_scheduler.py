@@ -1,0 +1,102 @@
+"""ActiveSequences + DefaultWorkerSelector unit tests
+(reference: scheduler.rs:462-560, sequence.rs tests)."""
+
+import random
+
+from dynamo_tpu.router.scheduler import (
+    ActiveSequences,
+    DefaultWorkerSelector,
+    MultiWorkerSequences,
+    SelectorConfig,
+    WorkerLoad,
+)
+
+
+def test_active_sequences_lifecycle():
+    seqs = ActiveSequences(block_size=16)
+    seqs.add_request("r1", prefill_tokens=64, total_blocks=5)
+    seqs.add_request("r2", prefill_tokens=32, total_blocks=3)
+    assert seqs.active_prefill_tokens == 96
+    assert seqs.active_blocks == 8
+    seqs.mark_prefill_completed("r1")
+    assert seqs.active_prefill_tokens == 32
+    assert seqs.active_blocks == 8
+    seqs.free("r1")
+    assert seqs.active_blocks == 3
+    seqs.free("r2")
+    assert seqs.num_active == 0
+
+
+def test_multi_worker_owner_tracking():
+    mw = MultiWorkerSequences(block_size=16)
+    mw.add_request("r1", (1, 0), 64, 4)
+    mw.add_request("r2", (2, 0), 64, 4)
+    mw.mark_prefill_completed("r1")
+    assert mw.worker((1, 0)).active_prefill_tokens == 0
+    assert mw.worker((2, 0)).active_prefill_tokens == 64
+    mw.remove_worker((2, 0))
+    mw.free("r2")  # no-op, owner gone
+    mw.free("r1")
+    assert mw.worker((1, 0)).num_active == 0
+
+
+def test_selector_prefers_overlap():
+    sel = DefaultWorkerSelector(SelectorConfig(overlap_weight=1.0))
+    cands = [
+        WorkerLoad(worker=(1, 0), overlap_blocks=8),
+        WorkerLoad(worker=(2, 0), overlap_blocks=0),
+    ]
+    r = sel.select(request_blocks=10, candidates=cands)
+    assert r.worker == (1, 0)
+    assert r.overlap_blocks == 8
+    # logit math: w1 = 1*(10-8) + 10 = 12 ; w2 = 1*10 + 10 = 20
+    assert r.logits[(1, 0)] == 12 and r.logits[(2, 0)] == 20
+
+
+def test_selector_prefers_idle_when_no_overlap():
+    sel = DefaultWorkerSelector()
+    cands = [
+        WorkerLoad(worker=(1, 0), active_decode_blocks=100),
+        WorkerLoad(worker=(2, 0), active_decode_blocks=2),
+    ]
+    assert sel.select(4, cands).worker == (2, 0)
+
+
+def test_selector_overlap_vs_load_tradeoff():
+    # Heavy queue on the overlap worker should eventually lose to an idle one.
+    sel = DefaultWorkerSelector(SelectorConfig(overlap_weight=1.0))
+    cands = [
+        WorkerLoad(worker=(1, 0), overlap_blocks=4,
+                   active_prefill_tokens=16 * 64,  # 64 blocks backlog
+                   active_decode_blocks=50),
+        WorkerLoad(worker=(2, 0), overlap_blocks=0),
+    ]
+    assert sel.select(5, cands).worker == (2, 0)
+
+
+def test_temperature_zero_random_tiebreak():
+    sel = DefaultWorkerSelector(rng=random.Random(0))
+    cands = [WorkerLoad(worker=(i, 0)) for i in range(4)]
+    seen = {sel.select(1, cands).worker for _ in range(50)}
+    assert len(seen) > 1  # ties broken randomly, not always the first
+
+
+def test_temperature_softmax_spreads():
+    sel = DefaultWorkerSelector(
+        SelectorConfig(temperature=10.0), rng=random.Random(1))
+    cands = [
+        WorkerLoad(worker=(1, 0), overlap_blocks=2),
+        WorkerLoad(worker=(2, 0), overlap_blocks=0),
+    ]
+    seen = {sel.select(4, cands).worker for _ in range(100)}
+    assert seen == {(1, 0), (2, 0)}  # high temp ⇒ both get traffic
+
+
+def test_temperature_zero_is_argmin():
+    sel = DefaultWorkerSelector(SelectorConfig(temperature=0.0))
+    cands = [
+        WorkerLoad(worker=(1, 0), overlap_blocks=3),
+        WorkerLoad(worker=(2, 0), overlap_blocks=1),
+    ]
+    for _ in range(20):
+        assert sel.select(4, cands).worker == (1, 0)

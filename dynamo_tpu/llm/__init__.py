@@ -1,0 +1,6 @@
+"""LLM serving library: OpenAI protocols, preprocessing, detokenization,
+model cards, discovery, HTTP frontend.
+
+Reference: `lib/llm/` — preprocessor.rs, backend.rs, migration.rs,
+model_card.rs, discovery/, http/service/, protocols/openai/.
+"""

@@ -1,0 +1,108 @@
+"""Entrypoints: assemble and run frontends and workers.
+
+Reference: `lib/llm/src/entrypoint.rs` (`EngineConfig`, `Input`,
+`run_input`) and `entrypoint/input/common.rs:261-325` (pipeline assembly).
+The Python CLI layers (`python -m dynamo_tpu.frontend` etc.) call these.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.llm.model_manager import ModelManager, ModelWatcher
+from dynamo_tpu.router.kv_router import (
+    KvRouterConfig,
+    kv_events_subject,
+    metrics_subject,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Frontend:
+    runtime: DistributedRuntime
+    manager: ModelManager
+    watcher: ModelWatcher
+    http: HttpService
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.http.host}:{self.http.port}"
+
+    async def stop(self) -> None:
+        await self.http.stop()
+        await self.watcher.stop()
+        await self.manager.close()
+
+
+async def start_frontend(runtime: DistributedRuntime,
+                         host: str = "127.0.0.1", port: int = 0,
+                         router_config: Optional[KvRouterConfig] = None
+                         ) -> Frontend:
+    """HTTP frontend: model discovery + OpenAI server (Input::Http)."""
+    manager = ModelManager(runtime, router_config)
+    watcher = await ModelWatcher(manager).start()
+    http = HttpService(manager, host, port)
+    await http.start()
+    return Frontend(runtime, manager, watcher, http)
+
+
+@dataclass
+class WorkerHandle:
+    runtime: DistributedRuntime
+    card: ModelDeploymentCard
+    served: object
+
+    async def stop(self) -> None:
+        await self.served.shutdown()
+
+
+async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
+                       card: ModelDeploymentCard,
+                       instance_id: Optional[int] = None) -> WorkerHandle:
+    """Worker side (entrypoint/input/endpoint.rs): serve a core engine on
+    the card's endpoint and publish the card."""
+    ep = (runtime.namespace(card.namespace).component(card.component)
+          .endpoint(card.endpoint))
+    served = await ep.serve(
+        engine, instance_id=instance_id,
+        metadata={"dp_size": card.runtime_config.data_parallel_size})
+    await register_llm(runtime, card)
+    return WorkerHandle(runtime, card, served)
+
+
+def wire_engine_events(runtime: DistributedRuntime,
+                       card: ModelDeploymentCard):
+    """Return (event_sink, metrics_sink) callables that publish a worker
+    engine's KV events and ForwardPassMetrics onto the runtime event bus
+    under the card's component subjects."""
+    import asyncio
+
+    ev_subject = kv_events_subject(card.namespace, card.component)
+    m_subject = metrics_subject(card.namespace, card.component)
+    bus = runtime.events
+
+    def event_sink(ev) -> None:
+        payload = ev.to_dict() if hasattr(ev, "to_dict") else ev
+        if hasattr(bus, "publish_nowait"):
+            bus.publish_nowait(ev_subject, payload)
+        else:
+            asyncio.get_running_loop().create_task(
+                bus.publish(ev_subject, payload))
+
+    def metrics_sink(m) -> None:
+        payload = m.to_dict() if hasattr(m, "to_dict") else m
+        if hasattr(bus, "publish_nowait"):
+            bus.publish_nowait(m_subject, payload)
+        else:
+            asyncio.get_running_loop().create_task(
+                bus.publish(m_subject, payload))
+
+    return event_sink, metrics_sink

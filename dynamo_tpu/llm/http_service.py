@@ -1,0 +1,203 @@
+"""OpenAI-compatible HTTP frontend (aiohttp, the axum analog).
+
+Reference: `lib/llm/src/http/service/` — `/v1/chat/completions`
+(openai.rs:540), `/v1/completions` (:274), `/v1/models`, health routes,
+SSE streaming with client-disconnect detection (service/disconnect.rs:
+dropping the connection cancels the request context mid-stream), and
+HTTP metrics with TTFT/ITL histograms (service/metrics.rs:109-262).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.model_manager import ModelManager
+from dynamo_tpu.llm.preprocessor import KIND_CHAT, KIND_COMPLETION
+from dynamo_tpu.llm.protocols_openai import (
+    OpenAIError,
+    SSE_DONE,
+    aggregate_chat_stream,
+    aggregate_completion_stream,
+    new_request_id,
+    sse_encode,
+)
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(self, manager: ModelManager, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.add_routes([
+            web.post("/v1/chat/completions", self._chat),
+            web.post("/v1/completions", self._completions),
+            web.get("/v1/models", self._models),
+            web.get("/health", self._health),
+            web.get("/live", self._live),
+            web.get("/metrics", self._metrics),
+        ])
+        self._runner: Optional[web.AppRunner] = None
+        m = manager.runtime.metrics.child("http")
+        self._req_counter = m.counter(
+            "requests_total", "HTTP requests by endpoint/status")
+        self._inflight = m.gauge("inflight_requests", "streams in flight")
+        self._ttft = m.histogram(
+            "time_to_first_token_seconds", "TTFT",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0))
+        self._itl = m.histogram(
+            "inter_token_latency_seconds", "ITL",
+            buckets=(0.0001, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0))
+        self._duration = m.histogram(
+            "request_duration_seconds", "total request duration")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore
+        logger.info("HTTP frontend on http://%s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_openai(request, KIND_CHAT)
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_openai(request, KIND_COMPLETION)
+
+    async def _serve_openai(self, request: web.Request,
+                            kind: str) -> web.StreamResponse:
+        endpoint = ("chat_completions" if kind == KIND_CHAT
+                    else "completions")
+        try:
+            body = await request.json()
+        except Exception:
+            return self._error(endpoint, OpenAIError("invalid JSON body"))
+        model = body.get("model") if isinstance(body, dict) else None
+        engine = self.manager.engine_for(model) if model else None
+        if engine is None:
+            return self._error(endpoint, OpenAIError(
+                f"model {model!r} not found", status=404,
+                err_type="model_not_found"))
+        stream = bool(body.get("stream"))
+        request_id = new_request_id(
+            "chatcmpl" if kind == KIND_CHAT else "cmpl")
+        ctx = Context(request_id=request_id)
+        pipeline_request = {"_kind": kind, "body": body,
+                            "request_id": request_id}
+        start = time.perf_counter()
+        self._inflight.add(1)
+        try:
+            chunks = engine.generate(pipeline_request, ctx)
+            if stream:
+                return await self._stream_sse(
+                    request, endpoint, chunks, ctx, start)
+            # unary: aggregate the stream
+            try:
+                full = await (aggregate_chat_stream(chunks)
+                              if kind == KIND_CHAT
+                              else aggregate_completion_stream(chunks))
+            except OpenAIError as e:
+                return self._error(endpoint, e)
+            except asyncio.CancelledError:
+                # client disconnected mid-aggregation: stop downstream work
+                ctx.cancel()
+                self._req_counter.inc(endpoint=endpoint, status="disconnect")
+                raise
+            self._req_counter.inc(endpoint=endpoint, status="200")
+            self._duration.observe(time.perf_counter() - start)
+            return web.json_response(full)
+        finally:
+            self._inflight.add(-1)
+
+    async def _stream_sse(self, request: web.Request, endpoint: str,
+                          chunks, ctx: Context,
+                          start: float) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        first_token_at: Optional[float] = None
+        last_token_at: Optional[float] = None
+        try:
+            async for chunk in chunks:
+                if first_token_at is None and self._has_content(chunk):
+                    first_token_at = time.perf_counter()
+                    self._ttft.observe(first_token_at - start)
+                elif self._has_content(chunk) and last_token_at is not None:
+                    self._itl.observe(time.perf_counter() - last_token_at)
+                if self._has_content(chunk):
+                    last_token_at = time.perf_counter()
+                if not resp.prepared:
+                    await resp.prepare(request)
+                await resp.write(sse_encode(chunk))
+            if not resp.prepared:
+                await resp.prepare(request)
+            await resp.write(SSE_DONE)
+            self._req_counter.inc(endpoint=endpoint, status="200")
+        except OpenAIError as e:
+            if not resp.prepared:
+                return self._error(endpoint, e)
+            await resp.write(sse_encode(e.body()))
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: cancel downstream work (disconnect.rs)
+            ctx.cancel()
+            self._req_counter.inc(endpoint=endpoint, status="disconnect")
+            raise
+        finally:
+            self._duration.observe(time.perf_counter() - start)
+        await resp.write_eof()
+        return resp
+
+    @staticmethod
+    def _has_content(chunk: dict) -> bool:
+        for choice in chunk.get("choices", ()):
+            if choice.get("delta", {}).get("content") or choice.get("text"):
+                return True
+        return False
+
+    def _error(self, endpoint: str, e: OpenAIError) -> web.Response:
+        self._req_counter.inc(endpoint=endpoint, status=str(e.status))
+        return web.json_response(e.body(), status=e.status)
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": name, "object": "model",
+                      "created": int(time.time()), "owned_by": "dynamo-tpu"}
+                     for name in self.manager.model_names()],
+        })
+
+    async def _health(self, request: web.Request) -> web.Response:
+        ready = bool(self.manager.model_names())
+        return web.json_response(
+            {"status": "healthy" if ready else "no models",
+             "models": self.manager.model_names()},
+            status=200 if ready else 503)
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.manager.runtime.metrics.render(),
+                            content_type="text/plain")

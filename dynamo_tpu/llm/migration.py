@@ -1,0 +1,63 @@
+"""Migration operator: replay a dying request on a surviving worker.
+
+Reference: `lib/llm/src/migration.rs:26-73` — wraps the network edge; when
+the response stream dies (worker crash, connection loss) it retries on a
+*new* worker up to `migration_limit` times, carrying the tokens generated so
+far, so generation continues seamlessly mid-stream
+(docs/architecture/request_migration.md).
+
+Sits between Backend and the router: requests/responses at this hop are
+PreprocessedRequest / EngineOutput dicts (token ids, not text), so replayed
+requests append accumulated tokens to the prompt.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import Operator
+
+logger = logging.getLogger(__name__)
+
+
+class Migration(Operator):
+    def __init__(self, migration_limit: int = 0) -> None:
+        super().__init__()
+        self.migration_limit = migration_limit
+
+    async def forward(self, request: dict, context: Context
+                      ) -> AsyncIterator[dict]:
+        assert self.inner is not None
+        accumulated: list[int] = list(request.get("accumulated_tokens", ()))
+        attempts_left = self.migration_limit
+        while True:
+            req = dict(request)
+            if accumulated:
+                # Replay: the new worker prefills prompt+generated and
+                # continues; max_tokens shrinks by what was already produced.
+                req["token_ids"] = list(request["token_ids"]) + accumulated
+                stop = dict(req.get("stop") or {})
+                if stop.get("max_tokens"):
+                    stop["max_tokens"] = max(
+                        stop["max_tokens"] - len(accumulated), 1)
+                req["stop"] = stop
+                req["accumulated_tokens"] = accumulated
+            try:
+                async for out in self.inner.generate(req, context):
+                    accumulated.extend(out.get("token_ids", ()))
+                    yield out
+                    if out.get("finish_reason"):
+                        return
+                return  # clean end of stream
+            except ConnectionError as e:
+                if context.is_cancelled() or attempts_left <= 0:
+                    raise
+                attempts_left -= 1
+                logger.warning(
+                    "stream for request %s died (%s); migrating "
+                    "(%d attempts left, %d tokens accumulated)",
+                    context.request_id, e, attempts_left, len(accumulated))
+                # loop retries on a fresh worker; a dead instance has
+                # already left the client's instance set

@@ -1,0 +1,90 @@
+"""ModelDeploymentCard: everything a frontend needs to serve a model.
+
+Reference: `lib/llm/src/model_card.rs:35,118-171,463` — tokenizer config,
+context length, KV block size, migration limit, runtime config (total KV
+blocks, dp size); published to the KV store under ``v1/mdc/...`` with a
+checksum, attached to the worker's lease, watched by frontends.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+MDC_PREFIX = "v1/mdc/"
+
+
+@dataclass
+class ModelRuntimeConfig:
+    """Engine-reported capacity (local_model/runtime_config.rs)."""
+
+    total_kv_blocks: int = 0
+    max_batch_size: int = 0
+    data_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str                       # served model name ("model" in requests)
+    namespace: str = "dynamo"
+    component: str = "backend"
+    endpoint: str = "generate"
+    tokenizer_kind: str = "word"    # word | byte | hf
+    tokenizer_path: str = ""
+    context_length: int = 8192
+    kv_block_size: int = 16
+    migration_limit: int = 0
+    router_mode: str = "kv"         # kv | round_robin | random
+    runtime_config: ModelRuntimeConfig = field(
+        default_factory=ModelRuntimeConfig)
+
+    def store_key(self, lease_id: int) -> str:
+        """Per-worker key: each serving process publishes its own copy, so
+        the model stays discoverable until the *last* worker's lease drops."""
+        return (f"{MDC_PREFIX}{self.namespace}/{self.component}/"
+                f"{self.name}/{lease_id:x}")
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["checksum"] = self.checksum()
+        return d
+
+    def checksum(self) -> str:
+        d = asdict(self)
+        return hashlib.blake2b(
+            json.dumps(d, sort_keys=True).encode(), digest_size=8).hexdigest()
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.to_dict()).encode()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelDeploymentCard":
+        rc = d.get("runtime_config") or {}
+        known_rc = {k: v for k, v in rc.items()
+                    if k in ModelRuntimeConfig.__dataclass_fields__}
+        known = {k: v for k, v in d.items()
+                 if k in cls.__dataclass_fields__ and k != "runtime_config"}
+        return cls(runtime_config=ModelRuntimeConfig(**known_rc), **known)
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ModelDeploymentCard":
+        return cls.from_dict(json.loads(raw))
+
+
+async def register_llm(runtime, card: ModelDeploymentCard) -> None:
+    """Publish the card under the process lease (worker side, the analog of
+    the reference's `register_llm`, bindings lib.rs:123 → model_card.rs:463).
+    The lease attachment means a dead worker's card disappears, and the
+    frontend drops the model when its last card vanishes."""
+    await runtime.store.put(card.store_key(runtime.lease_id), card.to_json(),
+                            runtime.lease_id)
+
+
+async def unregister_llm(runtime, card: ModelDeploymentCard) -> None:
+    await runtime.store.delete(card.store_key(runtime.lease_id))

@@ -1,0 +1,153 @@
+"""ModelManager + ModelWatcher: discovery-driven pipeline assembly.
+
+Reference: `lib/llm/src/discovery/{watcher.rs:49,model_manager.rs:38}` and
+the pipeline assembly in `entrypoint/input/common.rs:261-325`
+(`build_routed_pipeline_with_preprocessor`): when a ModelDeploymentCard
+appears under ``v1/mdc/``, build
+preprocessor → backend → migration → router(kv|round_robin) and expose it
+by model name; when the last card for a model vanishes, tear it down.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import MDC_PREFIX, ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import make_tokenizer
+from dynamo_tpu.router.kv_router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, build_pipeline
+from dynamo_tpu.runtime.push import PushRouter
+from dynamo_tpu.runtime.store import DELETE, PUT
+
+logger = logging.getLogger(__name__)
+
+
+class ModelEntry:
+    def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine,
+                 kv_router: Optional[KvPushRouter], client) -> None:
+        self.card = card
+        self.engine = engine
+        self.kv_router = kv_router
+        self.client = client
+        self.card_keys: set[str] = set()
+
+
+class ModelManager:
+    """model name → serving pipeline (discovery/model_manager.rs:38)."""
+
+    def __init__(self, runtime, router_config: Optional[KvRouterConfig] = None
+                 ) -> None:
+        self.runtime = runtime
+        self.router_config = router_config
+        self._models: dict[str, ModelEntry] = {}
+
+    def model_names(self) -> list[str]:
+        return sorted(self._models)
+
+    def get(self, model: str) -> Optional[ModelEntry]:
+        return self._models.get(model)
+
+    def engine_for(self, model: str) -> Optional[AsyncEngine]:
+        e = self._models.get(model)
+        return e.engine if e else None
+
+    async def add_model(self, card: ModelDeploymentCard,
+                        card_key: str) -> ModelEntry:
+        entry = self._models.get(card.name)
+        if entry is not None:
+            entry.card_keys.add(card_key)
+            return entry
+        rt = self.runtime
+        ep = (rt.namespace(card.namespace).component(card.component)
+              .endpoint(card.endpoint))
+        client = await ep.client()
+        await client.start()
+        kv_router: Optional[KvPushRouter] = None
+        if card.router_mode == "kv":
+            cfg = self.router_config or KvRouterConfig(
+                block_size=card.kv_block_size)
+            kv_router = await KvPushRouter(client, rt.events, cfg).start()
+            router_engine: AsyncEngine = kv_router
+        else:
+            router_engine = PushRouter(client, mode=card.router_mode)
+        tokenizer = make_tokenizer(card.tokenizer_kind, card.tokenizer_path)
+        engine = build_pipeline(
+            OpenAIPreprocessor(tokenizer, card.name, card.context_length),
+            Backend(tokenizer),
+            Migration(card.migration_limit),
+            sink=router_engine,
+        )
+        entry = ModelEntry(card, engine, kv_router, client)
+        entry.card_keys.add(card_key)
+        self._models[card.name] = entry
+        logger.info("model added: %s (router=%s)", card.name, card.router_mode)
+        return entry
+
+    async def remove_card(self, model: str, card_key: str) -> None:
+        entry = self._models.get(model)
+        if entry is None:
+            return
+        entry.card_keys.discard(card_key)
+        if entry.card_keys:
+            return  # other workers still serve this model
+        del self._models[model]
+        if entry.kv_router is not None:
+            await entry.kv_router.stop()
+        await entry.client.stop()
+        logger.info("model removed: %s", model)
+
+    async def close(self) -> None:
+        for name in list(self._models):
+            entry = self._models.pop(name)
+            if entry.kv_router is not None:
+                await entry.kv_router.stop()
+            await entry.client.stop()
+
+
+class ModelWatcher:
+    """Watches ``v1/mdc/`` and drives the ModelManager
+    (discovery/watcher.rs:49,60+)."""
+
+    def __init__(self, manager: ModelManager) -> None:
+        self.manager = manager
+        self._task: Optional[asyncio.Task] = None
+        self._watch = None
+        # card_key -> model name (DELETE events carry only the key)
+        self._key_model: dict[str, str] = {}
+
+    async def start(self) -> "ModelWatcher":
+        store = self.manager.runtime.store
+        self._watch = await store.watch_prefix(MDC_PREFIX)
+        for kv in await store.get_prefix(MDC_PREFIX):
+            await self._on_put(kv.key, kv.value)
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    async def _run(self) -> None:
+        assert self._watch is not None
+        async for ev in self._watch:
+            try:
+                if ev.kind == PUT:
+                    await self._on_put(ev.key, ev.value)
+                elif ev.kind == DELETE:
+                    model = self._key_model.pop(ev.key, None)
+                    if model is not None:
+                        await self.manager.remove_card(model, ev.key)
+            except Exception:
+                logger.exception("model watcher failed on %s", ev.key)
+
+    async def _on_put(self, key: str, value: bytes) -> None:
+        card = ModelDeploymentCard.from_json(value)
+        self._key_model[key] = card.name
+        await self.manager.add_model(card, key)
+
+    async def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.cancel()
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,163 @@
+"""OpenAIPreprocessor: OpenAI request → PreprocessedRequest on the way down,
+BackendOutput stream → OpenAI SSE chunks on the way up.
+
+Reference: `lib/llm/src/preprocessor.rs:102,159,430,629-700` — chat
+templating, tokenization, sampling-option application, and the postprocess
+stream transform back to OpenAI deltas.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.protocols_openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    OpenAIError,
+    chat_chunk,
+    completion_chunk,
+    new_request_id,
+    usage_dict,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import Operator
+
+KIND_CHAT = "chat"
+KIND_COMPLETION = "completion"
+
+DEFAULT_TEMPLATE_SUFFIX = "assistant:"
+
+
+def render_chat_template(tokenizer: Tokenizer, messages: list[dict]) -> str:
+    """HF chat template when the tokenizer has one; else a minimal
+    role-prefixed rendering (preprocessor/prompt/template/oai.rs analog)."""
+    apply = getattr(tokenizer, "apply_chat_template", None)
+    if apply is not None:
+        try:
+            return apply(messages, add_generation_prompt=True)
+        except Exception:
+            pass  # template missing/broken: fall through to default
+    lines = []
+    for m in messages:
+        content = m.get("content") or ""
+        if isinstance(content, list):  # multimodal parts: text only for now
+            content = " ".join(p.get("text", "") for p in content
+                               if isinstance(p, dict))
+        lines.append(f"{m.get('role', 'user')}: {content}")
+    lines.append(DEFAULT_TEMPLATE_SUFFIX)
+    return "\n".join(lines)
+
+
+class OpenAIPreprocessor(Operator):
+    """Front pipeline stage. Requests are dicts with ``_kind`` set by the
+    HTTP layer (chat vs completion); responses are OpenAI chunk dicts."""
+
+    def __init__(self, tokenizer: Tokenizer, model_name: str,
+                 context_length: int = 0,
+                 default_max_tokens: int = 1024) -> None:
+        super().__init__()
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.context_length = context_length
+        self.default_max_tokens = default_max_tokens
+
+    # -- request path -------------------------------------------------------
+
+    def preprocess_chat(self, req: ChatCompletionRequest
+                        ) -> PreprocessedRequest:
+        prompt = render_chat_template(self.tokenizer, req.messages)
+        return self._finish_preprocess(
+            prompt_ids=self.tokenizer.encode(prompt),
+            sampling=req.sampling_options(), stop=req.stop_conditions())
+
+    def preprocess_completion(self, req: CompletionRequest
+                              ) -> PreprocessedRequest:
+        if isinstance(req.prompt, list):
+            ids = [int(t) for t in req.prompt]
+        else:
+            ids = self.tokenizer.encode(req.prompt)
+        return self._finish_preprocess(
+            prompt_ids=ids, sampling=req.sampling_options(),
+            stop=req.stop_conditions())
+
+    def _finish_preprocess(self, prompt_ids, sampling, stop
+                           ) -> PreprocessedRequest:
+        if stop.max_tokens is None:
+            stop.max_tokens = self.default_max_tokens
+        if not stop.ignore_eos and self.tokenizer.eos_token_id is not None:
+            eos = self.tokenizer.eos_token_id
+            if eos not in stop.stop_token_ids:
+                stop.stop_token_ids.append(eos)
+        if self.context_length and len(prompt_ids) >= self.context_length:
+            raise OpenAIError(
+                f"prompt ({len(prompt_ids)} tokens) exceeds the model "
+                f"context length of {self.context_length}", status=400)
+        return PreprocessedRequest(
+            token_ids=list(prompt_ids), model=self.model_name,
+            sampling=sampling, stop=stop)
+
+    # -- pipeline stage -----------------------------------------------------
+
+    async def forward(self, request: dict, context: Context
+                      ) -> AsyncIterator[dict]:
+        assert self.inner is not None
+        kind = request.get("_kind", KIND_CHAT)
+        created = int(time.time())
+        if kind == KIND_CHAT:
+            oai = ChatCompletionRequest.from_dict(request["body"])
+            pre = self.preprocess_chat(oai)
+            request_id = request.get("request_id") or new_request_id()
+            async for chunk in self._postprocess_chat(
+                    pre, oai, request_id, created, context):
+                yield chunk
+        else:
+            oai_c = CompletionRequest.from_dict(request["body"])
+            pre = self.preprocess_completion(oai_c)
+            request_id = request.get("request_id") or new_request_id("cmpl")
+            async for chunk in self._postprocess_completion(
+                    pre, oai_c, request_id, created, context):
+                yield chunk
+
+    async def _postprocess_chat(self, pre: PreprocessedRequest,
+                                oai: ChatCompletionRequest, request_id: str,
+                                created: int, context: Context
+                                ) -> AsyncIterator[dict]:
+        prompt_tokens = len(pre.token_ids)
+        completion_tokens = 0
+        yield chat_chunk(request_id, oai.model, created, role="assistant")
+        finish: Optional[str] = None
+        async for out in self.inner.generate(pre.to_dict(), context):
+            completion_tokens += len(out.get("token_ids", ()))
+            text = out.get("text", "")
+            finish = out.get("finish_reason")
+            if text:
+                yield chat_chunk(request_id, oai.model, created, content=text)
+            if finish:
+                break
+        yield chat_chunk(
+            request_id, oai.model, created, finish_reason=finish or "stop",
+            usage=usage_dict(prompt_tokens, completion_tokens))
+
+    async def _postprocess_completion(self, pre: PreprocessedRequest,
+                                      oai: CompletionRequest, request_id: str,
+                                      created: int, context: Context
+                                      ) -> AsyncIterator[dict]:
+        prompt_tokens = len(pre.token_ids)
+        completion_tokens = 0
+        finish: Optional[str] = None
+        if oai.echo and isinstance(oai.prompt, str):
+            yield completion_chunk(request_id, oai.model, created, oai.prompt)
+        async for out in self.inner.generate(pre.to_dict(), context):
+            completion_tokens += len(out.get("token_ids", ()))
+            text = out.get("text", "")
+            finish = out.get("finish_reason")
+            if text:
+                yield completion_chunk(request_id, oai.model, created, text)
+            if finish:
+                break
+        yield completion_chunk(
+            request_id, oai.model, created, "", finish_reason=finish or "stop",
+            usage=usage_dict(prompt_tokens, completion_tokens))

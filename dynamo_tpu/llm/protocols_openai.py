@@ -1,0 +1,292 @@
+"""OpenAI-compatible request/response types + SSE codec + aggregation.
+
+Reference: `lib/llm/src/protocols/openai/*` (chat_completions, completions),
+`protocols/codec.rs` (SSE), `chat_completions/aggregator.rs` (delta→full).
+Wire format is plain dicts (we parse/emit JSON directly); these classes give
+validation and canonical construction of responses.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.protocols import (
+    SamplingOptions,
+    StopConditions,
+)
+
+
+class OpenAIError(ValueError):
+    """Maps to an HTTP 4xx with an OpenAI-style error body."""
+
+    def __init__(self, message: str, status: int = 400,
+                 err_type: str = "invalid_request_error") -> None:
+        super().__init__(message)
+        self.status = status
+        self.err_type = err_type
+
+    def body(self) -> dict:
+        return {"error": {"message": str(self), "type": self.err_type,
+                          "param": None, "code": None}}
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise OpenAIError(msg)
+
+
+@dataclass
+class ChatCompletionRequest:
+    model: str
+    messages: list[dict]
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None          # NVIDIA/NIM extension field
+    min_p: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    ignore_eos: bool = False             # extension (nvext in reference)
+    min_tokens: Optional[int] = None
+    logprobs: bool = False
+    n: int = 1
+    raw: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChatCompletionRequest":
+        _require(isinstance(d, dict), "request body must be a JSON object")
+        _require(bool(d.get("model")), "'model' is required")
+        msgs = d.get("messages")
+        _require(isinstance(msgs, list) and len(msgs) > 0,
+                 "'messages' must be a non-empty array")
+        for m in msgs:
+            _require(isinstance(m, dict) and "role" in m,
+                     "each message needs a 'role'")
+        stop = d.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        nvext = d.get("nvext") or {}
+        max_tokens = d.get("max_tokens", d.get("max_completion_tokens"))
+        return cls(
+            model=d["model"], messages=msgs, stream=bool(d.get("stream")),
+            max_tokens=max_tokens,
+            temperature=d.get("temperature"), top_p=d.get("top_p"),
+            top_k=d.get("top_k", nvext.get("top_k")),
+            min_p=d.get("min_p"),
+            frequency_penalty=d.get("frequency_penalty"),
+            presence_penalty=d.get("presence_penalty"),
+            seed=d.get("seed"), stop=list(stop),
+            ignore_eos=bool(d.get("ignore_eos",
+                                  nvext.get("ignore_eos", False))),
+            min_tokens=d.get("min_tokens"),
+            logprobs=bool(d.get("logprobs")), n=int(d.get("n", 1)),
+            raw=d,
+        )
+
+    def sampling_options(self) -> SamplingOptions:
+        s = SamplingOptions()
+        if self.temperature is not None:
+            s.temperature = float(self.temperature)
+        if self.top_p is not None:
+            s.top_p = float(self.top_p)
+        if self.top_k is not None:
+            s.top_k = int(self.top_k)
+        if self.min_p is not None:
+            s.min_p = float(self.min_p)
+        if self.frequency_penalty is not None:
+            s.frequency_penalty = float(self.frequency_penalty)
+        if self.presence_penalty is not None:
+            s.presence_penalty = float(self.presence_penalty)
+        if self.seed is not None:
+            s.seed = int(self.seed)
+        return s
+
+    def stop_conditions(self) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_tokens, stop=list(self.stop),
+            ignore_eos=self.ignore_eos, min_tokens=self.min_tokens or 0,
+        )
+
+
+@dataclass
+class CompletionRequest:
+    model: str
+    prompt: str | list[int]
+    stream: bool = False
+    max_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    min_p: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    seed: Optional[int] = None
+    stop: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    min_tokens: Optional[int] = None
+    echo: bool = False
+    n: int = 1
+    raw: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CompletionRequest":
+        _require(isinstance(d, dict), "request body must be a JSON object")
+        _require(bool(d.get("model")), "'model' is required")
+        prompt = d.get("prompt")
+        _require(prompt is not None, "'prompt' is required")
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+            _require(len(prompt) == 1, "batch prompts not supported yet")
+            prompt = prompt[0]
+        stop = d.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        nvext = d.get("nvext") or {}
+        return cls(
+            model=d["model"], prompt=prompt, stream=bool(d.get("stream")),
+            max_tokens=d.get("max_tokens"), temperature=d.get("temperature"),
+            top_p=d.get("top_p"), top_k=d.get("top_k", nvext.get("top_k")),
+            min_p=d.get("min_p"),
+            frequency_penalty=d.get("frequency_penalty"),
+            presence_penalty=d.get("presence_penalty"),
+            seed=d.get("seed"), stop=list(stop),
+            ignore_eos=bool(d.get("ignore_eos",
+                                  nvext.get("ignore_eos", False))),
+            min_tokens=d.get("min_tokens"),
+            echo=bool(d.get("echo")), n=int(d.get("n", 1)), raw=d,
+        )
+
+    sampling_options = ChatCompletionRequest.sampling_options
+
+    def stop_conditions(self) -> StopConditions:
+        return StopConditions(max_tokens=self.max_tokens,
+                              stop=list(self.stop),
+                              ignore_eos=self.ignore_eos,
+                              min_tokens=self.min_tokens or 0)
+
+
+# ---------------------------------------------------------------------------
+# Response builders
+# ---------------------------------------------------------------------------
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def new_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def chat_chunk(request_id: str, model: str, created: int,
+               content: Optional[str] = None, role: Optional[str] = None,
+               finish_reason: Optional[str] = None,
+               usage: Optional[dict] = None) -> dict:
+    delta: dict[str, Any] = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    out = {
+        "id": request_id, "object": "chat.completion.chunk",
+        "created": created, "model": model,
+        "choices": [{"index": 0, "delta": delta,
+                     "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def chat_completion(request_id: str, model: str, created: int, text: str,
+                    finish_reason: str, usage: dict) -> dict:
+    return {
+        "id": request_id, "object": "chat.completion", "created": created,
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason,
+        }],
+        "usage": usage,
+    }
+
+
+def completion_chunk(request_id: str, model: str, created: int, text: str,
+                     finish_reason: Optional[str] = None,
+                     usage: Optional[dict] = None) -> dict:
+    out = {
+        "id": request_id, "object": "text_completion", "created": created,
+        "model": model,
+        "choices": [{"index": 0, "text": text,
+                     "finish_reason": finish_reason, "logprobs": None}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def completion_response(request_id: str, model: str, created: int, text: str,
+                        finish_reason: str, usage: dict) -> dict:
+    return completion_chunk(request_id, model, created, text,
+                            finish_reason, usage)
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {"prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens}
+
+
+# ---------------------------------------------------------------------------
+# SSE codec (protocols/codec.rs)
+# ---------------------------------------------------------------------------
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_encode(payload: dict) -> bytes:
+    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() \
+        + b"\n\n"
+
+
+async def _aggregate_stream(chunks: AsyncIterator[dict], extract_text,
+                            build) -> dict:
+    """Shared delta→full fold (aggregator.rs); `extract_text` pulls the text
+    delta from one choice, `build` makes the final response."""
+    text_parts: list[str] = []
+    finish = "stop"
+    request_id, model, created, usage = "", "", _now(), None
+    async for c in chunks:
+        request_id = c.get("id", request_id)
+        model = c.get("model", model)
+        created = c.get("created", created)
+        if c.get("usage"):
+            usage = c["usage"]
+        for choice in c.get("choices", ()):
+            text = extract_text(choice)
+            if text:
+                text_parts.append(text)
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+    return build(request_id, model, created, "".join(text_parts), finish,
+                 usage or usage_dict(0, 0))
+
+
+async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
+    """Fold chat.completion.chunk stream into one chat.completion."""
+    return await _aggregate_stream(
+        chunks, lambda ch: ch.get("delta", {}).get("content"),
+        chat_completion)
+
+
+async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
+    """Fold text_completion chunk stream into one text_completion."""
+    return await _aggregate_stream(
+        chunks, lambda ch: ch.get("text"), completion_response)

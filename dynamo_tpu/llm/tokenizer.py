@@ -1,0 +1,165 @@
+"""Tokenizer abstraction + incremental detokenization.
+
+Reference: `lib/llm/src/tokenizers.rs` (HF `tokenizers` wrapper) and its
+`DecodeStream` — incremental decode that never emits half a multi-byte
+character: decode the whole tail, compare against previously emitted text,
+hold back while the suffix ends in an incomplete codepoint.
+
+Implementations:
+- `HfTokenizer` — wraps `transformers.AutoTokenizer` (real models).
+- `WordTokenizer` — whitespace vocab built on the fly; hermetic tests.
+- `ByteTokenizer` — UTF-8 bytes as ids 0..255; hermetic tests incl.
+  multi-byte boundary cases.
+
+The registry (`make_tokenizer`) is what ModelDeploymentCard references, so a
+frontend can construct the right tokenizer from a card without the engine's
+Python environment.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Protocol, Sequence
+
+REPLACEMENT_CHAR = "�"
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    @property
+    def eos_token_id(self) -> Optional[int]: ...
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids, get printable text deltas.
+
+    Sliding-window algorithm (the standard vLLM/`tokenizers` DecodeStream
+    scheme): keep two offsets into the generated ids — ``prefix`` (tokens
+    whose text is fully emitted) and ``read`` (tokens pending emission).
+    Each step decodes only ``ids[prefix:]`` (a bounded tail, not the whole
+    generation) and emits the part beyond the already-known prefix text, so
+    per-token cost is O(window), not O(total generated).
+    """
+
+    def __init__(self, tokenizer: Tokenizer,
+                 prompt_ids: Sequence[int] = ()) -> None:
+        self.tokenizer = tokenizer
+        self._gen: list[int] = []   # generated ids only (prompt not decoded)
+        self._prefix = 0            # ids[:_prefix] fully emitted
+        self._read = 0              # ids[_prefix:_read] = emitted prefix text
+        self._text_parts: list[str] = []
+
+    def step(self, token_id: int) -> str:
+        """Append one generated token; return newly printable text ('' if the
+        suffix is still an incomplete character)."""
+        self._gen.append(token_id)
+        prefix_text = self.tokenizer.decode(self._gen[self._prefix:self._read])
+        new_text = self.tokenizer.decode(self._gen[self._prefix:])
+        if new_text.endswith(REPLACEMENT_CHAR):
+            # mid-codepoint (byte-level BPE); wait for more tokens
+            return ""
+        delta = new_text[len(prefix_text):]
+        self._prefix = self._read
+        self._read = len(self._gen)
+        if delta:
+            self._text_parts.append(delta)
+        return delta
+
+    @property
+    def text(self) -> str:
+        return "".join(self._text_parts)
+
+
+class WordTokenizer:
+    """Whitespace tokenizer with a dynamically grown vocab (tests/demos).
+
+    Deterministic only within one process; fine for mock pipelines where the
+    same object encodes and decodes.
+    """
+
+    def __init__(self) -> None:
+        self._vocab: dict[str, int] = {"<eos>": 0}
+        self._rev: dict[int, str] = {0: "<eos>"}
+        self._lock = threading.Lock()
+
+    @property
+    def eos_token_id(self) -> int:
+        return 0
+
+    def _id(self, word: str) -> int:
+        with self._lock:
+            if word not in self._vocab:
+                i = len(self._vocab)
+                self._vocab[word] = i
+                self._rev[i] = word
+            return self._vocab[word]
+
+    def encode(self, text: str) -> list[int]:
+        return [self._id(w) for w in text.split()]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return " ".join(self._rev.get(i, "<unk>") for i in ids)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids (0..255); eos = 256."""
+
+    EOS = 256
+
+    @property
+    def eos_token_id(self) -> int:
+        return self.EOS
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HfTokenizer:
+    """transformers.AutoTokenizer wrapper (lazy import, heavyweight)."""
+
+    def __init__(self, path: str, **kwargs) -> None:
+        from transformers import AutoTokenizer  # local import: heavy
+
+        self._tok = AutoTokenizer.from_pretrained(path, **kwargs)
+        self.path = path
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._tok.eos_token_id
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict],
+                            add_generation_prompt: bool = True) -> str:
+        return self._tok.apply_chat_template(
+            messages, tokenize=False,
+            add_generation_prompt=add_generation_prompt)
+
+
+_REGISTRY = {}
+
+
+def make_tokenizer(kind: str, path: str = "") -> Tokenizer:
+    """Construct a tokenizer from ModelDeploymentCard fields."""
+    key = (kind, path)
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    if kind == "word":
+        tok: Tokenizer = WordTokenizer()
+    elif kind == "byte":
+        tok = ByteTokenizer()
+    elif kind == "hf":
+        tok = HfTokenizer(path)
+    else:
+        raise ValueError(f"unknown tokenizer kind {kind!r}")
+    _REGISTRY[key] = tok
+    return tok

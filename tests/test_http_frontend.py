@@ -1,0 +1,199 @@
+"""HTTP frontend e2e: discovery-driven serving against mock engine workers
+(reference: tests/frontend/test_completion_mocker_engine.py pattern)."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm.entrypoint import (
+    serve_engine,
+    start_frontend,
+    wire_engine_events,
+)
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import make_tokenizer
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+async def setup_stack(model="mock-model", router_mode="kv", workers=1):
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name=model, namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path=model, router_mode=router_mode,
+        migration_limit=1)
+    handles = []
+    engines = []
+    for i in range(workers):
+        ev_sink, m_sink = wire_engine_events(rt, card)
+        eng = MockEngine(
+            MockEngineConfig(block_size=card.kv_block_size, worker_id=i + 1,
+                             speedup=200.0, default_max_tokens=64),
+            event_sink=ev_sink, metrics_sink=m_sink)
+        engines.append(eng)
+        handles.append(await serve_engine(rt, eng, card, instance_id=i + 1))
+    frontend = await start_frontend(rt)
+    # wait until discovery built the pipeline
+    for _ in range(100):
+        if model in frontend.manager.model_names():
+            break
+        await asyncio.sleep(0.01)
+    return rt, frontend, handles, engines
+
+
+async def teardown_stack(rt, frontend, handles, engines):
+    await frontend.stop()
+    for h in handles:
+        await h.stop()
+    for e in engines:
+        await e.close()
+    await rt.close()
+
+
+async def test_models_and_health():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{fe.url}/v1/models") as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["data"][0]["id"] == "mock-model"
+            async with s.get(f"{fe.url}/health") as r:
+                assert r.status == 200
+            async with s.get(f"{fe.url}/metrics") as r:
+                assert "dynamo_http" in await r.text()
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_chat_completion_unary():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 8,
+                    "messages": [{"role": "user",
+                                  "content": "hello there friend"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "chat.completion"
+                msg = data["choices"][0]["message"]
+                assert msg["role"] == "assistant"
+                # mock echoes the templated prompt: the user words appear
+                assert "hello" in msg["content"]
+                assert data["usage"]["completion_tokens"] == 8
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_chat_completion_streaming_sse():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "stream": True, "max_tokens": 6,
+                    "messages": [{"role": "user", "content": "stream me"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "text/event-stream")
+                events = []
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[len("data: "):]
+                    if payload == "[DONE]":
+                        events.append("DONE")
+                        break
+                    events.append(json.loads(payload))
+                assert events[-1] == "DONE"
+                chunks = [e for e in events if isinstance(e, dict)]
+                assert chunks[0]["object"] == "chat.completion.chunk"
+                finish = [c["choices"][0].get("finish_reason")
+                          for c in chunks]
+                assert "length" in finish
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_completions_endpoint():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "prompt": "a b c",
+                    "max_tokens": 4}
+            async with s.post(f"{fe.url}/v1/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "text_completion"
+                assert "a b c" in data["choices"][0]["text"]
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_unknown_model_404():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "nope",
+                    "messages": [{"role": "user", "content": "x"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 404
+                err = await r.json()
+                assert err["error"]["type"] == "model_not_found"
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_bad_request_400():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json={"model": "mock-model",
+                                    "messages": []}) as r:
+                assert r.status == 400
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_model_removed_when_last_worker_dies():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        assert fe.manager.model_names() == ["mock-model"]
+        await hs[0].stop()
+        # unregister card: serve_engine attached it to the lease; explicit
+        # shutdown only removes the instance — delete the card directly to
+        # simulate lease drop in memory mode
+        await rt.store.delete(hs[0].card.store_key(rt.lease_id))
+        for _ in range(100):
+            if not fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        assert fe.manager.model_names() == []
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{fe.url}/health") as r:
+                assert r.status == 503
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_kv_routed_two_workers():
+    rt, fe, hs, es = await setup_stack(workers=2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            for i in range(6):
+                words = " ".join(f"w{i}x{j}" for j in range(40))
+                body = {"model": "mock-model", "max_tokens": 4,
+                        "messages": [{"role": "user", "content": words}]}
+                async with s.post(f"{fe.url}/v1/chat/completions",
+                                  json=body) as r:
+                    assert r.status == 200
+        assert es[0].kv.used_blocks + es[1].kv.used_blocks > 0
+    finally:
+        await teardown_stack(rt, fe, hs, es)

@@ -1,0 +1,169 @@
+"""Preprocessor → Backend → Migration pipeline tests with scripted and mock
+engines (reference: lib/llm/tests/preprocessor.rs, migration tests)."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.preprocessor import (
+    KIND_CHAT,
+    KIND_COMPLETION,
+    OpenAIPreprocessor,
+)
+from dynamo_tpu.llm.protocols_openai import (
+    ChatCompletionRequest,
+    OpenAIError,
+)
+from dynamo_tpu.llm.tokenizer import WordTokenizer
+from dynamo_tpu.protocols import FINISH_LENGTH
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import FnEngine, build_pipeline
+
+
+def make_echo_engine(tok):
+    """Engine that echoes the prompt token ids back, one per frame."""
+
+    async def gen(request, context):
+        for t in request["token_ids"]:
+            yield {"token_ids": [t]}
+        yield {"token_ids": [], "finish_reason": FINISH_LENGTH}
+
+    return FnEngine(gen)
+
+
+def chat_request(content, **kw):
+    body = {"model": "m", "messages": [{"role": "user", "content": content}]}
+    body.update(kw)
+    return {"_kind": KIND_CHAT, "body": body}
+
+
+async def collect(engine, request):
+    return [x async for x in engine.generate(request, Context())]
+
+
+async def test_chat_pipeline_end_to_end():
+    tok = WordTokenizer()
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok),
+        sink=make_echo_engine(tok))
+    chunks = await collect(pipe, chat_request("alpha beta gamma"))
+    # role chunk first, then content, then finish with usage
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks)
+    assert "alpha beta gamma" in text          # echo contains the prompt
+    last = chunks[-1]
+    assert last["choices"][0]["finish_reason"] == "length"
+    assert last["usage"]["completion_tokens"] > 0
+
+
+async def test_completion_pipeline():
+    tok = WordTokenizer()
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok),
+        sink=make_echo_engine(tok))
+    chunks = await collect(pipe, {
+        "_kind": KIND_COMPLETION,
+        "body": {"model": "m", "prompt": "one two three"}})
+    text = "".join(c["choices"][0]["text"] or "" for c in chunks)
+    assert "one two three" in text
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+async def test_stop_string_truncates_stream():
+    tok = WordTokenizer()
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok),
+        sink=make_echo_engine(tok))
+    chunks = await collect(pipe, chat_request(
+        "red green STOP blue", stop=["STOP"]))
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks)
+    assert "red green" in text
+    assert "STOP" not in text and "blue" not in text
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+async def test_eos_token_stops():
+    tok = WordTokenizer()
+
+    async def gen(request, context):
+        yield {"token_ids": [request["token_ids"][0]]}
+        yield {"token_ids": [tok.eos_token_id]}  # generated EOS
+        yield {"token_ids": [request["token_ids"][0]]}  # never reached
+
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok), sink=FnEngine(gen))
+    chunks = await collect(pipe, chat_request("hello world"))
+    assert chunks[-1]["choices"][0]["finish_reason"] == "eos"
+
+
+async def test_ignore_eos():
+    tok = WordTokenizer()
+
+    async def gen(request, context):
+        yield {"token_ids": [tok.eos_token_id]}
+        yield {"token_ids": [], "finish_reason": FINISH_LENGTH}
+
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok), sink=FnEngine(gen))
+    chunks = await collect(pipe, chat_request("x", ignore_eos=True))
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+async def test_context_length_rejection():
+    tok = WordTokenizer()
+    pre = OpenAIPreprocessor(tok, "m", context_length=2)
+    with pytest.raises(OpenAIError):
+        pre.preprocess_chat(ChatCompletionRequest.from_dict(
+            {"model": "m",
+             "messages": [{"role": "user", "content": "a b c d e f"}]}))
+
+
+async def test_sampling_options_mapping():
+    req = ChatCompletionRequest.from_dict({
+        "model": "m", "messages": [{"role": "user", "content": "x"}],
+        "temperature": 0.5, "top_p": 0.9, "seed": 7, "max_tokens": 3,
+        "stop": "DONE"})
+    s = req.sampling_options()
+    assert s.temperature == 0.5 and s.top_p == 0.9 and s.seed == 7
+    sc = req.stop_conditions()
+    assert sc.max_tokens == 3 and sc.stop == ["DONE"]
+
+
+async def test_migration_retries_on_stream_death():
+    tok = WordTokenizer()
+    attempts = []
+
+    async def flaky(request, context):
+        attempts.append(list(request["token_ids"]))
+        if len(attempts) == 1:
+            yield {"token_ids": [request["token_ids"][0]]}
+            raise ConnectionError("stream disconnected")
+        # survivor: finish the job
+        yield {"token_ids": [request["token_ids"][1]]}
+        yield {"token_ids": [], "finish_reason": FINISH_LENGTH}
+
+    mig = Migration(migration_limit=2)
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok), mig,
+        sink=FnEngine(flaky))
+    chunks = await collect(pipe, chat_request("aa bb"))
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    # second attempt's prompt includes the first attempt's generated token
+    assert len(attempts) == 2
+    assert attempts[1] == attempts[0] + [attempts[0][0]]
+
+
+async def test_migration_limit_exhausted():
+    tok = WordTokenizer()
+
+    async def always_dies(request, context):
+        yield {"token_ids": [request["token_ids"][0]]}
+        raise ConnectionError("stream disconnected")
+
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok),
+        Migration(migration_limit=1), sink=FnEngine(always_dies))
+    with pytest.raises(ConnectionError):
+        await collect(pipe, chat_request("aa bb"))

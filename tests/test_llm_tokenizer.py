@@ -1,0 +1,82 @@
+"""Tokenizer, DecodeStream, StopJail unit tests
+(reference: lib/llm/tests/tokenizers.rs, backend.rs stop handling)."""
+
+from dynamo_tpu.llm.backend import StopJail
+from dynamo_tpu.llm.tokenizer import (
+    ByteTokenizer,
+    DecodeStream,
+    WordTokenizer,
+    make_tokenizer,
+)
+
+
+def test_word_tokenizer_roundtrip():
+    tok = WordTokenizer()
+    ids = tok.encode("the quick brown fox")
+    assert tok.decode(ids) == "the quick brown fox"
+    assert tok.encode("the fox") == [ids[0], ids[3]]
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    s = "héllo ⚡"
+    assert tok.decode(tok.encode(s)) == s
+
+
+def test_decode_stream_multibyte_boundary():
+    tok = ByteTokenizer()
+    stream = DecodeStream(tok)
+    # ⚡ is 3 bytes: e2 9a a1 — partial prefixes must not emit garbage
+    data = "a⚡b".encode("utf-8")
+    outs = [stream.step(b) for b in data]
+    assert outs[0] == "a"
+    assert outs[1] == "" and outs[2] == ""      # mid-codepoint: held back
+    assert outs[3] == "⚡"
+    assert outs[4] == "b"
+    assert stream.text == "a⚡b"
+
+
+def test_decode_stream_ignores_prompt():
+    tok = WordTokenizer()
+    prompt = tok.encode("system prompt")
+    stream = DecodeStream(tok, prompt)
+    out = stream.step(tok.encode("reply")[0])
+    assert "prompt" not in out and "reply" in out
+
+
+def test_stop_jail_exact_match():
+    jail = StopJail(["STOP"])
+    emit, matched = jail.feed("hello STOP world")
+    assert emit == "hello " and matched == "STOP"
+
+
+def test_stop_jail_partial_held_then_released():
+    jail = StopJail(["STOP"])
+    emit, matched = jail.feed("abc ST")
+    assert emit == "abc " and matched is None     # "ST" held (prefix of STOP)
+    emit, matched = jail.feed("ZZ")
+    assert emit == "STZZ" and matched is None     # not a stop: released
+
+
+def test_stop_jail_partial_completed():
+    jail = StopJail(["STOP"])
+    emit1, m1 = jail.feed("xS")
+    emit2, m2 = jail.feed("TOPy")
+    assert emit1 == "x" and m1 is None
+    assert emit2 == "" and m2 == "STOP"
+
+
+def test_stop_jail_multiple_stops():
+    jail = StopJail(["\n\n", "END"])
+    emit, matched = jail.feed("line1\nmore EN")
+    assert matched is None
+    # held could be "\n...": check eventual match on END
+    emit2, matched2 = jail.feed("D tail")
+    assert matched2 == "END"
+    assert "END" not in (emit + emit2)
+
+
+def test_make_tokenizer_registry_caches():
+    t1 = make_tokenizer("word", "x")
+    t2 = make_tokenizer("word", "x")
+    assert t1 is t2

@@ -1,0 +1,2 @@
+"""The owned TPU serving engine (SURVEY.md §7 step 5): paged KV cache,
+continuous batching, pallas/XLA attention, pjit sharding."""

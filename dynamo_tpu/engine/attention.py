@@ -1,0 +1,138 @@
+"""Paged attention: XLA reference implementation + TPU pallas kernel path.
+
+The XLA path is pure lax ops, so it runs on any backend and partitions under
+`jit` + sharding annotations (tensor parallelism over the kv-head axis).
+The pallas path uses the TPU paged-attention kernel
+(`jax.experimental.pallas.ops.tpu.paged_attention`) for decode — the HBM-
+bandwidth-bound hot loop — and is selected automatically on TPU when the
+kv-head axis is not sharded (single-chip or per-shard invocation).
+
+Cache layout (both paths): K/V pages per layer are
+``(num_kv_heads, num_pages, page_size, head_dim)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# Global switch: "auto" | "xla" | "pallas". Trace-time constant.
+_impl = "auto"
+
+
+def set_attention_impl(impl: str) -> None:
+    global _impl
+    assert impl in ("auto", "xla", "pallas"), impl
+    _impl = impl
+
+
+def use_pallas() -> bool:
+    if _impl == "pallas":
+        return True
+    if _impl == "xla":
+        return False
+    # auto: honour an explicit jax_default_device override (tests pin CPU
+    # while the process-default backend stays TPU under the axon tunnel)
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return dev.platform == "tpu"
+    return jax.default_backend() == "tpu"
+
+
+def _repeat_kv(x: jax.Array, groups: int, axis: int) -> jax.Array:
+    """GQA: repeat kv heads to match query heads."""
+    return jnp.repeat(x, groups, axis=axis) if groups > 1 else x
+
+
+def prefill_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                      page_table: jax.Array, q_positions: jax.Array,
+                      seq_len: jax.Array, page_size: int) -> jax.Array:
+    """Causal attention for one sequence's prefill, reading K/V from pages.
+
+    q: (T, H, D); k_pages/v_pages: (KVH, N, P, D); page_table: (max_pages,);
+    q_positions: (T,) absolute positions; seq_len: scalar valid length.
+    Returns (T, H, D). Quadratic XLA attention — prefill is MXU-bound and
+    XLA fuses the mask/softmax; a flash-style pallas kernel is a later
+    optimisation for very long context (ring attention covers longer still).
+    """
+    kvh, _, p, d = k_pages.shape
+    h = q.shape[1]
+    groups = h // kvh
+    # Gather this sequence's K/V: (KVH, max_pages, P, D) -> (KVH, S, D)
+    k = k_pages[:, page_table].reshape(kvh, -1, d)
+    v = v_pages[:, page_table].reshape(kvh, -1, d)
+    k = _repeat_kv(k, groups, axis=0)                      # (H, S, D)
+    v = _repeat_kv(v, groups, axis=0)
+    scores = jnp.einsum("thd,hsd->hts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (d ** 0.5)
+    s_pos = jnp.arange(k.shape[1])
+    mask = (s_pos[None, :] <= q_positions[:, None]) \
+        & (s_pos[None, :] < seq_len)                       # (T, S)
+    scores = jnp.where(mask[None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hts,hsd->thd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, lengths: jax.Array,
+                           page_tables: jax.Array,
+                           page_size: int) -> jax.Array:
+    """One-token-per-sequence paged attention.
+
+    q: (B, H, D); k_pages/v_pages: (KVH, N, P, D); lengths: (B,) valid
+    lengths (0 = padding lane); page_tables: (B, max_pages). → (B, H, D).
+    """
+    # Mosaic tiling constraint: last dims must align to (8, 128) lanes —
+    # head_dim must be a multiple of 128 for the kernel's block specs.
+    if use_pallas() and q.shape[-1] % 128 == 0:
+        return _pallas_decode(q, k_pages, v_pages, lengths, page_tables)
+    return _xla_decode(q, k_pages, v_pages, lengths, page_tables)
+
+
+def _xla_decode(q, k_pages, v_pages, lengths, page_tables):
+    kvh, _, p, d = k_pages.shape
+    b, h, _ = q.shape
+    groups = h // kvh
+    # (KVH, B, max_pages, P, D) -> (B, KVH, S, D)
+    k = jnp.moveaxis(k_pages[:, page_tables], 0, 1).reshape(b, kvh, -1, d)
+    v = jnp.moveaxis(v_pages[:, page_tables], 0, 1).reshape(b, kvh, -1, d)
+    k = _repeat_kv(k, groups, axis=1)                      # (B, H, S, D)
+    v = _repeat_kv(v, groups, axis=1)
+    scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (d ** 0.5)
+    s_pos = jnp.arange(k.shape[2])
+    mask = s_pos[None, :] < lengths[:, None]               # (B, S)
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked (padding) lanes: softmax is uniform; output is garbage
+    # but the scheduler ignores padding lanes' logits.
+    out = jnp.einsum("bhs,bhsd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.cache
+def _pallas_paged_attention():
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as kernel,
+    )
+    return kernel
+
+
+def _pallas_decode(q, k_pages, v_pages, lengths, page_tables):
+    kernel = _pallas_paged_attention()
+    max_pages = page_tables.shape[1]
+    pages_per_block = 1
+    for cand in (8, 4, 2, 1):
+        if max_pages % cand == 0:
+            pages_per_block = cand
+            break
+    return kernel(
+        q, k_pages, v_pages, lengths.astype(jnp.int32),
+        page_tables.astype(jnp.int32),
+        pages_per_compute_block=pages_per_block,
+    )

@@ -1,0 +1,470 @@
+"""TpuEngine: the owned serving engine — continuous batching over jitted
+prefill/decode steps with a paged KV cache.
+
+This replaces the reference's engine workers (vLLM/SGLang/TRT-LLM,
+`components/src/dynamo/vllm/main.py`): same engine contract as MockEngine —
+`PreprocessedRequest` dicts in, `EngineOutput` dict stream out — so the
+entire serve path (frontend, router, disagg) is engine-agnostic.
+
+XLA discipline:
+- all device shapes are bucketed (prefill length → pow2 chunks, decode
+  batch → pow2) so each shape compiles once and is cached
+- cache buffers are donated through every step (in-place updates in HBM)
+- one device round-trip per decode iteration: decode_step + sample_tokens
+  run on device, only the sampled (B,) ints come back to host
+- scheduling, stop conditions, paging are host-side (Python), overlapped
+  with device work via a single background asyncio task
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+import jax
+import numpy as np
+
+from dynamo_tpu.engine.pages import PagePool
+from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    decode_multi_step,
+    init_cache,
+    init_params,
+    prefill_step,
+)
+from dynamo_tpu.protocols import (
+    FINISH_CANCELLED,
+    FINISH_ERROR,
+    FINISH_LENGTH,
+    FINISH_STOP,
+    EngineOutput,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    PreprocessedRequest,
+    WorkerStats,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+logger = logging.getLogger(__name__)
+
+
+def _next_pow2(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(b, hi)
+
+
+@dataclass
+class TpuEngineConfig:
+    model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
+    num_pages: int = 1024                 # incl. scratch page 0
+    max_batch_size: int = 8
+    prefill_chunk: int = 512              # max tokens per prefill call
+    min_prefill_bucket: int = 16
+    watermark: float = 0.95
+    worker_id: int = 0
+    dp_rank: int = 0
+    default_max_tokens: int = 1024
+    rng_seed: int = 0
+    # Fused decode steps per host round-trip: device samples each token and
+    # feeds it to the next step; the host syncs once per burst. Critical on
+    # TPU where a device→host sync stalls the pipeline.
+    decode_steps_per_sync: int = 8
+
+
+@dataclass
+class _Seq:
+    req: PreprocessedRequest
+    ctx: Context
+    queue: asyncio.Queue
+    token_seq: TokenBlockSequence         # tokens whose KV is on device
+    prompt: list[int]                     # effective prompt (incl. replays)
+    prompt_hashes: list[int] = field(default_factory=list)
+    pages: list[int] = field(default_factory=list)
+    cached_len: int = 0                   # prefix-cache hit length
+    next_token: int = -1                  # sampled, KV not yet written
+    generated: int = 0                    # sampled tokens streamed
+    prefilled: bool = False
+    finished: bool = False
+    seed: int = 0
+    arrival: int = 0
+
+    @property
+    def pos(self) -> int:
+        return len(self.token_seq)
+
+    @property
+    def max_tokens(self) -> int:
+        return self.req.stop.max_tokens or 0
+
+
+class TpuEngine:
+    """AsyncEngine over a JAX model with paged KV cache."""
+
+    def __init__(self, config: Optional[TpuEngineConfig] = None,
+                 params: Optional[dict] = None,
+                 event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
+                 metrics_sink: Optional[Callable[[ForwardPassMetrics], None]]
+                 = None) -> None:
+        self.config = config or TpuEngineConfig()
+        cfg = self.config
+        self.model_cfg = cfg.model
+        if params is None:
+            params = init_params(jax.random.PRNGKey(cfg.rng_seed),
+                                 self.model_cfg)
+        self.params = params
+        self.k_cache, self.v_cache = init_cache(self.model_cfg, cfg.num_pages)
+        self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
+                             cfg.worker_id, cfg.dp_rank, event_sink)
+        self.metrics_sink = metrics_sink
+        self._waiting: list[_Seq] = []
+        self._running: list[_Seq] = []
+        self._arrivals = 0
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._stopped = False
+        self._rng = np.random.RandomState(cfg.rng_seed)
+
+    # -- engine contract ----------------------------------------------------
+
+    async def generate(self, request: dict, context: Context
+                       ) -> AsyncIterator[dict]:
+        req = PreprocessedRequest.from_dict(request)
+        if req.stop.max_tokens is None:
+            req.stop.max_tokens = self.config.default_max_tokens
+        cfg, mcfg = self.config, self.model_cfg
+        if self._stopped:
+            yield EngineOutput(
+                token_ids=[], finish_reason=FINISH_ERROR,
+                extra={"error": "engine closed"}).to_dict()
+            return
+        if not req.token_ids:
+            yield EngineOutput(
+                token_ids=[], finish_reason=FINISH_ERROR,
+                extra={"error": "empty prompt"}).to_dict()
+            return
+        # decode bursts may overshoot by up to decode_steps_per_sync tokens
+        max_len = (mcfg.page_size * mcfg.max_pages_per_seq
+                   - cfg.decode_steps_per_sync)
+        need_pages = (len(req.token_ids) + req.stop.max_tokens
+                      + cfg.decode_steps_per_sync
+                      + mcfg.page_size - 1) // mcfg.page_size
+        if len(req.token_ids) + req.stop.max_tokens > max_len \
+                or need_pages > self.pool.capacity:
+            yield EngineOutput(
+                token_ids=[], finish_reason=FINISH_ERROR,
+                extra={"error": f"prompt+max_tokens exceeds capacity "
+                                f"(context {max_len}, "
+                                f"pages {self.pool.capacity})"}).to_dict()
+            return
+        seq = _Seq(
+            req=req, ctx=context, queue=asyncio.Queue(),
+            token_seq=TokenBlockSequence(mcfg.page_size),
+            prompt=list(req.token_ids),
+            prompt_hashes=TokenBlockSequence(
+                mcfg.page_size, req.token_ids).seq_hashes(),
+            seed=(req.sampling.seed if req.sampling.seed is not None
+                  else int(self._rng.randint(0, 2**31 - 1))),
+            arrival=self._arrivals,
+        )
+        self._arrivals += 1
+        self._ensure_loop()
+        self._waiting.append(seq)
+        self._wake.set()
+        while True:
+            out = await seq.queue.get()
+            if out is None:
+                return
+            yield out
+            if out.get("finish_reason"):
+                return
+
+    async def close(self) -> None:
+        self._stopped = True
+        self._wake.set()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+        # unblock any generate() caller still awaiting its queue
+        for s in self._running + self._waiting:
+            s.queue.put_nowait(EngineOutput(
+                token_ids=[], finish_reason=FINISH_CANCELLED).to_dict())
+            s.queue.put_nowait(None)
+            self.pool.release_sequence(s.pages)
+        self._running.clear()
+        self._waiting.clear()
+
+    # -- scheduler loop -----------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._scheduler_loop())
+
+    async def _scheduler_loop(self) -> None:
+        while not self._stopped:
+            if not self._waiting and not self._running:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            try:
+                self._admit()
+                progressed = await self._prefill_pending()
+                progressed |= await self._decode_iter()
+                self._publish_metrics()
+                if not progressed:
+                    await asyncio.sleep(0.001)
+            except Exception:
+                logger.exception("engine scheduler iteration failed")
+                self._fail_all()
+
+    def _fail_all(self) -> None:
+        for s in self._running + self._waiting:
+            s.queue.put_nowait(EngineOutput(
+                token_ids=[], finish_reason=FINISH_ERROR,
+                extra={"error": "engine step failed"}).to_dict())
+            s.queue.put_nowait(None)
+            self.pool.release_sequence(s.pages)
+        self._running.clear()
+        self._waiting.clear()
+
+    # -- admission ----------------------------------------------------------
+
+    def _admit(self) -> None:
+        cfg = self.config
+        while self._waiting and len(self._running) < cfg.max_batch_size:
+            cand = self._waiting[0]
+            if cand.ctx.is_cancelled():
+                self._waiting.pop(0)
+                self._finish(cand, FINISH_CANCELLED)
+                continue
+            hashes = cand.prompt_hashes
+            need_pages = (len(cand.prompt) + self.model_cfg.page_size - 1) \
+                // self.model_cfg.page_size
+            if (self.pool.active_pages + need_pages
+                    > cfg.watermark * self.pool.capacity and self._running):
+                break
+            alloc = self.pool.allocate_sequence(hashes, len(cand.prompt))
+            if alloc is None:
+                break
+            cand.pages, cand.cached_len = alloc
+            self._waiting.pop(0)
+            self._running.append(cand)
+
+    # -- prefill ------------------------------------------------------------
+
+    async def _prefill_pending(self) -> bool:
+        """Prefill every admitted-but-unprefilled sequence, then sample all
+        their first tokens in ONE device call + ONE host sync. The prefill
+        dispatches queue back-to-back on the device; only the final sampled
+        batch crosses back to the host."""
+        pending = [s for s in self._running if not s.prefilled]
+        if not pending:
+            return False
+        mcfg, cfg = self.model_cfg, self.config
+
+        def prefill_all():
+            last_logits = []
+            for seq in pending:
+                page_table = np.zeros(mcfg.max_pages_per_seq, dtype=np.int32)
+                page_table[:len(seq.pages)] = seq.pages
+                pt_dev = jax.numpy.asarray(page_table)
+                offset = seq.cached_len
+                logits = None
+                while offset < len(seq.prompt):
+                    chunk = seq.prompt[offset:offset + cfg.prefill_chunk]
+                    bucket = _next_pow2(len(chunk), cfg.min_prefill_bucket,
+                                        cfg.prefill_chunk)
+                    padded = np.zeros(bucket, dtype=np.int32)
+                    padded[:len(chunk)] = chunk
+                    logits, self.k_cache, self.v_cache = prefill_step(
+                        self.params, self.k_cache, self.v_cache,
+                        jax.numpy.asarray(padded), pt_dev,
+                        np.int32(offset), np.int32(offset + len(chunk)),
+                        mcfg)
+                    offset += len(chunk)
+                last_logits.append(logits)
+            # pad to a fixed width so sampling compiles exactly once
+            width = cfg.max_batch_size
+            while len(last_logits) < width:
+                last_logits.append(last_logits[0])
+
+            def arr(fn, dtype):
+                vals = [fn(s) for s in pending]
+                vals += [vals[0]] * (width - len(pending))
+                return np.asarray(vals, dtype=dtype)
+
+            sampled = sample_tokens(
+                jax.numpy.stack(last_logits),
+                arr(lambda s: s.seed, np.uint32),
+                arr(lambda s: s.generated, np.uint32),
+                arr(lambda s: s.req.sampling.temperature, np.float32),
+                arr(lambda s: s.req.sampling.top_p, np.float32),
+                arr(lambda s: s.req.sampling.top_k, np.int32))
+            return np.asarray(sampled)                    # ONE host sync
+
+        tokens = await asyncio.to_thread(prefill_all)
+        for seq, token in zip(pending, tokens):
+            # token_seq mirrors what prefill wrote to the device
+            seq.token_seq = TokenBlockSequence(mcfg.page_size, seq.prompt)
+            for block in seq.token_seq.blocks[seq.cached_len
+                                              // mcfg.page_size:]:
+                self.pool.register_page(
+                    seq.pages[block.block_index], block.seq_hash,
+                    block.local_hash, block.parent_seq_hash)
+            seq.prefilled = True
+            self._emit_token(seq, int(token))
+        return True
+
+    # -- decode -------------------------------------------------------------
+
+    async def _decode_iter(self) -> bool:
+        runnable = [s for s in self._running if s.prefilled]
+        if not runnable:
+            return False
+        mcfg, cfg = self.model_cfg, self.config
+        # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
+        # compilation for the engine's lifetime. Underfull lanes/steps waste
+        # a little compute; recompiles (tens of seconds) waste far more.
+        k_steps = cfg.decode_steps_per_sync
+        # every runnable seq needs pages covering pos .. pos+k_steps-1
+        for s in list(runnable):
+            if s.ctx.is_cancelled():
+                self._finish(s, FINISH_CANCELLED)
+                runnable.remove(s)
+                continue
+            need = (s.pos + k_steps - 1) // mcfg.page_size + 1
+            while len(s.pages) < need:
+                pid = self.pool.allocate_page()
+                if pid is None:
+                    victim = self._pick_victim(exclude=s)
+                    if victim is not None and victim in runnable:
+                        runnable.remove(victim)
+                    pid = self.pool.allocate_page()
+                if pid is None:
+                    self._preempt(s)
+                    runnable.remove(s)
+                    break
+                s.pages.append(pid)
+        if not runnable:
+            return False
+        b = cfg.max_batch_size
+        batch = runnable[:b]
+        max_pages = mcfg.max_pages_per_seq
+        tokens = np.zeros(b, dtype=np.int32)
+        positions = np.zeros(b, dtype=np.int32)
+        page_tables = np.zeros((b, max_pages), dtype=np.int32)
+        valid = np.zeros(b, dtype=bool)
+        seeds = np.zeros(b, dtype=np.uint32)
+        steps = np.zeros(b, dtype=np.uint32)
+        temps = np.zeros(b, dtype=np.float32)
+        top_ps = np.ones(b, dtype=np.float32)
+        top_ks = np.zeros(b, dtype=np.int32)
+        for i, s in enumerate(batch):
+            tokens[i] = s.next_token
+            positions[i] = s.pos
+            page_tables[i, :len(s.pages)] = s.pages
+            valid[i] = True
+            seeds[i] = s.seed
+            steps[i] = s.generated
+            temps[i] = s.req.sampling.temperature
+            top_ps[i] = s.req.sampling.top_p
+            top_ks[i] = s.req.sampling.top_k
+
+        def run_burst():
+            sampled, kc, vc = decode_multi_step(
+                self.params, self.k_cache, self.v_cache,
+                jax.numpy.asarray(tokens), jax.numpy.asarray(positions),
+                jax.numpy.asarray(page_tables), jax.numpy.asarray(valid),
+                jax.numpy.asarray(seeds), jax.numpy.asarray(steps),
+                jax.numpy.asarray(temps), jax.numpy.asarray(top_ps),
+                jax.numpy.asarray(top_ks), mcfg, k_steps)
+            return np.asarray(sampled), kc, vc            # ONE host sync
+
+        sampled, self.k_cache, self.v_cache = \
+            await asyncio.to_thread(run_burst)
+        for i, s in enumerate(batch):
+            for k in range(k_steps):
+                if s.finished or s not in self._running:
+                    break  # overshoot tokens discarded; pages released
+                # the step-k input token's KV is now on device
+                block = s.token_seq.append(s.next_token)
+                if block is not None:
+                    self.pool.register_page(
+                        s.pages[block.block_index], block.seq_hash,
+                        block.local_hash, block.parent_seq_hash)
+                self._emit_token(s, int(sampled[k, i]))
+        return True
+
+    # -- lifecycle helpers --------------------------------------------------
+
+    def _emit_token(self, seq: _Seq, token: int) -> None:
+        seq.next_token = token
+        seq.generated += 1
+        finish = None
+        if seq.req.stop.stop_token_ids and \
+                token in seq.req.stop.stop_token_ids and \
+                seq.generated >= seq.req.stop.min_tokens:
+            finish = FINISH_STOP
+        elif seq.generated >= seq.max_tokens:
+            finish = FINISH_LENGTH
+        seq.queue.put_nowait(EngineOutput(
+            token_ids=[token], finish_reason=finish).to_dict())
+        if finish is not None:
+            self._finish(seq, finish, emit=False)
+
+    def _finish(self, seq: _Seq, reason: str, emit: bool = True) -> None:
+        seq.finished = True
+        if seq in self._running:
+            self._running.remove(seq)
+        if seq in self._waiting:
+            self._waiting.remove(seq)
+        self.pool.release_sequence(seq.pages)
+        seq.pages = []
+        if emit:
+            seq.queue.put_nowait(EngineOutput(
+                token_ids=[], finish_reason=reason).to_dict())
+        seq.queue.put_nowait(None)
+
+    def _pick_victim(self, exclude: _Seq) -> Optional[_Seq]:
+        cands = [s for s in self._running if s is not exclude and s.prefilled]
+        if not cands:
+            return None
+        victim = max(cands, key=lambda s: s.arrival)
+        self._preempt(victim)
+        return victim
+
+    def _preempt(self, seq: _Seq) -> None:
+        """Release pages, fold generated tokens into the prompt, requeue at
+        the head (re-prefill later; mocker/scheduler.rs preemption)."""
+        if seq in self._running:
+            self._running.remove(seq)
+        self.pool.release_sequence(seq.pages)
+        seq.pages = []
+        seq.prompt = seq.token_seq.tokens + [seq.next_token]
+        seq.prompt_hashes = TokenBlockSequence(
+            self.model_cfg.page_size, seq.prompt).seq_hashes()
+        seq.token_seq = TokenBlockSequence(self.model_cfg.page_size)
+        seq.cached_len = 0
+        seq.prefilled = False
+        self._waiting.insert(0, seq)
+
+    def _publish_metrics(self) -> None:
+        if self.metrics_sink is None:
+            return
+        self.metrics_sink(ForwardPassMetrics(
+            worker_id=self.config.worker_id, dp_rank=self.config.dp_rank,
+            worker_stats=WorkerStats(
+                request_active_slots=len(self._running),
+                request_total_slots=self.config.max_batch_size,
+                num_requests_waiting=len(self._waiting)),
+            kv_stats=KvStats(
+                kv_active_blocks=self.pool.active_pages,
+                kv_total_blocks=self.pool.capacity,
+                hbm_cache_usage=self.pool.usage()),
+        ))

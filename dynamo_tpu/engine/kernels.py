@@ -1,0 +1,75 @@
+"""Hand-written pallas TPU kernels for ops XLA handles poorly.
+
+`paged_kv_write`: scatter one token's K/V per sequence into the paged cache.
+XLA lowers this scatter to ~23ms/step on a 1B model (measured, v5e) —
+dominating decode. The pallas version updates only the touched pages via
+block DMA: load page block, overwrite one row, store back (~0.1ms).
+
+Layout matches the paged-attention kernel: cache (KVH, N, P, D).
+Constraints: P % 8 == 0 and D % 128 == 0 (mosaic tiling); callers fall
+back to the XLA scatter otherwise (models/llama.py `_write_pages`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def _pltpu():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl, pltpu
+
+
+def kv_write_supported(page_size: int, head_dim: int) -> bool:
+    return page_size % 8 == 0 and head_dim % 128 == 0
+
+
+def paged_kv_write(kc: jax.Array, vc: jax.Array, k: jax.Array, v: jax.Array,
+                   page_ids: jax.Array, offsets: jax.Array
+                   ) -> tuple[jax.Array, jax.Array]:
+    """kc/vc: (KVH, N, P, D); k/v: (B, KVH, D); page_ids/offsets: (B,).
+
+    Writes k[b]/v[b] into page page_ids[b] at row offsets[b]. Grid is
+    sequential on TPU, so duplicate page_ids (scratch page 0 for padding
+    lanes) are safe — last write wins.
+    """
+    pl, pltpu = _pltpu()
+    kvh, n_pages, p, d = kc.shape
+    b = k.shape[0]
+
+    def kernel(pid_ref, off_ref, k_ref, v_ref, kc_in, vc_in,
+               kc_out, vc_out):
+        # Mosaic can't do sublane-unaligned dynamic stores; blend the new
+        # row into the page block with a mask instead (pure vector ops on
+        # the one touched page — only that block is DMA'd in/out).
+        i = pl.program_id(0)
+        off = off_ref[i]
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, p, 1), 2)
+        mask = row == off
+        kc_out[...] = jnp.where(mask, k_ref[0][:, None, None, :], kc_in[...])
+        vc_out[...] = jnp.where(mask, v_ref[0][:, None, None, :], vc_in[...])
+
+    page_block = pl.BlockSpec(
+        (kvh, 1, p, d),
+        lambda i, pid_ref, off_ref: (0, pid_ref[i], 0, 0))
+    row_block = pl.BlockSpec((1, kvh, d),
+                             lambda i, pid_ref, off_ref: (i, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[row_block, row_block, page_block, page_block],
+        out_specs=[page_block, page_block],
+    )
+    out_kc, out_vc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(kc.shape, kc.dtype),
+                   jax.ShapeDtypeStruct(vc.shape, vc.dtype)],
+        input_output_aliases={4: 0, 5: 1},  # kc/vc updated in place
+    )(page_ids.astype(jnp.int32), offsets.astype(jnp.int32), k, v, kc, vc)
+    return out_kc, out_vc

@@ -1,0 +1,185 @@
+"""Host-side page allocator for the device KV cache: refcounted pages,
+prefix-cache reuse by sequence hash, LRU eviction, KV event emission.
+
+This is the engine-side analog of vLLM's block manager that the reference
+orchestrates around (and of `lib/llm/src/mocker/kv_manager.rs` which fakes
+it). Pages hold `page_size` tokens of K/V per layer on device; this class
+only tracks ownership — the device arrays are indexed by the page ids it
+hands out.
+
+Invariants:
+- page 0 is scratch (padding lanes scatter there; never allocated)
+- a page is *registered* once it holds a complete block and is then
+  immutable and shareable (prefix reuse increments its refcount)
+- refcount 0 + registered ⇒ inactive LRU, evictable; refcount 0 +
+  unregistered ⇒ freed immediately
+- KvCacheEvents (stored/removed) are emitted exactly at register/evict,
+  so the router's view mirrors reality (publisher.rs analog)
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.protocols import (
+    KV_REMOVED,
+    KV_STORED,
+    KvCacheEvent,
+    StoredBlock,
+)
+
+EventSink = Callable[[KvCacheEvent], None]
+
+
+@dataclass
+class _Page:
+    page_id: int
+    refcount: int = 0
+    seq_hash: Optional[int] = None       # set when registered
+    local_hash: Optional[int] = None
+    parent_seq_hash: Optional[int] = None
+
+
+class PagePool:
+    def __init__(self, num_pages: int, page_size: int, worker_id: int = 0,
+                 dp_rank: int = 0,
+                 event_sink: Optional[EventSink] = None) -> None:
+        # page 0 reserved as scratch
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.worker_id = worker_id
+        self.dp_rank = dp_rank
+        self.event_sink = event_sink
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self._pages: dict[int, _Page] = {}
+        self._registered: dict[int, int] = {}       # seq_hash -> page_id
+        self._inactive: OrderedDict[int, None] = OrderedDict()  # LRU page ids
+        self._event_ids = itertools.count(1)
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def active_pages(self) -> int:
+        return self.capacity - len(self._free) - len(self._inactive)
+
+    @property
+    def used_pages(self) -> int:
+        return self.capacity - len(self._free)
+
+    def usage(self) -> float:
+        return self.active_pages / self.capacity if self.capacity else 1.0
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) + len(self._inactive) >= n
+
+    # -- allocation ---------------------------------------------------------
+
+    def match_prefix(self, seq_hashes: list[int]) -> list[int]:
+        """Longest chain of registered pages covering the leading blocks."""
+        out = []
+        for h in seq_hashes:
+            pid = self._registered.get(h)
+            if pid is None:
+                break
+            out.append(pid)
+        return out
+
+    def acquire(self, page_id: int) -> None:
+        page = self._pages[page_id]
+        if page.refcount == 0:
+            self._inactive.pop(page_id, None)
+        page.refcount += 1
+
+    def allocate_page(self) -> Optional[int]:
+        """One fresh (writable) page; evicts LRU inactive if needed."""
+        if not self._free and not self._evict_one():
+            return None
+        pid = self._free.pop()
+        self._pages[pid] = _Page(page_id=pid, refcount=1)
+        return pid
+
+    def allocate_sequence(self, seq_hashes: list[int], total_len: int
+                          ) -> Optional[tuple[list[int], int]]:
+        """Pages for a new sequence of `total_len` tokens whose complete
+        blocks hash to `seq_hashes`. Returns (page_ids, cached_len) or None
+        if capacity is insufficient. Guarantees cached_len < total_len so
+        at least one token is computed (its logits are needed)."""
+        matched = self.match_prefix(seq_hashes)
+        if len(matched) * self.page_size >= total_len:
+            matched = matched[:(total_len - 1) // self.page_size]
+        need_pages = (total_len + self.page_size - 1) // self.page_size
+        fresh_needed = need_pages - len(matched)
+        if len(self._free) + len(self._inactive) < fresh_needed:
+            return None
+        for pid in matched:
+            self.acquire(pid)
+        pages = list(matched)
+        for _ in range(fresh_needed):
+            pid = self.allocate_page()
+            if pid is None:  # raced our own estimate (shouldn't happen)
+                self.release_sequence(pages)
+                return None
+            pages.append(pid)
+        return pages, len(matched) * self.page_size
+
+    # -- registration / release --------------------------------------------
+
+    def register_page(self, page_id: int, seq_hash: int, local_hash: int,
+                      parent_seq_hash: int) -> None:
+        """Mark a page complete+immutable; publish the stored event."""
+        page = self._pages[page_id]
+        if page.seq_hash is not None:
+            return
+        page.seq_hash = seq_hash
+        page.local_hash = local_hash
+        page.parent_seq_hash = parent_seq_hash
+        # first writer wins; duplicate content on another page stays
+        # unregistered-for-reuse but still evictable via its own entry
+        self._registered.setdefault(seq_hash, page_id)
+        if self.event_sink is not None:
+            self.event_sink(KvCacheEvent(
+                kind=KV_STORED, worker_id=self.worker_id,
+                dp_rank=self.dp_rank, event_id=next(self._event_ids),
+                parent_seq_hash=parent_seq_hash,
+                blocks=[StoredBlock(seq_hash, local_hash)]))
+
+    def release_sequence(self, page_ids: list[int]) -> None:
+        for pid in page_ids:
+            page = self._pages.get(pid)
+            if page is None:
+                continue
+            page.refcount -= 1
+            if page.refcount > 0:
+                continue
+            if page.seq_hash is not None \
+                    and self._registered.get(page.seq_hash) == pid:
+                self._inactive[pid] = None       # reusable, evict-last
+                self._inactive.move_to_end(pid)
+            else:
+                self._discard(page)
+
+    def _discard(self, page: _Page) -> None:
+        self._pages.pop(page.page_id, None)
+        self._free.append(page.page_id)
+
+    def _evict_one(self) -> bool:
+        if not self._inactive:
+            return False
+        pid, _ = self._inactive.popitem(last=False)   # LRU
+        page = self._pages[pid]
+        if page.seq_hash is not None:
+            self._registered.pop(page.seq_hash, None)
+            if self.event_sink is not None:
+                self.event_sink(KvCacheEvent(
+                    kind=KV_REMOVED, worker_id=self.worker_id,
+                    dp_rank=self.dp_rank, event_id=next(self._event_ids),
+                    seq_hashes=[page.seq_hash]))
+        self._discard(page)
+        return True

@@ -1,0 +1,61 @@
+"""Batched token sampling, jitted: greedy / temperature / top-k / top-p.
+
+All knobs are per-request arrays so one compiled function serves a mixed
+batch (no recompile per sampling config — XLA static-shape friendly).
+Randomness is derived *inside* the jit from (seed, step) pairs, so the
+scheduler passes plain integers and replay/migration is deterministic.
+
+TPU note: full-vocab `sort` costs tens of ms; instead `lax.top_k` keeps the
+MAX_CANDIDATES highest logits (cheap on TPU) and top-k/top-p/sampling run
+on that truncated set. User top_k is clipped to MAX_CANDIDATES; top-p mass
+is computed over the candidates (the tail beyond 64 candidates carries
+negligible probability for real models). Greedy uses a full argmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+MAX_CANDIDATES = 64
+
+
+def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
+                         steps: jax.Array, temperature: jax.Array,
+                         top_p: jax.Array, top_k: jax.Array) -> jax.Array:
+    """logits: (B, V) fp32; seeds/steps: (B,) u32/i32; temperature/top_p:
+    (B,) f32; top_k: (B,) i32 (0 = disabled). temperature <= 0 ⇒ greedy.
+    Returns (B,) i32 tokens. Traceable (used inside fused decode loops)."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    c = min(MAX_CANDIDATES, v)
+    cand_logits, cand_idx = lax.top_k(logits, c)           # (B, C) sorted desc
+
+    # user top-k within the candidate set
+    k_eff = jnp.clip(jnp.where(top_k > 0, top_k, c), 1, c)
+    pos = jnp.arange(c)
+    masked = jnp.where(pos[None, :] < k_eff[:, None], cand_logits, _NEG_INF)
+
+    # top-p: smallest prefix of the sorted candidates covering the mass.
+    # `<=` (not `<`) so top_p=0.0 still keeps index 0 (near-greedy), never
+    # an all-masked row that categorical() would sample uniformly from.
+    t = jnp.where(temperature > 0, temperature, 1.0)
+    probs = jax.nn.softmax(masked / t[:, None], axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) <= top_p[:, None]                 # always keeps [0]
+    masked = jnp.where(keep, masked, _NEG_INF)
+
+    def sample_one(seed, step, lg, tt):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.categorical(key, lg / tt)
+
+    choice = jax.vmap(sample_one)(
+        seeds.astype(jnp.uint32), steps.astype(jnp.uint32), masked, t)
+    sampled = jnp.take_along_axis(cand_idx, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+sample_tokens = jax.jit(sample_tokens_traced)

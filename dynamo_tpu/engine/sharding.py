@@ -1,0 +1,74 @@
+"""Mesh + sharding layout for the serving engine.
+
+The scaling-book recipe: pick a mesh, annotate shardings on params/cache,
+let XLA insert the collectives. Axes:
+- "dp": replica axis — engine-level data parallelism (each dp slice is an
+  independently-addressable worker rank, the reference's dp_rank routing,
+  SURVEY.md §2.10)
+- "tp": tensor parallelism — attention heads / ffn hidden sharded; XLA
+  inserts the all-reduce after o-proj and down-proj (megatron pattern)
+
+Params layout (models/llama.py init_params):
+  wq/wk/wv:   (L, E, Heads*D)  → shard out dim over tp
+  wo:         (L, H*D, E)      → shard in dim over tp  (psum after)
+  w_gate/up:  (L, E, F)        → shard F over tp
+  w_down:     (L, F, E)        → shard F over tp       (psum after)
+  embed:      (V, E)           → shard V over tp (gathered on lookup)
+  lm_head:    (E, V)           → shard V over tp
+KV cache (L, KVH, N, P, D)     → shard KVH over tp
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int = 1, tp: int = 1,
+              devices: Optional[list] = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = dp * tp
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    arr = np.asarray(devices[:n]).reshape(dp, tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def param_specs() -> dict:
+    """PartitionSpecs matching init_params' pytree structure."""
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def cache_spec() -> P:
+    # (L, KVH, N, P, D): kv heads over tp
+    return P(None, "tp", None, None, None)
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    specs = param_specs()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, dict))
+
+
+def shard_cache(cache, mesh: Mesh):
+    ns = NamedSharding(mesh, cache_spec())
+    return jax.tree.map(lambda x: jax.device_put(x, ns), cache)

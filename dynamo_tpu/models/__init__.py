@@ -1,0 +1,6 @@
+"""Model zoo for the TPU engine (we own the engine; the reference delegates
+to vLLM/SGLang/TRT-LLM — SURVEY.md §7 step 5)."""
+
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+__all__ = ["LlamaConfig", "init_params"]

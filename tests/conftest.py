@@ -1,7 +1,11 @@
 """Test harness config.
 
-- Forces JAX onto CPU with 8 virtual devices so multi-chip sharding tests
-  run anywhere (the driver separately dry-runs the multichip path).
+- Pins JAX to CPU with 8 virtual devices so multi-chip sharding tests run
+  anywhere (the driver separately dry-runs the multichip path). NOTE: in
+  this image a sitecustomize imports jax at interpreter start and registers
+  the TPU tunnel as the default backend — JAX_PLATFORMS set here is too
+  late. The CPU client *is* still created lazily, so we set XLA_FLAGS
+  before first use and pin `jax_default_device` to CPU instead.
 - Runs `async def` tests on a fresh event loop (no pytest-asyncio in image).
 """
 
@@ -9,15 +13,23 @@ import asyncio
 import inspect
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # honoured when axon is absent
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import pytest
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def cpu_mesh_devices():
+    return jax.devices("cpu")
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -1,0 +1,114 @@
+"""PagePool unit tests: refcounts, prefix reuse, LRU eviction, KV events."""
+
+from dynamo_tpu.engine.pages import PagePool
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+def hashes(tokens, bs=4):
+    return TokenBlockSequence(bs, tokens).seq_hashes()
+
+
+def collect_events():
+    events = []
+    return events, events.append
+
+
+def test_scratch_page_reserved():
+    pool = PagePool(num_pages=8, page_size=4)
+    pages = set()
+    while True:
+        p = pool.allocate_page()
+        if p is None:
+            break
+        pages.add(p)
+    assert 0 not in pages
+    assert len(pages) == 7
+
+
+def test_allocate_sequence_and_prefix_reuse():
+    events, sink = collect_events()
+    pool = PagePool(num_pages=16, page_size=4, event_sink=sink)
+    toks = list(range(10))           # 2 complete blocks + partial
+    hs = hashes(toks)
+    alloc = pool.allocate_sequence(hs, len(toks))
+    assert alloc is not None
+    pages, cached = alloc
+    assert cached == 0 and len(pages) == 3
+    # register the two complete blocks
+    seq = TokenBlockSequence(4, toks)
+    for b in seq.blocks:
+        pool.register_page(pages[b.block_index], b.seq_hash, b.local_hash,
+                           b.parent_seq_hash)
+    assert len([e for e in events if e.kind == "stored"]) == 2
+
+    # a second sequence with the same prefix reuses the registered pages
+    alloc2 = pool.allocate_sequence(hs, len(toks))
+    pages2, cached2 = alloc2
+    assert cached2 == 8
+    assert pages2[:2] == pages[:2]
+    assert pages2[2] != pages[2]     # partial page is never shared
+
+
+def test_full_prefix_hit_capped():
+    pool = PagePool(num_pages=16, page_size=4)
+    toks = list(range(8))            # exactly 2 blocks
+    hs = hashes(toks)
+    pages, _ = pool.allocate_sequence(hs, len(toks))
+    seq = TokenBlockSequence(4, toks)
+    for b in seq.blocks:
+        pool.register_page(pages[b.block_index], b.seq_hash, b.local_hash,
+                           b.parent_seq_hash)
+    pool.release_sequence(pages)
+    # identical prompt: must still compute >= 1 token
+    pages2, cached2 = pool.allocate_sequence(hs, len(toks))
+    assert cached2 == 4 and len(pages2) == 2
+
+
+def test_release_and_lru_eviction_events():
+    events, sink = collect_events()
+    pool = PagePool(num_pages=4, page_size=4, event_sink=sink)  # 3 usable
+    toks_a = list(range(4))
+    hs_a = hashes(toks_a)
+    pages_a, _ = pool.allocate_sequence(hs_a, 4)
+    seq_a = TokenBlockSequence(4, toks_a)
+    pool.register_page(pages_a[0], seq_a.blocks[0].seq_hash,
+                       seq_a.blocks[0].local_hash,
+                       seq_a.blocks[0].parent_seq_hash)
+    pool.release_sequence(pages_a)       # -> inactive, still registered
+    assert pool.active_pages == 0 and pool.used_pages == 1
+
+    # fill remaining capacity; eviction must kick in and emit removed
+    toks_b = list(range(100, 112))
+    pages_b, cached_b = pool.allocate_sequence(hashes(toks_b), 12)
+    assert cached_b == 0 and len(pages_b) == 3
+    removed = [e for e in events if e.kind == "removed"]
+    assert len(removed) == 1
+    assert removed[0].seq_hashes == [seq_a.blocks[0].seq_hash]
+    # evicted hash no longer matches
+    assert pool.match_prefix(hs_a) == []
+
+
+def test_shared_page_not_evicted_while_referenced():
+    pool = PagePool(num_pages=4, page_size=4)
+    toks = list(range(4))
+    hs = hashes(toks)
+    pages, _ = pool.allocate_sequence(hs, 4)
+    seq = TokenBlockSequence(4, toks)
+    pool.register_page(pages[0], seq.blocks[0].seq_hash,
+                       seq.blocks[0].local_hash,
+                       seq.blocks[0].parent_seq_hash)
+    # second ref
+    pages2, cached = pool.allocate_sequence(hashes(toks + [9]), 5)
+    assert pages2[0] == pages[0] and cached == 4
+    pool.release_sequence(pages)
+    # page still referenced by seq 2: allocating all remaining must fail
+    # rather than evict the shared page
+    assert pool.allocate_sequence(hashes(list(range(50, 62))), 12) is None
+
+
+def test_capacity_exhaustion_returns_none():
+    pool = PagePool(num_pages=4, page_size=4)
+    assert pool.allocate_sequence(hashes(list(range(16))), 16) is None
+    alloc = pool.allocate_sequence(hashes(list(range(12))), 12)
+    assert alloc is not None
+    assert pool.allocate_sequence(hashes(list(range(100, 104))), 4) is None

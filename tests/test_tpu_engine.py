@@ -1,0 +1,226 @@
+"""TpuEngine e2e on the CPU backend: continuous batching, prefix cache,
+determinism, preemption, cancellation — the owned-engine analog of the
+reference's mocker/engine tests."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+
+def make_engine(events=None, metrics=None, **kw):
+    defaults = dict(
+        model=LlamaConfig.tiny(),
+        num_pages=64, max_batch_size=4, prefill_chunk=32,
+        min_prefill_bucket=8, default_max_tokens=8)
+    defaults.update(kw)
+    return TpuEngine(
+        TpuEngineConfig(**defaults),
+        event_sink=(events.append if events is not None else None),
+        metrics_sink=(metrics.append if metrics is not None else None))
+
+
+def req(tokens, max_tokens=8, temperature=0.0, seed=None, stop_ids=()):
+    return {"token_ids": list(tokens), "model": "m",
+            "sampling": {"temperature": temperature, "seed": seed},
+            "stop": {"max_tokens": max_tokens,
+                     "stop_token_ids": list(stop_ids)}}
+
+
+async def run(engine, request, ctx=None):
+    return [o async for o in engine.generate(request, ctx or Context())]
+
+
+async def test_generates_tokens_and_finishes():
+    eng = make_engine()
+    try:
+        outs = await run(eng, req(range(1, 11), max_tokens=5))
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert len(toks) == 5
+        assert outs[-1]["finish_reason"] == "length"
+        assert all(0 <= t < 256 for t in toks)
+    finally:
+        await eng.close()
+
+
+async def test_greedy_determinism_and_prefix_cache():
+    events = []
+    eng = make_engine(events=events)
+    try:
+        prompt = list(range(1, 13))  # 3 complete pages of 4
+        out1 = await run(eng, req(prompt, max_tokens=4))
+        toks1 = [t for o in out1 for t in o.get("token_ids", ())]
+        stored = [e for e in events if e.kind == "stored"]
+        assert len(stored) >= 3          # prompt blocks registered
+
+        # identical prompt: prefix cache hit, identical greedy tokens
+        out2 = await run(eng, req(prompt, max_tokens=4))
+        toks2 = [t for o in out2 for t in o.get("token_ids", ())]
+        assert toks1 == toks2
+        # second run must have found cached pages (fewer fresh allocations):
+        # cached_len for run 2 was 8 (3 blocks matched, capped to < 12 only
+        # if whole prompt matched; partial 3rd page not shared => 8)
+        assert eng.pool.match_prefix(
+            __import__("dynamo_tpu.tokens", fromlist=["x"])
+            .TokenBlockSequence(4, prompt).seq_hashes())
+    finally:
+        await eng.close()
+
+
+async def test_seeded_sampling_reproducible():
+    eng = make_engine()
+    try:
+        r = req(range(1, 9), max_tokens=6, temperature=0.8, seed=42)
+        t1 = [t for o in await run(eng, r) for t in o.get("token_ids", ())]
+        t2 = [t for o in await run(eng, r) for t in o.get("token_ids", ())]
+        assert t1 == t2
+        r2 = req(range(1, 9), max_tokens=6, temperature=0.8, seed=43)
+        t3 = [t for o in await run(eng, r2) for t in o.get("token_ids", ())]
+        assert t3 != t1  # overwhelmingly likely
+    finally:
+        await eng.close()
+
+
+async def test_concurrent_requests_batched():
+    eng = make_engine(max_batch_size=4)
+    try:
+        results = await asyncio.gather(*(
+            run(eng, req(range(1 + i, 9 + i), max_tokens=4))
+            for i in range(6)))
+        for outs in results:
+            toks = [t for o in outs for t in o.get("token_ids", ())]
+            assert len(toks) == 4
+            assert outs[-1]["finish_reason"] == "length"
+        assert eng.pool.active_pages == 0  # everything released
+    finally:
+        await eng.close()
+
+
+async def test_stop_token_id():
+    eng = make_engine()
+    try:
+        # greedy on random weights: find what the first generated token is,
+        # then use it as a stop id on a fresh request
+        outs = await run(eng, req(range(1, 9), max_tokens=3))
+        first = outs[0]["token_ids"][0]
+        outs2 = await run(eng, req(range(1, 9), max_tokens=8,
+                                   stop_ids=[first]))
+        assert outs2[-1]["finish_reason"] == "stop"
+        assert len([t for o in outs2 for t in o.get("token_ids", ())]) == 1
+    finally:
+        await eng.close()
+
+
+async def test_cancellation_frees_resources():
+    eng = make_engine(default_max_tokens=10_000)
+    try:
+        ctx = Context()
+        agen = eng.generate(req(range(1, 9), max_tokens=10_000), ctx)
+        got = 0
+        async for _ in agen:
+            got += 1
+            if got == 3:
+                ctx.cancel()
+                break
+        await agen.aclose()
+        for _ in range(200):
+            if eng.pool.active_pages == 0 and not eng._running:
+                break
+            await asyncio.sleep(0.01)
+        assert eng.pool.active_pages == 0
+        assert not eng._running
+    finally:
+        await eng.close()
+
+
+async def test_kv_pressure_preemption_recovers():
+    # tiny pool: concurrent long generations force preemption; all finish
+    eng = make_engine(num_pages=14, max_batch_size=3, default_max_tokens=8)
+    try:
+        results = await asyncio.gather(*(
+            run(eng, req(range(1 + 20 * i, 9 + 20 * i), max_tokens=8))
+            for i in range(3)))
+        for outs in results:
+            toks = [t for o in outs for t in o.get("token_ids", ())]
+            assert len(toks) == 8
+        assert eng.pool.active_pages == 0
+    finally:
+        await eng.close()
+
+
+async def test_oversized_prompt_rejected():
+    eng = make_engine()
+    try:
+        big = list(range(300))  # tiny config context = 4*16 = 64
+        outs = await run(eng, req(big, max_tokens=4))
+        assert outs[-1]["finish_reason"] == "error"
+    finally:
+        await eng.close()
+
+
+async def test_prompt_exceeding_pool_capacity_rejected():
+    # fits the context-length guard but not the page pool: must error, not
+    # wedge the queue (capacity 13 pages * 4 tok = 52; context = 64)
+    eng = make_engine(num_pages=14, decode_steps_per_sync=1)
+    try:
+        outs = await run(eng, req(range(55), max_tokens=1))
+        assert outs[-1]["finish_reason"] == "error"
+        # a small request behind it must still complete
+        outs2 = await run(eng, req(range(8), max_tokens=2))
+        assert outs2[-1]["finish_reason"] == "length"
+    finally:
+        await eng.close()
+
+
+async def test_empty_prompt_rejected():
+    eng = make_engine()
+    try:
+        outs = await run(eng, req([], max_tokens=2))
+        assert outs[-1]["finish_reason"] == "error"
+    finally:
+        await eng.close()
+
+
+async def test_close_unblocks_inflight_and_rejects_new():
+    eng = make_engine(default_max_tokens=10_000)
+    try:
+        agen = eng.generate(req(range(1, 9), max_tokens=10_000), Context())
+        await agen.__anext__()          # stream started
+        await eng.close()
+        outs = [o async for o in agen]  # must terminate, not hang
+        assert outs == [] or outs[-1].get("finish_reason") in (
+            "cancelled", "error")
+        outs2 = await run(eng, req(range(4), max_tokens=2))
+        assert outs2[-1]["finish_reason"] == "error"
+    finally:
+        await eng.close()
+
+
+async def test_top_p_zero_is_near_greedy():
+    from dynamo_tpu.engine.sampling import sample_tokens
+    import numpy as np
+
+    logits = np.zeros((1, 100), dtype=np.float32)
+    logits[0, 37] = 5.0
+    out = sample_tokens(
+        logits, np.asarray([123], np.uint32), np.asarray([0], np.uint32),
+        np.asarray([1.0], np.float32), np.asarray([0.0], np.float32),
+        np.asarray([0], np.int32))
+    assert int(np.asarray(out)[0]) == 37
+
+
+async def test_metrics_published():
+    metrics = []
+    eng = make_engine(metrics=metrics)
+    try:
+        await run(eng, req(range(1, 9), max_tokens=3))
+        assert metrics
+        assert metrics[-1].kv_stats.kv_total_blocks == 63  # 64 - scratch
+    finally:
+        await eng.close()

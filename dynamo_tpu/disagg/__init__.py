@@ -1,0 +1,20 @@
+"""Disaggregated prefill/decode serving (SURVEY §3.3).
+
+The framework's own inter-engine parallelism: a decode worker orchestrates
+remote prefill (decode-first pattern, `components/src/dynamo/vllm/
+handlers.py:140-274` analog), KV blocks move prefill→decode via the
+transfer plane (NIXL-replacement: host-staged over the runtime transport
+today, ICI device-to-device as the intra-pod fast path), and the
+conditional `DisaggRouter` (disagg_router.rs analog) decides local vs
+remote by uncached prefill length.
+"""
+
+from dynamo_tpu.disagg.disagg_router import DisaggRouter
+from dynamo_tpu.disagg.handlers import (
+    DecodeWorkerHandler,
+    PrefillWorkerHandler,
+    serve_kv_pull,
+)
+
+__all__ = ["DisaggRouter", "DecodeWorkerHandler", "PrefillWorkerHandler",
+           "serve_kv_pull"]

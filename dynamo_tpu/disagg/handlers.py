@@ -1,0 +1,206 @@
+"""Disaggregated worker handlers: decode-first orchestration + KV pull.
+
+Reference: `components/src/dynamo/vllm/handlers.py` —
+`DecodeWorkerHandler.generate` (:140) builds a max_tokens=1 prefill request
+with ``kv_transfer_params.do_remote_decode``, sends it to the prefill pool
+(router-first with round-robin fallback, :183-199), attaches the returned
+transfer descriptors to the local decode, and streams. The TPU transfer
+plane: the prefill engine pins the sequence's pages; the decode handler
+pulls them over the runtime transport (``kv_pull`` endpoint) and preloads
+them into its engine's fresh pages. On one host the pull is a zero-copy
+in-proc call; across hosts it rides TCP (DCN analog); intra-pod ICI
+device-to-device is the planned fast path.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator, Optional
+
+import numpy as np
+
+from dynamo_tpu.disagg.disagg_router import DisaggRouter
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.push import PushRouter
+
+logger = logging.getLogger(__name__)
+
+KV_PULL_ENDPOINT = "kv_pull"
+
+
+def _bf16_bytes(arr: np.ndarray) -> tuple[bytes, list[int], str]:
+    return arr.tobytes(), list(arr.shape), str(arr.dtype)
+
+
+def _bf16_from(raw: bytes, shape: list[int], dtype: str) -> np.ndarray:
+    import ml_dtypes  # ships with jax
+
+    np_dtype = np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" \
+        else np.dtype(dtype)
+    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+
+class PrefillWorkerHandler:
+    """Serves `generate` on the prefill pool (handlers.py:236 analog).
+
+    The engine does the work (max_tokens=1 + pinned pages); this wrapper
+    stamps the instance id into kv_transfer_params so the decode side can
+    address the owning worker's kv_pull endpoint directly."""
+
+    def __init__(self, engine: TpuEngine, instance_id: int) -> None:
+        self.engine = engine
+        self.instance_id = instance_id
+
+    async def generate(self, request: dict, context: Context
+                       ) -> AsyncIterator[dict]:
+        async for out in self.engine.generate(request, context):
+            ktp = out.get("kv_transfer_params")
+            if ktp is not None:
+                ktp["instance_id"] = self.instance_id
+            yield out
+
+    async def kv_pull(self, request: dict, context: Context
+                      ) -> AsyncIterator[dict]:
+        """Transfer endpoint: {"transfer_id"} → one frame of page data."""
+        tid = request["transfer_id"]
+        try:
+            pages, prefill_len = self.engine.take_transfer(tid)
+        except KeyError:
+            yield {"error": f"unknown transfer {tid}"}
+            return
+        data = await self.engine.read_kv_pages(pages)
+        raw, shape, dtype = _bf16_bytes(data)
+        # release BEFORE yielding: the consumer may close the stream right
+        # after the first frame, skipping any code after the yield
+        self.engine.complete_transfer(tid)
+        yield {"kv": raw, "shape": shape, "dtype": dtype,
+               "prefill_len": prefill_len}
+
+
+async def serve_kv_pull(runtime, namespace: str, component: str,
+                        handler: PrefillWorkerHandler,
+                        instance_id: int):
+    """Register the prefill worker's kv_pull endpoint."""
+    ep = (runtime.namespace(namespace).component(component)
+          .endpoint(KV_PULL_ENDPOINT))
+    return await ep.serve(handler.kv_pull, instance_id=instance_id)
+
+
+class DecodeWorkerHandler:
+    """Decode-first disaggregation (handlers.py:140-230 analog).
+
+    generate():
+    1. if a prefill pool exists and DisaggRouter says remote → send the
+       prompt there with max_tokens=1 + do_remote_decode
+    2. pull the KV pages from the owning prefill worker
+    3. run local decode with the imported KV (prompt + first token,
+       cached_len = prefill_len)
+    Falls back to fully-local prefill+decode when the pool is empty or the
+    prompt is short/mostly cached.
+    """
+
+    def __init__(self, engine: TpuEngine,
+                 prefill_router: Optional[AsyncEngine] = None,
+                 kv_pull_router: Optional[PushRouter] = None,
+                 disagg_router: Optional[DisaggRouter] = None) -> None:
+        self.engine = engine
+        self.prefill_router = prefill_router
+        self.kv_pull_router = kv_pull_router
+        self.disagg_router = disagg_router or DisaggRouter()
+
+    def _can_prefill_remote(self) -> bool:
+        if self.prefill_router is None or self.kv_pull_router is None:
+            return False
+        try:
+            return bool(self.kv_pull_router.client.instances())
+        except Exception:
+            return False
+
+    def _prefix_hit_len(self, token_ids: list[int]) -> int:
+        from dynamo_tpu.tokens import TokenBlockSequence
+
+        hashes = TokenBlockSequence(
+            self.engine.model_cfg.page_size, token_ids).seq_hashes()
+        return len(self.engine.pool.match_prefix(hashes)) \
+            * self.engine.model_cfg.page_size
+
+    async def generate(self, request: dict, context: Context
+                       ) -> AsyncIterator[dict]:
+        token_ids = list(request.get("token_ids", ()))
+        remote = (self._can_prefill_remote()
+                  and self.disagg_router.prefill_remote(
+                      len(token_ids), self._prefix_hit_len(token_ids)))
+        if not remote:
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        # --- 1. remote prefill (max_tokens=1, pages pinned remotely) ---
+        prefill_req = dict(request)
+        stop = dict(prefill_req.get("stop") or {})
+        stop["max_tokens"] = 1
+        stop.pop("stop_token_ids", None)
+        prefill_req["stop"] = stop
+        prefill_req["kv_transfer_params"] = {"do_remote_decode": True}
+        first_token: Optional[int] = None
+        ktp: Optional[dict] = None
+        try:
+            async for out in self.prefill_router.generate(
+                    prefill_req, context):
+                if out.get("token_ids"):
+                    first_token = out["token_ids"][0]
+                if out.get("kv_transfer_params"):
+                    ktp = out["kv_transfer_params"]
+                if out.get("finish_reason") == "error":
+                    ktp = None
+                    break
+        except ConnectionError:
+            ktp = None
+        if ktp is None or first_token is None:
+            # remote prefill failed: fall back to fully-local serve
+            logger.warning("remote prefill failed; serving locally")
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        # --- 2. pull the KV pages from the owning prefill worker ---
+        kv_data = None
+        try:
+            async for frame in self.kv_pull_router.direct(
+                    {"transfer_id": ktp["transfer_id"]},
+                    ktp["instance_id"], context):
+                if "kv" in frame:
+                    kv_data = _bf16_from(frame["kv"], frame["shape"],
+                                         frame["dtype"])
+                break
+        except ConnectionError:
+            kv_data = None
+        if kv_data is None:
+            logger.warning("KV pull failed; serving locally")
+            async for out in self.engine.generate(request, context):
+                yield out
+            return
+
+        # --- 3. stream the prefill token, then local decode with the
+        #        imported cache ---
+        orig_stop = request.get("stop") or {}
+        if orig_stop.get("max_tokens") == 1:
+            # no decode needed; the pulled KV is simply dropped
+            yield {"token_ids": [first_token], "finish_reason": "length"}
+            return
+        if first_token in (orig_stop.get("stop_token_ids") or ()):
+            yield {"token_ids": [first_token], "finish_reason": "stop"}
+            return
+        yield {"token_ids": [first_token]}
+        decode_req = dict(request)
+        decode_req["token_ids"] = token_ids + [first_token]
+        stop = dict(decode_req.get("stop") or {})
+        if stop.get("max_tokens"):
+            stop["max_tokens"] = max(stop["max_tokens"] - 1, 1)
+        decode_req["stop"] = stop
+        decode_req["kv_transfer_params"] = {
+            "kv_data": kv_data, "prefill_len": int(ktp["prefill_len"])}
+        async for out in self.engine.generate(decode_req, context):
+            yield out

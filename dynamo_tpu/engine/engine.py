@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -87,6 +89,8 @@ class _Seq:
     prompt: list[int]                     # effective prompt (incl. replays)
     prompt_hashes: list[int] = field(default_factory=list)
     pages: list[int] = field(default_factory=list)
+    # disagg: host KV data to preload into this seq's pages before prefill
+    import_kv: Optional[tuple] = None     # (np array (2,L,KVH,n,P,D), len)
     cached_len: int = 0                   # prefix-cache hit length
     next_token: int = -1                  # sampled, KV not yet written
     generated: int = 0                    # sampled tokens streamed
@@ -130,6 +134,15 @@ class TpuEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self._rng = np.random.RandomState(cfg.rng_seed)
+        # Serializes device access: step functions donate the cache buffers
+        # (the pre-step arrays die mid-call), so concurrent readers
+        # (kv_pull) must not touch k_cache/v_cache while a step runs.
+        self._device_lock = asyncio.Lock()
+        # disagg: finished prefill-only sequences whose pages are pinned
+        # until the decode worker pulls them (transfer_id -> (pages, len,
+        # deadline)); reaped by the scheduler loop after transfer_ttl.
+        self._transfers: dict[str, tuple[list[int], int, float]] = {}
+        self.transfer_ttl = 60.0
 
     # -- engine contract ----------------------------------------------------
 
@@ -163,12 +176,32 @@ class TpuEngine:
                                 f"(context {max_len}, "
                                 f"pages {self.pool.capacity})"}).to_dict()
             return
+        ktp = req.kv_transfer_params or {}
+        import_kv = None
+        if ktp.get("kv_data") is not None:
+            data = ktp["kv_data"]
+            plen = int(ktp["prefill_len"])
+            n_pages = (plen + mcfg.page_size - 1) // mcfg.page_size
+            want = (2, mcfg.num_layers, mcfg.num_kv_heads, n_pages,
+                    mcfg.page_size, mcfg.head_dim)
+            if not (0 < plen < len(req.token_ids)) \
+                    or tuple(data.shape) != want:
+                # a malformed import must fail THIS request, not reach
+                # prefill_all where an exception would _fail_all everyone
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": f"bad kv import: prefill_len={plen}, "
+                                    f"shape={tuple(data.shape)} != {want}"}
+                ).to_dict()
+                return
+            import_kv = (data, plen)
         seq = _Seq(
             req=req, ctx=context, queue=asyncio.Queue(),
             token_seq=TokenBlockSequence(mcfg.page_size),
             prompt=list(req.token_ids),
             prompt_hashes=TokenBlockSequence(
                 mcfg.page_size, req.token_ids).seq_hashes(),
+            import_kv=import_kv,
             seed=(req.sampling.seed if req.sampling.seed is not None
                   else int(self._rng.randint(0, 2**31 - 1))),
             arrival=self._arrivals,
@@ -210,9 +243,19 @@ class TpuEngine:
         while not self._stopped:
             if not self._waiting and not self._running:
                 self._wake.clear()
-                await self._wake.wait()
+                if self._transfers:
+                    # stay reap-able: pinned transfers must expire even
+                    # when no requests are in flight
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), 1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                    self._reap_transfers()
+                else:
+                    await self._wake.wait()
                 continue
             try:
+                self._reap_transfers()
                 self._admit()
                 progressed = await self._prefill_pending()
                 progressed |= await self._decode_iter()
@@ -249,10 +292,18 @@ class TpuEngine:
             if (self.pool.active_pages + need_pages
                     > cfg.watermark * self.pool.capacity and self._running):
                 break
-            alloc = self.pool.allocate_sequence(hashes, len(cand.prompt))
-            if alloc is None:
-                break
-            cand.pages, cand.cached_len = alloc
+            if cand.import_kv is not None:
+                # disagg import: fresh pages only (remote KV overwrites
+                # them); cached_len comes from the transfer, not hashing
+                alloc = self.pool.allocate_sequence([], len(cand.prompt))
+                if alloc is None:
+                    break
+                cand.pages, cand.cached_len = alloc[0], cand.import_kv[1]
+            else:
+                alloc = self.pool.allocate_sequence(hashes, len(cand.prompt))
+                if alloc is None:
+                    break
+                cand.pages, cand.cached_len = alloc
             self._waiting.pop(0)
             self._running.append(cand)
 
@@ -271,6 +322,11 @@ class TpuEngine:
         def prefill_all():
             last_logits = []
             for seq in pending:
+                if seq.import_kv is not None:
+                    data, n_tok = seq.import_kv
+                    n_pages = (n_tok + mcfg.page_size - 1) // mcfg.page_size
+                    self.write_kv_pages(seq.pages[:n_pages], data)
+                    seq.import_kv = None
                 page_table = np.zeros(mcfg.max_pages_per_seq, dtype=np.int32)
                 page_table[:len(seq.pages)] = seq.pages
                 pt_dev = jax.numpy.asarray(page_table)
@@ -308,12 +364,14 @@ class TpuEngine:
                 arr(lambda s: s.req.sampling.top_k, np.int32))
             return np.asarray(sampled)                    # ONE host sync
 
-        tokens = await asyncio.to_thread(prefill_all)
+        async with self._device_lock:
+            tokens = await asyncio.to_thread(prefill_all)
         for seq, token in zip(pending, tokens):
-            # token_seq mirrors what prefill wrote to the device
+            # token_seq mirrors what prefill wrote to the device; register
+            # every complete block this worker now holds (no-op for blocks
+            # matched from already-registered shared pages)
             seq.token_seq = TokenBlockSequence(mcfg.page_size, seq.prompt)
-            for block in seq.token_seq.blocks[seq.cached_len
-                                              // mcfg.page_size:]:
+            for block in seq.token_seq.blocks:
                 self.pool.register_page(
                     seq.pages[block.block_index], block.seq_hash,
                     block.local_hash, block.parent_seq_hash)
@@ -386,8 +444,9 @@ class TpuEngine:
                 jax.numpy.asarray(top_ks), mcfg, k_steps)
             return np.asarray(sampled), kc, vc            # ONE host sync
 
-        sampled, self.k_cache, self.v_cache = \
-            await asyncio.to_thread(run_burst)
+        async with self._device_lock:
+            sampled, self.k_cache, self.v_cache = \
+                await asyncio.to_thread(run_burst)
         for i, s in enumerate(batch):
             for k in range(k_steps):
                 if s.finished or s not in self._running:
@@ -413,23 +472,88 @@ class TpuEngine:
             finish = FINISH_STOP
         elif seq.generated >= seq.max_tokens:
             finish = FINISH_LENGTH
-        seq.queue.put_nowait(EngineOutput(
-            token_ids=[token], finish_reason=finish).to_dict())
+        out = EngineOutput(token_ids=[token], finish_reason=finish)
+        exported = False
+        if finish is not None and \
+                (seq.req.kv_transfer_params or {}).get("do_remote_decode"):
+            # disagg prefill worker: pin this seq's pages for the decode
+            # worker to pull; advertise the transfer in the final frame
+            # (handlers.py adds the worker's address; SURVEY §3.3).
+            # Pin only the pages holding the seq.pos written tokens —
+            # decode-lookahead pages would break the importer's shapes.
+            ps = self.model_cfg.page_size
+            n_pages = (seq.pos + ps - 1) // ps
+            self.pool.release_sequence(seq.pages[n_pages:])
+            tid = uuid.uuid4().hex
+            self._transfers[tid] = (
+                seq.pages[:n_pages], seq.pos,
+                time.monotonic() + self.transfer_ttl)
+            out.kv_transfer_params = {
+                "transfer_id": tid, "prefill_len": seq.pos,
+                "worker_id": self.config.worker_id}
+            exported = True
+        seq.queue.put_nowait(out.to_dict())
         if finish is not None:
-            self._finish(seq, finish, emit=False)
+            self._finish(seq, finish, emit=False,
+                         release_pages=not exported)
 
-    def _finish(self, seq: _Seq, reason: str, emit: bool = True) -> None:
+    def _finish(self, seq: _Seq, reason: str, emit: bool = True,
+                release_pages: bool = True) -> None:
         seq.finished = True
         if seq in self._running:
             self._running.remove(seq)
         if seq in self._waiting:
             self._waiting.remove(seq)
-        self.pool.release_sequence(seq.pages)
+        if release_pages:
+            self.pool.release_sequence(seq.pages)
         seq.pages = []
         if emit:
             seq.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=reason).to_dict())
         seq.queue.put_nowait(None)
+
+    # -- disagg KV transfer (SURVEY §3.3; NIXL-replacement host path) -------
+
+    async def read_kv_pages(self, page_ids: list[int]) -> np.ndarray:
+        """Copy pages to host: (2, L, KVH, n, P, D) [k;v]. Takes the device
+        lock — steps donate the cache buffers, so an unsynchronized read
+        mid-step would touch a deleted array. The ICI device-to-device path
+        replaces this for intra-pod transfers."""
+        async with self._device_lock:
+            return await asyncio.to_thread(self._read_kv_pages_sync, page_ids)
+
+    def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
+        ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
+        k_sel = np.asarray(self.k_cache[:, :, ids])
+        v_sel = np.asarray(self.v_cache[:, :, ids])
+        return np.stack([k_sel, v_sel])
+
+    def write_kv_pages(self, page_ids: list[int], data: np.ndarray) -> None:
+        """Only call from within the scheduler's device-locked step (the
+        prefill path does, for disagg imports)."""
+        ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
+        k_new = jax.numpy.asarray(data[0], dtype=self.model_cfg.dtype)
+        v_new = jax.numpy.asarray(data[1], dtype=self.model_cfg.dtype)
+        self.k_cache = self.k_cache.at[:, :, ids].set(k_new)
+        self.v_cache = self.v_cache.at[:, :, ids].set(v_new)
+
+    def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
+        """(pages, prefill_len) for a pinned transfer; KeyError if unknown
+        or expired."""
+        pages, plen, _ = self._transfers[transfer_id]
+        return pages, plen
+
+    def complete_transfer(self, transfer_id: str) -> None:
+        entry = self._transfers.pop(transfer_id, None)
+        if entry is not None:
+            self.pool.release_sequence(entry[0])
+
+    def _reap_transfers(self) -> None:
+        now = time.monotonic()
+        for tid in [t for t, (_, _, dl) in self._transfers.items()
+                    if dl <= now]:
+            logger.warning("disagg transfer %s expired unpulled", tid)
+            self.complete_transfer(tid)
 
     def _pick_victim(self, exclude: _Seq) -> Optional[_Seq]:
         cands = [s for s in self._running if s is not exclude and s.prefilled]

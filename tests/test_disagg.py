@@ -1,0 +1,200 @@
+"""Disaggregated prefill/decode tests (CPU backend, tiny model).
+
+The gold check: greedy disaggregated serve must produce EXACTLY the same
+tokens as a fully-aggregated run of the same prompt — proving the KV pages
+that crossed the worker boundary are bit-meaningful.
+(Reference analog: tests/kvbm determinism + disagg flow of handlers.py.)
+"""
+
+import asyncio
+
+from dynamo_tpu.disagg.disagg_router import DisaggRouter
+from dynamo_tpu.disagg.handlers import (
+    KV_PULL_ENDPOINT,
+    DecodeWorkerHandler,
+    PrefillWorkerHandler,
+)
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push import PushRouter
+
+set_attention_impl("xla")
+
+
+def make_engine(**kw):
+    defaults = dict(model=LlamaConfig.tiny(), num_pages=64,
+                    max_batch_size=4, prefill_chunk=32,
+                    min_prefill_bucket=8, default_max_tokens=8)
+    defaults.update(kw)
+    return TpuEngine(TpuEngineConfig(**defaults))
+
+
+def req(tokens, max_tokens=6):
+    return {"token_ids": list(tokens), "model": "m",
+            "sampling": {"temperature": 0.0},
+            "stop": {"max_tokens": max_tokens}}
+
+
+async def collect_tokens(engine, request):
+    outs = [o async for o in engine.generate(request, Context())]
+    assert not any(o.get("finish_reason") == "error" for o in outs), outs
+    return [t for o in outs for t in o.get("token_ids", ())]
+
+
+async def test_disagg_router_threshold():
+    r = DisaggRouter(max_local_prefill_length=100)
+    assert not r.prefill_remote(80)
+    assert r.prefill_remote(150)
+    assert not r.prefill_remote(150, prefix_hit_len=100)
+    r2 = DisaggRouter(conditional=False)
+    assert r2.prefill_remote(1)
+
+
+async def test_disagg_router_store_watch():
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        r = await DisaggRouter(max_local_prefill_length=100).start_watch(
+            rt, "ns", "decode")
+        from dynamo_tpu.disagg.disagg_router import disagg_config_key
+        await rt.store.put(disagg_config_key("ns", "decode"),
+                           b'{"max_local_prefill_length": 5}')
+        for _ in range(50):
+            if r.max_local_prefill_length == 5:
+                break
+            await asyncio.sleep(0.01)
+        assert r.max_local_prefill_length == 5
+        await r.stop()
+    finally:
+        await rt.close()
+
+
+async def test_engine_export_import_roundtrip():
+    """Engine-level: prefill with do_remote_decode pins pages; importing
+    them into a second engine reproduces the aggregated continuation."""
+    prompt = list(range(1, 12))
+
+    # aggregated reference
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    prefill_eng = make_engine(rng_seed=0)
+    decode_eng = make_engine(rng_seed=0)
+    try:
+        # remote-prefill request
+        p_req = req(prompt, max_tokens=1)
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in prefill_eng.generate(p_req, Context())]
+        first = outs[0]["token_ids"][0]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        assert ktp["prefill_len"] == len(prompt)
+        # pages pinned (not released) until pulled
+        assert prefill_eng.pool.active_pages > 0
+
+        pages, plen = prefill_eng.take_transfer(ktp["transfer_id"])
+        data = await prefill_eng.read_kv_pages(pages)
+        prefill_eng.complete_transfer(ktp["transfer_id"])
+        assert prefill_eng.pool.active_pages == 0
+
+        d_req = req(prompt + [first], max_tokens=5)
+        d_req["kv_transfer_params"] = {"kv_data": data, "prefill_len": plen}
+        rest = await collect_tokens(decode_eng, d_req)
+        assert [first] + rest == ref
+    finally:
+        await prefill_eng.close()
+        await decode_eng.close()
+
+
+async def setup_disagg_stack(max_local=0):
+    """decode + prefill workers wired over an in-proc runtime."""
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    ns = "ns"
+    prefill_eng = make_engine(rng_seed=0)
+    decode_eng = make_engine(rng_seed=0)
+
+    p_handler = PrefillWorkerHandler(prefill_eng, instance_id=11)
+    ep_gen = rt.namespace(ns).component("prefill").endpoint("generate")
+    await ep_gen.serve(p_handler, instance_id=11)
+    ep_pull = rt.namespace(ns).component("prefill").endpoint(KV_PULL_ENDPOINT)
+    await ep_pull.serve(p_handler.kv_pull, instance_id=11)
+
+    gen_client = await ep_gen.client()
+    await gen_client.start()
+    await gen_client.wait_ready()
+    pull_client = await ep_pull.client()
+    await pull_client.start()
+    await pull_client.wait_ready()
+
+    d_handler = DecodeWorkerHandler(
+        decode_eng,
+        prefill_router=PushRouter(gen_client),
+        kv_pull_router=PushRouter(pull_client),
+        disagg_router=DisaggRouter(max_local_prefill_length=max_local))
+    return rt, prefill_eng, decode_eng, d_handler
+
+
+async def test_disagg_e2e_matches_aggregated():
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref
+        # prefill did the prompt work; decode imported it
+        assert pe.pool.used_pages > 0       # registered pages cached
+        assert pe.pool.active_pages == 0    # transfer completed, released
+        assert de.pool.active_pages == 0
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_short_prompt_stays_local():
+    rt, pe, de, handler = await setup_disagg_stack(max_local=100)
+    try:
+        outs = [o async for o in handler.generate(
+            req(list(range(1, 9)), max_tokens=4), Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert len(toks) == 4
+        assert pe.pool.used_pages == 0      # prefill pool untouched
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_max_tokens_one():
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    try:
+        outs = [o async for o in handler.generate(
+            req(list(range(1, 9)), max_tokens=1), Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert len(toks) == 1
+        assert outs[-1]["finish_reason"] == "length"
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_fallback_when_no_prefill_pool():
+    de = make_engine()
+    handler = DecodeWorkerHandler(de)  # no routers at all
+    try:
+        outs = [o async for o in handler.generate(
+            req(list(range(1, 9)), max_tokens=3), Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert len(toks) == 3
+    finally:
+        await de.close()

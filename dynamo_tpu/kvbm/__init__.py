@@ -1,0 +1,14 @@
+"""KVBM: multi-tier KV block manager (SURVEY §2.5).
+
+Reference: `lib/llm/src/block_manager/` — cache tiers G1 (device HBM) →
+G2 (host RAM) → G3 (local disk), offload on eviction, onboard on prefix
+match. Here G1 is the engine's device page pool (engine/pages.py); this
+package owns G2/G3 and the offload/onboard flows. Transfers are
+device↔host copies (the CUDA `block_copy.cu` analog is the engine's
+read/write_kv_pages); tier demotion G2→G3 is host file IO.
+"""
+
+from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmManager
+from dynamo_tpu.kvbm.tiers import DiskTier, HostTier
+
+__all__ = ["KvbmManager", "KvbmConfig", "HostTier", "DiskTier"]

@@ -197,8 +197,14 @@ class DecodeWorkerHandler:
         decode_req = dict(request)
         decode_req["token_ids"] = token_ids + [first_token]
         stop = dict(decode_req.get("stop") or {})
-        if stop.get("max_tokens"):
-            stop["max_tokens"] = max(stop["max_tokens"] - 1, 1)
+        # the remote prefill already streamed one token, so the decode
+        # phase's budget shrinks by one — resolving the engine default
+        # first, else an unset max_tokens would yield one extra token vs
+        # the fully-local path (same for min_tokens / EOS suppression)
+        eff_max = stop.get("max_tokens") or self.engine.config.default_max_tokens
+        stop["max_tokens"] = max(eff_max - 1, 1)
+        if stop.get("min_tokens"):
+            stop["min_tokens"] = max(stop["min_tokens"] - 1, 0)
         decode_req["stop"] = stop
         decode_req["kv_transfer_params"] = {
             "kv_data": kv_data, "prefill_len": int(ktp["prefill_len"])}

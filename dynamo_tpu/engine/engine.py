@@ -78,6 +78,11 @@ class TpuEngineConfig:
     # feeds it to the next step; the host syncs once per burst. Critical on
     # TPU where a device→host sync stalls the pipeline.
     decode_steps_per_sync: int = 8
+    # Optional jax.sharding.Mesh ("dp","tp" axes): params/cache are placed
+    # with the megatron-pattern specs (engine/sharding.py) and every jitted
+    # step runs SPMD over it. One engine = one rank's (sub)mesh; dp ranks
+    # each own a disjoint tp submesh (WorkerWithDpRank addressing).
+    mesh: Optional[Any] = None
 
 
 @dataclass
@@ -119,13 +124,38 @@ class TpuEngine:
         self.config = config or TpuEngineConfig()
         cfg = self.config
         self.model_cfg = cfg.model
-        if params is None:
-            params = init_params(jax.random.PRNGKey(cfg.rng_seed),
-                                 self.model_cfg)
-        self.params = params
-        self.k_cache, self.v_cache = init_cache(self.model_cfg, cfg.num_pages)
+        mcfg = self.model_cfg
+        if cfg.mesh is None:
+            if params is None:
+                params = init_params(jax.random.PRNGKey(cfg.rng_seed), mcfg)
+            self.params = params
+            self.k_cache, self.v_cache = init_cache(mcfg, cfg.num_pages)
+        else:
+            from dynamo_tpu.engine.sharding import (
+                cache_sharding,
+                param_sharding,
+                shard_params,
+            )
+
+            if params is None:
+                # init directly sharded (jit + out_shardings): the full
+                # parameter set must never materialize on one device — an
+                # 8B bf16 model alone would OOM a single v5e chip
+                params = jax.jit(
+                    lambda key: init_params(key, mcfg),
+                    out_shardings=param_sharding(cfg.mesh),
+                )(jax.random.PRNGKey(cfg.rng_seed))
+                self.params = params
+            else:
+                # externally-loaded (host) weights: place shard-by-shard
+                self.params = shard_params(params, cfg.mesh)
+            self.k_cache, self.v_cache = jax.jit(
+                lambda: init_cache(mcfg, cfg.num_pages),
+                out_shardings=cache_sharding(cfg.mesh),
+            )()
         self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
                              cfg.worker_id, cfg.dp_rank, event_sink)
+        self.kvbm = None   # set by kvbm.KvbmManager when attached
         self.metrics_sink = metrics_sink
         self._waiting: list[_Seq] = []
         self._running: list[_Seq] = []
@@ -304,6 +334,11 @@ class TpuEngine:
                 if alloc is None:
                     break
                 cand.pages, cand.cached_len = alloc
+                if self.kvbm is not None:
+                    # KVBM onboard: blocks past the device prefix hit that
+                    # live in the host/disk tiers are DMA'd into the fresh
+                    # pages so prefill skips them
+                    cand.cached_len = self.kvbm.onboard(cand)
             self._waiting.pop(0)
             self._running.append(cand)
 
@@ -392,6 +427,11 @@ class TpuEngine:
         k_steps = cfg.decode_steps_per_sync
         # every runnable seq needs pages covering pos .. pos+k_steps-1
         for s in list(runnable):
+            if s not in runnable:
+                # preempted as an earlier seq's victim in this same pass:
+                # it is back in _waiting with no pages — allocating into it
+                # here would leak pages when _admit re-allocates
+                continue
             if s.ctx.is_cancelled():
                 self._finish(s, FINISH_CANCELLED)
                 runnable.remove(s)

@@ -53,6 +53,12 @@ class PagePool:
         self.worker_id = worker_id
         self.dp_rank = dp_rank
         self.event_sink = event_sink
+        # KVBM offload hook: called with a BATCH of (page_id, seq_hash)
+        # pairs just before registered pages are evicted, while their
+        # device data is still intact — one hook call per eviction batch so
+        # the manager pays one device gather, not one sync per page
+        self.evict_hook: Optional[Callable[[list[tuple[int, int]]], None]] \
+            = None
         self._free: list[int] = list(range(num_pages - 1, 0, -1))
         self._pages: dict[int, _Page] = {}
         self._registered: dict[int, int] = {}       # seq_hash -> page_id
@@ -118,6 +124,11 @@ class PagePool:
         fresh_needed = need_pages - len(matched)
         if len(self._free) + len(self._inactive) < fresh_needed:
             return None
+        # pre-evict the whole deficit now: one batched offload-hook call
+        # instead of one device sync per page inside the allocate loop
+        deficit = fresh_needed - len(self._free)
+        if deficit > 0:
+            self._evict_many(deficit)
         for pid in matched:
             self.acquire(pid)
         pages = list(matched)
@@ -170,16 +181,25 @@ class PagePool:
         self._free.append(page.page_id)
 
     def _evict_one(self) -> bool:
-        if not self._inactive:
-            return False
-        pid, _ = self._inactive.popitem(last=False)   # LRU
-        page = self._pages[pid]
-        if page.seq_hash is not None:
+        return self._evict_many(1) == 1
+
+    def _evict_many(self, n: int) -> int:
+        """Evict up to n LRU inactive pages; ONE offload-hook call for the
+        whole batch (device data still intact when it fires)."""
+        victims: list[_Page] = []
+        while len(victims) < n and self._inactive:
+            pid, _ = self._inactive.popitem(last=False)   # LRU
+            victims.append(self._pages[pid])
+        registered = [p for p in victims if p.seq_hash is not None]
+        if registered and self.evict_hook is not None:
+            self.evict_hook([(p.page_id, p.seq_hash) for p in registered])
+        for page in registered:
             self._registered.pop(page.seq_hash, None)
             if self.event_sink is not None:
                 self.event_sink(KvCacheEvent(
                     kind=KV_REMOVED, worker_id=self.worker_id,
                     dp_rank=self.dp_rank, event_id=next(self._event_ids),
                     seq_hashes=[page.seq_hash]))
-        self._discard(page)
-        return True
+        for page in victims:
+            self._discard(page)
+        return len(victims)

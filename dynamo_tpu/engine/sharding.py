@@ -61,6 +61,16 @@ def cache_spec() -> P:
     return P(None, "tp", None, None, None)
 
 
+def param_sharding(mesh: Mesh) -> dict:
+    """NamedSharding tree matching init_params' structure."""
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def cache_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, cache_spec())
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     specs = param_specs()
     return jax.tree.map(

@@ -1,0 +1,177 @@
+"""KVBM storage tiers: G2 host RAM + G3 local disk.
+
+Reference: `lib/llm/src/block_manager/block_manager.rs:63-75` (CacheLevel
+G1..G4) and `offload.rs:86` (offload/onboard pipeline). The TPU analog
+keeps G1 in the engine's device HBM page pool; this module owns the host
+side. Blocks are immutable registered KV pages keyed by their chained
+sequence hash (tokens.py), stored as host numpy arrays of shape
+``(2, layers, kv_heads, page_size, head_dim)`` ([k; v]).
+
+Tier flow: evicted device pages land in :class:`HostTier`; when it
+overflows, LRU blocks demote to :class:`DiskTier`; disk hits promote back
+to host on access. :class:`TieredStore` composes the two behind one
+get/put/match interface.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class HostTier:
+    """G2: host-RAM block store with LRU eviction (offload.rs:86 analog)."""
+
+    def __init__(self, capacity_blocks: int) -> None:
+        self.capacity = capacity_blocks
+        self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._blocks
+
+    def get(self, seq_hash: int) -> Optional[np.ndarray]:
+        data = self._blocks.get(seq_hash)
+        if data is not None:
+            self._blocks.move_to_end(seq_hash)
+        return data
+
+    def put(self, seq_hash: int, data: np.ndarray
+            ) -> list[tuple[int, np.ndarray]]:
+        """Insert; returns LRU (seq_hash, data) pairs displaced over
+        capacity (for the caller to demote to the next tier)."""
+        if seq_hash in self._blocks:
+            self._blocks.move_to_end(seq_hash)
+            return []
+        self._blocks[seq_hash] = data
+        displaced = []
+        while len(self._blocks) > self.capacity:
+            displaced.append(self._blocks.popitem(last=False))
+        return displaced
+
+    def pop(self, seq_hash: int) -> Optional[np.ndarray]:
+        return self._blocks.pop(seq_hash, None)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class DiskTier:
+    """G3: local-disk block store, one file per block, LRU by access.
+
+    Blocks are written as raw bytes (``.npy`` can't round-trip bfloat16 —
+    it loads back as ``|V2``); dtype/shape ride in the in-memory index,
+    which is fine because the LRU order itself is in-memory state.
+    """
+
+    def __init__(self, capacity_blocks: int,
+                 directory: Optional[str] = None) -> None:
+        self.capacity = capacity_blocks
+        if directory is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="dynamo_kvbm_")
+            directory = self._tmp.name
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        # seq_hash -> (path, dtype_name, shape)
+        self._lru: OrderedDict[int, tuple[str, str, tuple]] = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.directory, f"{seq_hash & (2**64-1):016x}.kv")
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._lru
+
+    def put(self, seq_hash: int, data: np.ndarray) -> None:
+        if seq_hash in self._lru:
+            self._lru.move_to_end(seq_hash)
+            return
+        path = self._path(seq_hash)
+        with open(path, "wb") as f:
+            f.write(np.ascontiguousarray(data).tobytes())
+        self._lru[seq_hash] = (path, str(data.dtype), tuple(data.shape))
+        while len(self._lru) > self.capacity:
+            _, (old, _, _) = self._lru.popitem(last=False)
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    def get(self, seq_hash: int) -> Optional[np.ndarray]:
+        entry = self._lru.get(seq_hash)
+        if entry is None:
+            return None
+        self._lru.move_to_end(seq_hash)
+        path, dtype, shape = entry
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            return np.frombuffer(raw, dtype=_np_dtype(dtype)).reshape(shape)
+        except (OSError, ValueError):
+            logger.warning("kvbm disk block %x unreadable; dropping",
+                           seq_hash)
+            self._lru.pop(seq_hash, None)
+            return None
+
+    def pop(self, seq_hash: int) -> None:
+        entry = self._lru.pop(seq_hash, None)
+        if entry is not None:
+            try:
+                os.unlink(entry[0])
+            except OSError:
+                pass
+
+
+class TieredStore:
+    """Host + disk tiers behind one interface; disk hits promote to host."""
+
+    def __init__(self, host_blocks: int = 1024, disk_blocks: int = 0,
+                 disk_dir: Optional[str] = None) -> None:
+        self.host = HostTier(host_blocks)
+        self.disk = DiskTier(disk_blocks, disk_dir) if disk_blocks else None
+
+    def contains(self, seq_hash: int) -> bool:
+        return self.host.contains(seq_hash) or (
+            self.disk is not None and self.disk.contains(seq_hash))
+
+    def put(self, seq_hash: int, data: np.ndarray) -> None:
+        for demoted_hash, demoted in self.host.put(seq_hash, data):
+            if self.disk is not None:
+                self.disk.put(demoted_hash, demoted)
+
+    def get(self, seq_hash: int) -> Optional[np.ndarray]:
+        data = self.host.get(seq_hash)
+        if data is not None:
+            return data
+        if self.disk is None:
+            return None
+        data = self.disk.get(seq_hash)
+        if data is not None:
+            # promote: hot again, keep it a RAM copy away
+            self.put(seq_hash, data)
+        return data
+
+    def match_prefix(self, seq_hashes: list[int]) -> int:
+        """Longest leading chain of blocks present in any tier."""
+        n = 0
+        for h in seq_hashes:
+            if not self.contains(h):
+                break
+            n += 1
+        return n

@@ -1,0 +1,27 @@
+"""Every module in the package must import cleanly.
+
+Round-1 shipped `dynamo_tpu.kvbm` re-exporting modules that did not exist;
+nothing imported it, so nothing caught it. This walk makes a broken import
+a test failure forever after.
+"""
+
+import importlib
+import pathlib
+
+import pytest
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent / "dynamo_tpu"
+
+
+def _module_names():
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        rel = path.relative_to(PKG_ROOT.parent)
+        parts = list(rel.with_suffix("").parts)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        yield ".".join(parts)
+
+
+@pytest.mark.parametrize("name", list(_module_names()))
+def test_module_imports(name):
+    importlib.import_module(name)

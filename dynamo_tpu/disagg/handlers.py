@@ -190,7 +190,10 @@ class DecodeWorkerHandler:
             # no decode needed; the pulled KV is simply dropped
             yield {"token_ids": [first_token], "finish_reason": "length"}
             return
-        if first_token in (orig_stop.get("stop_token_ids") or ()):
+        if first_token in (orig_stop.get("stop_token_ids") or ()) \
+                and (orig_stop.get("min_tokens") or 0) <= 1:
+            # min_tokens suppresses the stop exactly like the local path's
+            # _emit_token (generated=1 here)
             yield {"token_ids": [first_token], "finish_reason": "stop"}
             return
         yield {"token_ids": [first_token]}

@@ -122,16 +122,19 @@ class PagePool:
             matched = matched[:(total_len - 1) // self.page_size]
         need_pages = (total_len + self.page_size - 1) // self.page_size
         fresh_needed = need_pages - len(matched)
+        # acquire matched pages FIRST: they may be sitting in _inactive and
+        # must leave the LRU before any eviction can pick them as victims
+        for pid in matched:
+            self.acquire(pid)
+        pages = list(matched)
         if len(self._free) + len(self._inactive) < fresh_needed:
+            self.release_sequence(pages)
             return None
         # pre-evict the whole deficit now: one batched offload-hook call
         # instead of one device sync per page inside the allocate loop
         deficit = fresh_needed - len(self._free)
         if deficit > 0:
             self._evict_many(deficit)
-        for pid in matched:
-            self.acquire(pid)
-        pages = list(matched)
         for _ in range(fresh_needed):
             pid = self.allocate_page()
             if pid is None:  # raced our own estimate (shouldn't happen)

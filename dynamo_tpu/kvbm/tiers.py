@@ -163,7 +163,10 @@ class TieredStore:
             return None
         data = self.disk.get(seq_hash)
         if data is not None:
-            # promote: hot again, keep it a RAM copy away
+            # promote: hot again, keep it a RAM copy away — and free the
+            # disk slot (a lingering entry would double-count the block
+            # against disk capacity and strand its file)
+            self.disk.pop(seq_hash)
             self.put(seq_hash, data)
         return data
 

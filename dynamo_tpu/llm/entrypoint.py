@@ -106,3 +106,46 @@ def wire_engine_events(runtime: DistributedRuntime,
                 bus.publish(m_subject, payload))
 
     return event_sink, metrics_sink
+
+
+def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
+                     num_pages: int = 2048, max_batch_size: int = 8,
+                     decode_steps_per_sync: int = 8, mesh=None,
+                     worker_id: int = 0, dp_rank: int = 0,
+                     random_init: bool = False, kvbm_host_blocks: int = 0,
+                     **model_overrides):
+    """(TpuEngine, ModelDeploymentCard) for a real checkpoint.
+
+    Resolves `model` (dir or HF-cache name, loader.resolve_model), loads
+    safetensors weights into the engine's layout, and fills the card so
+    frontends build the matching HF tokenizer. `random_init=True` skips
+    the weight read (benchmarks on synthetic weights). `model_overrides`
+    tune geometry, e.g. ``max_pages_per_seq`` to bound context.
+    """
+    import os
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.models.loader import (
+        config_from_hf,
+        load_llama_params,
+        resolve_model,
+    )
+
+    path = resolve_model(model)
+    cfg = config_from_hf(path, **model_overrides)
+    params = None if random_init else load_llama_params(path, cfg)
+    engine = TpuEngine(
+        TpuEngineConfig(model=cfg, num_pages=num_pages,
+                        max_batch_size=max_batch_size,
+                        decode_steps_per_sync=decode_steps_per_sync,
+                        mesh=mesh, worker_id=worker_id, dp_rank=dp_rank),
+        params=params)
+    if kvbm_host_blocks:
+        from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
+
+        KvbmManager(engine, KvbmConfig(host_blocks=kvbm_host_blocks))
+    card = ModelDeploymentCard(
+        name=served_name or os.path.basename(path.rstrip("/")),
+        tokenizer_kind="hf", tokenizer_path=path, model_path=path,
+        context_length=cfg.context_length, kv_block_size=cfg.page_size)
+    return engine, card

@@ -37,6 +37,7 @@ class ModelDeploymentCard:
     endpoint: str = "generate"
     tokenizer_kind: str = "word"    # word | byte | hf
     tokenizer_path: str = ""
+    model_path: str = ""            # checkpoint dir (local_model.rs:449)
     context_length: int = 8192
     kv_block_size: int = 16
     migration_limit: int = 0

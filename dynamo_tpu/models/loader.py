@@ -1,0 +1,187 @@
+"""Checkpoint loading: HF-style safetensors → the engine's param pytree.
+
+Reference: `lib/llm/src/local_model.rs:449` (LocalModel resolution) and
+`lib/llm/src/hub.rs` (HF-hub cache lookup). Zero-egress environment: we
+resolve local directories and already-downloaded HF cache snapshots — no
+network fetch path.
+
+Layout mapping (HF `LlamaForCausalLM` → models/llama.py init_params):
+
+  model.embed_tokens.weight            (V, E)      → embed       (V, E)
+  .layers.{i}.self_attn.q_proj.weight  (H·D, E)    → wq[i]       (E, H·D)ᵀ
+  .layers.{i}.self_attn.k_proj.weight  (KVH·D, E)  → wk[i]       (E, KVH·D)ᵀ
+  .layers.{i}.self_attn.v_proj.weight  (KVH·D, E)  → wv[i]       (E, KVH·D)ᵀ
+  .layers.{i}.self_attn.o_proj.weight  (E, H·D)    → wo[i]       (H·D, E)ᵀ
+  .layers.{i}.mlp.gate_proj.weight     (F, E)      → w_gate[i]   (E, F)ᵀ
+  .layers.{i}.mlp.up_proj.weight       (F, E)      → w_up[i]     (E, F)ᵀ
+  .layers.{i}.mlp.down_proj.weight     (E, F)      → w_down[i]   (F, E)ᵀ
+  .layers.{i}.input_layernorm.weight   (E,)        → attn_norm[i] (fp32)
+  .layers.{i}.post_attention_layernorm (E,)        → mlp_norm[i]  (fp32)
+  model.norm.weight                    (E,)        → final_norm   (fp32)
+  lm_head.weight                       (V, E)      → lm_head     (E, V)ᵀ
+                                       (tied ⇒ embedᵀ)
+
+RoPE: transformers checkpoints use the rotate-half convention (q/k weights
+already permuted from Meta's interleaved layout), which is exactly what
+models/llama.py `rope` computes — weights load without re-permutation.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from dynamo_tpu.models.llama import LlamaConfig
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_model(name_or_path: str) -> str:
+    """Local dir, or an HF-cache snapshot for `org/name` (hub.rs:~).
+
+    Raises FileNotFoundError with the looked-up locations otherwise.
+    """
+    if os.path.isdir(name_or_path):
+        return name_or_path
+    cache_root = os.environ.get(
+        "HF_HOME", os.path.expanduser("~/.cache/huggingface"))
+    repo_dir = os.path.join(
+        cache_root, "hub", "models--" + name_or_path.replace("/", "--"))
+    snapshots = sorted(
+        glob.glob(os.path.join(repo_dir, "snapshots", "*")),
+        key=os.path.getmtime, reverse=True)
+    for snap in snapshots:
+        if glob.glob(os.path.join(snap, "*.safetensors")) or \
+                os.path.exists(os.path.join(snap, "config.json")):
+            return snap
+    raise FileNotFoundError(
+        f"model '{name_or_path}' is neither a directory nor a cached HF "
+        f"snapshot (looked in {repo_dir}; this environment cannot download)")
+
+
+def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
+    """LlamaConfig from a checkpoint dir's config.json."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if "llama" not in arch.lower() and "mistral" not in arch.lower():
+        logger.warning("loading %s with the llama-family loader", arch)
+    hidden = hf["hidden_size"]
+    heads = hf["num_attention_heads"]
+    cfg = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hidden,
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim") or hidden // heads,
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+    )
+    cfg.update(overrides)
+    return LlamaConfig(**cfg)
+
+
+class _TensorIndex:
+    """name → numpy array across one or many .safetensors shards."""
+
+    def __init__(self, path: str) -> None:
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.path = path
+        index_path = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self._map = json.load(f)["weight_map"]
+        else:
+            files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+            if not files:
+                raise FileNotFoundError(f"no .safetensors under {path}")
+            self._map = {}
+            for fp in files:
+                with safe_open(fp, framework="np") as f:
+                    for name in f.keys():
+                        self._map[name] = os.path.basename(fp)
+        self._handles: dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._map
+
+    def get(self, name: str) -> np.ndarray:
+        fname = self._map[name]
+        h = self._handles.get(fname)
+        if h is None:
+            h = self._safe_open(os.path.join(self.path, fname),
+                                framework="np")
+            self._handles[fname] = h
+        t = h.get_tensor(name)
+        if t.dtype.kind == "V":  # bfloat16 loads as void through numpy
+            import ml_dtypes
+
+            t = t.view(ml_dtypes.bfloat16)
+        return t
+
+    def close(self) -> None:
+        self._handles.clear()
+
+
+def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
+    """Host-numpy param pytree in init_params' layout. Dense weights are
+    cast to cfg.dtype, norms to fp32 (matching init_params)."""
+    import ml_dtypes
+
+    w_dtype = np.dtype(ml_dtypes.bfloat16) \
+        if cfg.dtype.__name__ == "bfloat16" else np.dtype(cfg.dtype.__name__)
+    idx = _TensorIndex(path)
+    L = cfg.num_layers
+
+    def dense(name: str, transpose: bool = True) -> np.ndarray:
+        t = idx.get(name)
+        if transpose:
+            t = t.T
+        return np.ascontiguousarray(t).astype(w_dtype)
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([dense(fmt.format(i)) for i in range(L)])
+
+    def stack_norm(fmt: str) -> np.ndarray:
+        return np.stack([idx.get(fmt.format(i)).astype(np.float32)
+                         for i in range(L)])
+
+    p = "model.layers.{}."
+    params = {
+        "embed": dense("model.embed_tokens.weight", transpose=False),
+        "layers": {
+            "attn_norm": stack_norm(p + "input_layernorm.weight"),
+            "wq": stack(p + "self_attn.q_proj.weight"),
+            "wk": stack(p + "self_attn.k_proj.weight"),
+            "wv": stack(p + "self_attn.v_proj.weight"),
+            "wo": stack(p + "self_attn.o_proj.weight"),
+            "mlp_norm": stack_norm(p + "post_attention_layernorm.weight"),
+            "w_gate": stack(p + "mlp.gate_proj.weight"),
+            "w_up": stack(p + "mlp.up_proj.weight"),
+            "w_down": stack(p + "mlp.down_proj.weight"),
+        },
+        "final_norm": idx.get("model.norm.weight").astype(np.float32),
+    }
+    if "lm_head.weight" in idx:
+        params["lm_head"] = dense("lm_head.weight")
+    else:  # tie_word_embeddings
+        params["lm_head"] = np.ascontiguousarray(params["embed"].T)
+    idx.close()
+    return params
+
+
+def load_model(name_or_path: str, **cfg_overrides: Any
+               ) -> tuple[LlamaConfig, dict]:
+    """(config, host params) for a local/cached checkpoint."""
+    path = resolve_model(name_or_path)
+    cfg = config_from_hf(path, **cfg_overrides)
+    return cfg, load_llama_params(path, cfg)

@@ -112,3 +112,32 @@ def test_capacity_exhaustion_returns_none():
     alloc = pool.allocate_sequence(hashes(list(range(12))), 12)
     assert alloc is not None
     assert pool.allocate_sequence(hashes(list(range(100, 104))), 4) is None
+
+
+def test_matched_inactive_pages_survive_pre_eviction():
+    """Regression: with the free list empty and the prefix-matched pages
+    sitting in the inactive LRU, allocate_sequence must acquire them (not
+    evict them as deficit victims) and evict only unrelated pages."""
+    pool = PagePool(num_pages=8, page_size=4)       # 7 usable
+    toks_a = list(range(8))                          # 2 blocks
+    seq_a = TokenBlockSequence(4, toks_a)
+    pages_a, _ = pool.allocate_sequence(hashes(toks_a), 8)
+    for blk in seq_a.blocks:
+        pool.register_page(pages_a[blk.block_index], blk.seq_hash,
+                           blk.local_hash, blk.parent_seq_hash)
+    toks_b = list(range(100, 108))
+    seq_b = TokenBlockSequence(4, toks_b)
+    pages_b, _ = pool.allocate_sequence(hashes(toks_b), 8)
+    for blk in seq_b.blocks:
+        pool.register_page(pages_b[blk.block_index], blk.seq_hash,
+                           blk.local_hash, blk.parent_seq_hash)
+    extra = [pool.allocate_page() for _ in range(3)]  # drain the free list
+    assert all(p is not None for p in extra) and not pool.can_allocate(5)
+    pool.release_sequence(pages_a)                   # A+B now inactive LRU
+    pool.release_sequence(pages_b)
+    # re-request A (prefix hit) + 2 fresh pages: must evict from B, not A
+    alloc = pool.allocate_sequence(hashes(toks_a + list(range(200, 208))), 16)
+    assert alloc is not None
+    pages, cached = alloc
+    assert cached == 8
+    assert pages[:2] == pages_a                      # matched, not evicted

@@ -1,0 +1,138 @@
+"""Real-weight loading: safetensors fixture (written by transformers) →
+engine params; logits must match the transformers forward pass.
+
+Reference parity target: `lib/llm/src/local_model.rs:449` / `hub.rs`
+(resolution) and the requirement that a served model is the *same
+function* as its checkpoint.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.attention import set_attention_impl
+
+set_attention_impl("xla")
+
+HF_CFG = dict(
+    vocab_size=128, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+    tie_word_embeddings=False,
+)
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    """Random-weight HF Llama checkpoint saved as safetensors."""
+    import torch
+    from transformers import LlamaConfig as HfLlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(HfLlamaConfig(**HF_CFG))
+    path = tmp_path_factory.mktemp("llama-tiny-ckpt")
+    model.save_pretrained(str(path), safe_serialization=True)
+    return str(path), model
+
+
+def test_resolve_model_dir_and_missing(checkpoint, tmp_path):
+    from dynamo_tpu.models.loader import resolve_model
+
+    path, _ = checkpoint
+    assert resolve_model(path) == path
+    with pytest.raises(FileNotFoundError):
+        resolve_model("no-such/model-anywhere")
+
+
+def test_config_from_hf(checkpoint):
+    from dynamo_tpu.models.loader import config_from_hf
+
+    path, _ = checkpoint
+    cfg = config_from_hf(path, page_size=8, max_pages_per_seq=16)
+    assert cfg.vocab_size == 128 and cfg.num_layers == 2
+    assert cfg.num_heads == 4 and cfg.num_kv_heads == 2
+    assert cfg.head_dim == 16 and cfg.page_size == 8
+
+
+def test_logits_match_transformers(checkpoint):
+    import torch
+
+    from dynamo_tpu.models.llama import init_cache, prefill_step
+    from dynamo_tpu.models.loader import config_from_hf, load_llama_params
+
+    path, hf_model = checkpoint
+    cfg = config_from_hf(path, dtype=jnp.float32, page_size=8,
+                         max_pages_per_seq=8)
+    params = load_llama_params(path, cfg)
+
+    prompt = [3, 17, 42, 99, 7, 55, 21, 90, 11, 64]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt])).logits[0].numpy()
+
+    k_cache, v_cache = init_cache(cfg, num_pages=16)
+    T = 16
+    padded = np.zeros(T, dtype=np.int32)
+    padded[:len(prompt)] = prompt
+    page_table = np.arange(1, cfg.max_pages_per_seq + 1, dtype=np.int32)
+    logits, _, _ = prefill_step(
+        params, k_cache, v_cache, jnp.asarray(padded),
+        jnp.asarray(page_table), jnp.int32(0), jnp.int32(len(prompt)), cfg)
+    ours = np.asarray(logits)
+
+    np.testing.assert_allclose(ours, ref[len(prompt) - 1], rtol=2e-3,
+                               atol=2e-3)
+    # same argmax ⇒ identical greedy decoding
+    assert int(ours.argmax()) == int(ref[len(prompt) - 1].argmax())
+
+
+def test_tied_embeddings_fallback(checkpoint, tmp_path):
+    """Checkpoints without lm_head.weight fall back to embedᵀ."""
+    import torch
+    from transformers import LlamaConfig as HfLlamaConfig, LlamaForCausalLM
+
+    from dynamo_tpu.models.loader import config_from_hf, load_llama_params
+
+    torch.manual_seed(1)
+    tied_cfg = dict(HF_CFG, tie_word_embeddings=True)
+    model = LlamaForCausalLM(HfLlamaConfig(**tied_cfg))
+    path = str(tmp_path / "tied")
+    model.save_pretrained(path, safe_serialization=True)
+    cfg = config_from_hf(path, dtype=jnp.float32, page_size=8,
+                         max_pages_per_seq=8)
+    params = load_llama_params(path, cfg)
+    np.testing.assert_array_equal(params["lm_head"], params["embed"].T)
+
+
+async def test_engine_serves_loaded_checkpoint(checkpoint):
+    """End-to-end: build_tpu_engine on the checkpoint dir; greedy engine
+    output equals transformers greedy generation."""
+    import torch
+
+    from dynamo_tpu.llm.entrypoint import build_tpu_engine
+    from dynamo_tpu.runtime.context import Context
+
+    path, hf_model = checkpoint
+    engine, card = build_tpu_engine(
+        path, served_name="tiny", num_pages=32, max_batch_size=2,
+        decode_steps_per_sync=2, dtype=jnp.float32, page_size=8,
+        max_pages_per_seq=8)
+    try:
+        assert card.model_path == path and card.tokenizer_kind == "hf"
+        prompt = [5, 9, 23, 51, 3, 78, 12, 34]
+        n_new = 6
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor([prompt]), max_new_tokens=n_new,
+                do_sample=False)[0, len(prompt):].tolist()
+        req = {"token_ids": prompt, "model": "tiny",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": n_new}}
+        got = [t async for o in engine.generate(req, Context())
+               for t in o.get("token_ids", ())]
+        assert got == ref
+    finally:
+        await engine.close()

@@ -35,7 +35,7 @@ from dynamo_tpu.models.llama import (
     decode_multi_step,
     init_cache,
     init_params,
-    prefill_step,
+    prefill_batch,
 )
 from dynamo_tpu.protocols import (
     FINISH_CANCELLED,
@@ -345,45 +345,72 @@ class TpuEngine:
     # -- prefill ------------------------------------------------------------
 
     async def _prefill_pending(self) -> bool:
-        """Prefill every admitted-but-unprefilled sequence, then sample all
-        their first tokens in ONE device call + ONE host sync. The prefill
-        dispatches queue back-to-back on the device; only the final sampled
-        batch crosses back to the host."""
+        """Prefill every admitted-but-unprefilled sequence with BATCHED
+        chunk rounds (prefill_batch): each round streams the weights once
+        for all pending sequences, then all first tokens are sampled in one
+        device call + ONE host sync."""
         pending = [s for s in self._running if not s.prefilled]
         if not pending:
             return False
         mcfg, cfg = self.model_cfg, self.config
 
         def prefill_all():
-            last_logits = []
             for seq in pending:
                 if seq.import_kv is not None:
                     data, n_tok = seq.import_kv
                     n_pages = (n_tok + mcfg.page_size - 1) // mcfg.page_size
                     self.write_kv_pages(seq.pages[:n_pages], data)
                     seq.import_kv = None
-                page_table = np.zeros(mcfg.max_pages_per_seq, dtype=np.int32)
-                page_table[:len(seq.pages)] = seq.pages
-                pt_dev = jax.numpy.asarray(page_table)
-                offset = seq.cached_len
-                logits = None
-                while offset < len(seq.prompt):
-                    chunk = seq.prompt[offset:offset + cfg.prefill_chunk]
-                    bucket = _next_pow2(len(chunk), cfg.min_prefill_bucket,
-                                        cfg.prefill_chunk)
-                    padded = np.zeros(bucket, dtype=np.int32)
-                    padded[:len(chunk)] = chunk
-                    logits, self.k_cache, self.v_cache = prefill_step(
-                        self.params, self.k_cache, self.v_cache,
-                        jax.numpy.asarray(padded), pt_dev,
-                        np.int32(offset), np.int32(offset + len(chunk)),
-                        mcfg)
-                    offset += len(chunk)
-                last_logits.append(logits)
+            offsets = {id(s): s.cached_len for s in pending}
+            last_logits: dict[int, Any] = {}
+            while True:
+                ready = [s for s in pending
+                         if offsets[id(s)] < len(s.prompt)]
+                if not ready:
+                    break
+                # rounds are grouped by page-alignment of the cached
+                # offset: mid-page starts (disagg imports) need the row
+                # write path — batching them with aligned lanes would
+                # drag everyone onto it
+                aligned_s = [s for s in ready
+                             if offsets[id(s)] % mcfg.page_size == 0]
+                active = aligned_s or ready
+                aligned = bool(aligned_s)
+                # pow2 batch width: compiles stay bounded to log2 widths
+                # per bucket while low-concurrency prefill (compute-bound,
+                # unlike decode) avoids paying max_batch_size× the FLOPs
+                bp = _next_pow2(len(active), 1, cfg.max_batch_size)
+                active = active[:bp]
+                chunk_lens = [min(len(s.prompt) - offsets[id(s)],
+                                  cfg.prefill_chunk) for s in active]
+                t_bucket = _next_pow2(max(chunk_lens),
+                                      cfg.min_prefill_bucket,
+                                      cfg.prefill_chunk)
+                toks = np.zeros((bp, t_bucket), dtype=np.int32)
+                tables = np.zeros((bp, mcfg.max_pages_per_seq),
+                                  dtype=np.int32)
+                cached = np.zeros(bp, dtype=np.int32)
+                seq_lens = np.zeros(bp, dtype=np.int32)
+                for i, s in enumerate(active):
+                    off, n = offsets[id(s)], chunk_lens[i]
+                    toks[i, :n] = s.prompt[off:off + n]
+                    tables[i, :len(s.pages)] = s.pages
+                    cached[i] = off
+                    seq_lens[i] = off + n
+                logits_b, self.k_cache, self.v_cache = prefill_batch(
+                    self.params, self.k_cache, self.v_cache,
+                    jax.numpy.asarray(toks), jax.numpy.asarray(tables),
+                    jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
+                    mcfg, aligned)
+                for i, s in enumerate(active):
+                    offsets[id(s)] += chunk_lens[i]
+                    if offsets[id(s)] >= len(s.prompt):
+                        last_logits[id(s)] = logits_b[i]
             # pad to a fixed width so sampling compiles exactly once
             width = cfg.max_batch_size
-            while len(last_logits) < width:
-                last_logits.append(last_logits[0])
+            stack = [last_logits[id(s)] for s in pending]
+            while len(stack) < width:
+                stack.append(stack[0])
 
             def arr(fn, dtype):
                 vals = [fn(s) for s in pending]
@@ -391,7 +418,7 @@ class TpuEngine:
                 return np.asarray(vals, dtype=dtype)
 
             sampled = sample_tokens(
-                jax.numpy.stack(last_logits),
+                jax.numpy.stack(stack),
                 arr(lambda s: s.seed, np.uint32),
                 arr(lambda s: s.generated, np.uint32),
                 arr(lambda s: s.req.sampling.temperature, np.float32),
@@ -563,19 +590,27 @@ class TpuEngine:
             return await asyncio.to_thread(self._read_kv_pages_sync, page_ids)
 
     def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
+        """Host copy (2, L, KVH, n, P, D) — the wire/tier format. Caches
+        are per-layer tuples on device; one stacked device gather + one
+        transfer."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        k_sel = np.asarray(self.k_cache[:, :, ids])
-        v_sel = np.asarray(self.v_cache[:, :, ids])
+        k_sel = np.asarray(jax.numpy.stack(
+            [kc[:, ids] for kc in self.k_cache]))
+        v_sel = np.asarray(jax.numpy.stack(
+            [vc[:, ids] for vc in self.v_cache]))
         return np.stack([k_sel, v_sel])
 
     def write_kv_pages(self, page_ids: list[int], data: np.ndarray) -> None:
         """Only call from within the scheduler's device-locked step (the
         prefill path does, for disagg imports)."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        k_new = jax.numpy.asarray(data[0], dtype=self.model_cfg.dtype)
-        v_new = jax.numpy.asarray(data[1], dtype=self.model_cfg.dtype)
-        self.k_cache = self.k_cache.at[:, :, ids].set(k_new)
-        self.v_cache = self.v_cache.at[:, :, ids].set(v_new)
+        dtype = self.model_cfg.dtype
+        self.k_cache = tuple(
+            kc.at[:, ids].set(jax.numpy.asarray(data[0, l], dtype=dtype))
+            for l, kc in enumerate(self.k_cache))
+        self.v_cache = tuple(
+            vc.at[:, ids].set(jax.numpy.asarray(data[1, l], dtype=dtype))
+            for l, vc in enumerate(self.v_cache))
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown

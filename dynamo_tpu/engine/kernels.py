@@ -73,3 +73,51 @@ def paged_kv_write(kc: jax.Array, vc: jax.Array, k: jax.Array, v: jax.Array,
         input_output_aliases={4: 0, 5: 1},  # kc/vc updated in place
     )(page_ids.astype(jnp.int32), offsets.astype(jnp.int32), k, v, kc, vc)
     return out_kc, out_vc
+
+
+def paged_kv_write_pages(kc: jax.Array, vc: jax.Array,
+                         k_blocks: jax.Array, v_blocks: jax.Array,
+                         page_ids: jax.Array
+                         ) -> tuple[jax.Array, jax.Array]:
+    """Full-page KV store for prefill: kc/vc (KVH, N, P, D); k_blocks/
+    v_blocks (M, KVH, P, D) — one complete page of rows per entry;
+    page_ids (M,) destination pages (0 ⇒ scratch, for padding slots).
+
+    Unlike `paged_kv_write` (row blend: DMA page in, overwrite one row,
+    DMA out) this is a pure store — no read-back — and runs one program
+    per PAGE rather than per token. Unwritten tail rows of a partially
+    filled final page carry garbage that is (a) masked by attention's
+    length mask and (b) overwritten by decode's row-blend writes later.
+    Measured: row path on a (16 seqs × 128 tok) prefill round = 2048
+    programs/layer ≈ 143 ms per engine prefill; page path = 128
+    programs/layer.
+    """
+    pl, pltpu = _pltpu()
+    kvh, n_pages, p, d = kc.shape
+    m = k_blocks.shape[0]
+
+    def kernel(pid_ref, k_ref, v_ref, kc_in, vc_in, kc_out, vc_out):
+        kc_out[...] = k_ref[0][:, None]
+        vc_out[...] = v_ref[0][:, None]
+
+    page_block = pl.BlockSpec(
+        (kvh, 1, p, d), lambda i, pid_ref: (0, pid_ref[i], 0, 0))
+    src_block = pl.BlockSpec((1, kvh, p, d), lambda i, pid_ref: (i, 0, 0, 0))
+    # aliased cache INPUTS get a constant minimal block: the kernel fully
+    # overwrites each destination page, so fetching the old page contents
+    # (a full page DMA-in per program) would only burn bandwidth
+    dummy_block = pl.BlockSpec((1, 1, p, d), lambda i, pid_ref: (0, 0, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[src_block, src_block, dummy_block, dummy_block],
+        out_specs=[page_block, page_block],
+    )
+    out_kc, out_vc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(kc.shape, kc.dtype),
+                   jax.ShapeDtypeStruct(vc.shape, vc.dtype)],
+        input_output_aliases={3: 0, 4: 1},
+    )(page_ids.astype(jnp.int32), k_blocks, v_blocks, kc, vc)
+    return out_kc, out_vc

@@ -57,8 +57,8 @@ def param_specs() -> dict:
 
 
 def cache_spec() -> P:
-    # (L, KVH, N, P, D): kv heads over tp
-    return P(None, "tp", None, None, None)
+    # per-layer (KVH, N, P, D): kv heads over tp
+    return P("tp", None, None, None)
 
 
 def param_sharding(mesh: Mesh) -> dict:

@@ -69,7 +69,7 @@ def test_decode_matches_full_prefill():
                              jnp.asarray(valid), CFG)
 
     l2, _, _ = full_prefill_logits(params, CFG, tokens + [42], pt)
-    assert float(jnp.max(jnp.abs(l2 - dl[0]))) < 2e-2
+    assert float(jnp.max(jnp.abs(l2 - dl[0]))) < 4e-2  # bf16 tolerance
 
 
 def test_chunked_prefill_matches_full():
@@ -86,7 +86,7 @@ def test_chunked_prefill_matches_full():
     pad4[:3] = tokens[8:]
     l2, kc2, vc2 = prefill_step(params, kc2, vc2, jnp.asarray(pad4), pt,
                                 jnp.int32(8), jnp.int32(11), CFG)
-    assert float(jnp.max(jnp.abs(l2 - full))) < 2e-2
+    assert float(jnp.max(jnp.abs(l2 - full))) < 4e-2  # bf16 tolerance
 
 
 def test_padding_lanes_do_not_corrupt_cache():
@@ -132,7 +132,7 @@ def test_tp_sharded_decode_matches_single(tp, cpu_mesh_devices):
     logits, skc, svc = prefill_step(
         sp, skc, svc, jnp.asarray(padded), pt,
         jnp.int32(0), jnp.int32(len(tokens)), cfg)
-    assert float(jnp.max(jnp.abs(logits - ref_logits))) < 2e-2
+    assert float(jnp.max(jnp.abs(logits - ref_logits))) < 4e-2  # bf16 tolerance
 
     B = 2
     toks = np.array([42, 0], dtype=np.int32)
@@ -164,4 +164,4 @@ def test_param_specs_cover_params():
     jax.tree.map(
         check, params, specs,
         is_leaf=lambda x: not isinstance(x, dict))
-    assert len(cache_spec()) == 5
+    assert len(cache_spec()) == 4  # per-layer (KVH, N, P, D)

@@ -1,0 +1,69 @@
+"""Shared CLI plumbing for the `python -m dynamo_tpu.*` components.
+
+Reference: every L4 component is a `python -m dynamo.<comp>` argparse CLI
+(`components/src/dynamo/frontend/main.py:4-16`, `vllm/main.py`); flags
+layer over `RuntimeConfig` env (`DYN_*`) the way figment does in
+`lib/runtime/src/config.rs:214-226`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+from typing import Optional
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+
+
+def add_runtime_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--store", default=None,
+                   help="control-plane store url: memory | tcp://host:port "
+                        "(default: DYN_STORE_URL env or memory)")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--system-port", type=int, default=None,
+                   help="system status server port (health/metrics)")
+    p.add_argument("--lease-ttl", type=float, default=None)
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"])
+
+
+def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
+    cfg = RuntimeConfig.from_env()
+    if args.store is not None:
+        cfg.store_url = args.store
+    if getattr(args, "system_port", None) is not None:
+        cfg.system_port = args.system_port
+    if getattr(args, "lease_ttl", None) is not None:
+        cfg.lease_ttl = args.lease_ttl
+    return cfg
+
+
+def setup_logging(level: str) -> None:
+    from dynamo_tpu.runtime.logging_util import init_logging
+
+    init_logging(level.upper())
+
+
+def run_until_signal(main_coro_factory, *, shutdown=None) -> None:
+    """asyncio.run a service until SIGINT/SIGTERM.
+
+    `main_coro_factory()` must return (started) objects with an optional
+    async `stop()`/`close()`; `shutdown(objs)` overrides teardown.
+    """
+
+    async def runner():
+        stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop_event.set)
+        objs = await main_coro_factory()
+        try:
+            await stop_event.wait()
+        finally:
+            logging.getLogger(__name__).info("shutting down")
+            if shutdown is not None:
+                await shutdown(objs)
+
+    asyncio.run(runner())

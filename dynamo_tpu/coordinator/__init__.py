@@ -1,0 +1,7 @@
+"""Coordinator service: the control-plane store server.
+
+`python -m dynamo_tpu.coordinator --port 6379` runs the TCP lease-KV
+coordinator every other component points its `--store tcp://host:port`
+at — the deployment role etcd plays for the reference
+(`docs/architecture/architecture.md:21-28`).
+"""

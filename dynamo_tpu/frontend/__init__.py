@@ -1,0 +1,5 @@
+"""Frontend component: OpenAI HTTP server + model discovery.
+
+`python -m dynamo_tpu.frontend` — the analog of
+`components/src/dynamo/frontend/main.py`.
+"""

@@ -1,0 +1,74 @@
+"""`python -m dynamo_tpu.frontend` — OpenAI HTTP frontend.
+
+Reference: `components/src/dynamo/frontend/main.py:4-16,342` (router-mode
+flags, port, namespace → make_engine + run_input).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from dynamo_tpu.cli_util import (
+    add_runtime_args,
+    run_until_signal,
+    runtime_config_from_args,
+    setup_logging,
+)
+from dynamo_tpu.router.kv_router import KvRouterConfig
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.frontend",
+        description="dynamo_tpu OpenAI HTTP frontend")
+    add_runtime_args(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--router-mode", default=None,
+                   choices=["kv", "round_robin", "random"],
+                   help="override each model card's router mode")
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--no-kv-events", action="store_true",
+                   help="use the TTL-based approx indexer instead of "
+                        "engine KV events")
+    p.add_argument("--router-replica-sync", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    setup_logging(args.log_level)
+
+    async def start():
+        from dynamo_tpu.llm.entrypoint import start_frontend
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        cfg = runtime_config_from_args(args)
+        rt = await DistributedRuntime.create(cfg)
+        router_cfg = KvRouterConfig(
+            overlap_weight=args.kv_overlap_score_weight,
+            temperature=args.router_temperature,
+            use_kv_events=not args.no_kv_events,
+            replica_sync=args.router_replica_sync,
+        )
+        fe = await start_frontend(rt, host=args.host, port=args.port,
+                                  router_config=router_cfg,
+                                  router_mode_override=args.router_mode,
+                                  namespace=args.namespace)
+        print(f"FRONTEND_READY {fe.url}", flush=True)
+        return rt, fe
+
+    async def stop(objs):
+        rt, fe = objs
+        await fe.stop()
+        await rt.close()
+
+    run_until_signal(start, shutdown=stop)
+
+
+if __name__ == "__main__":
+    main()

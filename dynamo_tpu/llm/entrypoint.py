@@ -44,11 +44,17 @@ class Frontend:
 
 async def start_frontend(runtime: DistributedRuntime,
                          host: str = "127.0.0.1", port: int = 0,
-                         router_config: Optional[KvRouterConfig] = None
-                         ) -> Frontend:
-    """HTTP frontend: model discovery + OpenAI server (Input::Http)."""
+                         router_config: Optional[KvRouterConfig] = None,
+                         router_mode_override: Optional[str] = None,
+                         namespace: Optional[str] = None) -> Frontend:
+    """HTTP frontend: model discovery + OpenAI server (Input::Http).
+
+    `router_mode_override` must be set before the watcher's initial MDC
+    scan builds pipelines; `namespace` (if set) restricts discovery to
+    cards in that namespace."""
     manager = ModelManager(runtime, router_config)
-    watcher = await ModelWatcher(manager).start()
+    manager.router_mode_override = router_mode_override
+    watcher = await ModelWatcher(manager, namespace=namespace).start()
     http = HttpService(manager, host, port)
     await http.start()
     return Frontend(runtime, manager, watcher, http)

@@ -44,6 +44,9 @@ class ModelManager:
                  ) -> None:
         self.runtime = runtime
         self.router_config = router_config
+        # CLI `--router-mode` overrides every card's router_mode
+        # (frontend/main.py:4-16 flag semantics)
+        self.router_mode_override: Optional[str] = None
         self._models: dict[str, ModelEntry] = {}
 
     def model_names(self) -> list[str]:
@@ -68,13 +71,20 @@ class ModelManager:
         client = await ep.client()
         await client.start()
         kv_router: Optional[KvPushRouter] = None
-        if card.router_mode == "kv":
-            cfg = self.router_config or KvRouterConfig(
+        router_mode = self.router_mode_override or card.router_mode
+        if router_mode == "kv":
+            # the card's kv_block_size always wins: events are hashed at
+            # the engine's block granularity, so a frontend-supplied
+            # config with a different block size would silently mis-index
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                self.router_config or KvRouterConfig(),
                 block_size=card.kv_block_size)
             kv_router = await KvPushRouter(client, rt.events, cfg).start()
             router_engine: AsyncEngine = kv_router
         else:
-            router_engine = PushRouter(client, mode=card.router_mode)
+            router_engine = PushRouter(client, mode=router_mode)
         tokenizer = make_tokenizer(card.tokenizer_kind, card.tokenizer_path)
         engine = build_pipeline(
             OpenAIPreprocessor(tokenizer, card.name, card.context_length),
@@ -113,8 +123,11 @@ class ModelWatcher:
     """Watches ``v1/mdc/`` and drives the ModelManager
     (discovery/watcher.rs:49,60+)."""
 
-    def __init__(self, manager: ModelManager) -> None:
+    def __init__(self, manager: ModelManager,
+                 namespace: Optional[str] = None) -> None:
         self.manager = manager
+        # only cards in this namespace are served (None = all)
+        self.namespace = namespace
         self._task: Optional[asyncio.Task] = None
         self._watch = None
         # card_key -> model name (DELETE events carry only the key)
@@ -143,6 +156,8 @@ class ModelWatcher:
 
     async def _on_put(self, key: str, value: bytes) -> None:
         card = ModelDeploymentCard.from_json(value)
+        if self.namespace is not None and card.namespace != self.namespace:
+            return
         self._key_model[key] = card.name
         await self.manager.add_model(card, key)
 

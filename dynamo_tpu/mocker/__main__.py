@@ -1,0 +1,12 @@
+"""`python -m dynamo_tpu.mocker` — mocker engine worker.
+
+Reference: `components/src/dynamo/mocker/main.py`. Thin alias of
+`python -m dynamo_tpu.worker --mock`.
+"""
+
+import sys
+
+from dynamo_tpu.worker.main import main
+
+if __name__ == "__main__":
+    main(["--mock", *sys.argv[1:]])

@@ -1,0 +1,7 @@
+"""Engine worker component.
+
+`python -m dynamo_tpu.worker` — the analog of `python -m dynamo.vllm`
+(`components/src/dynamo/vllm/main.py`): boots an engine (owned TPU
+engine, mocker, or echo), registers the model card, serves `generate`
+(and `kv_pull` for prefill workers), publishes KV events + metrics.
+"""

@@ -1,0 +1,4 @@
+from dynamo_tpu.worker.main import main
+
+if __name__ == "__main__":
+    main()

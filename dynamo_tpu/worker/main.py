@@ -1,0 +1,188 @@
+"""Worker CLI implementation (see package docstring).
+
+Reference: `components/src/dynamo/vllm/main.py:69-228` — parse args,
+build engine, register endpoints + model card, serve until signal; the
+engine monitor force-exits so the lease drops when the engine dies
+(`engine_monitor.py`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+from dynamo_tpu.cli_util import (
+    add_runtime_args,
+    run_until_signal,
+    runtime_config_from_args,
+    setup_logging,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.worker",
+        description="dynamo_tpu engine worker")
+    add_runtime_args(p)
+    eng = p.add_mutually_exclusive_group()
+    eng.add_argument("--model", default=None,
+                     help="checkpoint dir or cached HF name (TPU engine)")
+    eng.add_argument("--mock", action="store_true",
+                     help="serve the mocker engine (no chips needed)")
+    eng.add_argument("--echo", action="store_true",
+                     help="serve the token-echo engine")
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--is-prefill-worker", action="store_true",
+                   help="register under <component>_prefill and serve the "
+                        "kv_pull transfer endpoint")
+    p.add_argument("--migration-limit", type=int, default=0)
+    p.add_argument("--router-mode", default="kv",
+                   choices=["kv", "round_robin", "random"])
+    p.add_argument("--instance-id", type=int, default=None)
+    # engine geometry
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--decode-steps-per-sync", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=512)
+    p.add_argument("--context-length", type=int, default=None,
+                   help="override model context (max_pages_per_seq)")
+    p.add_argument("--random-init", action="store_true",
+                   help="skip weight load (synthetic benchmarking)")
+    p.add_argument("--kvbm-host-blocks", type=int, default=0,
+                   help="enable the KVBM host tier with this many blocks")
+    # mocker knobs
+    p.add_argument("--mock-speedup", type=float, default=1.0)
+    p.add_argument("--mock-decode-ms", type=float, default=4.0)
+    p.add_argument("--mock-total-blocks", type=int, default=1024)
+    return p.parse_args(argv)
+
+
+def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
+                          instance_id: int):
+    """(engine, card) per the CLI's engine selection. The engine's
+    worker_id must equal the served instance_id: the router keys workers
+    by discovered instance_id and KV events/metrics by the engine's
+    worker_id — a mismatch silently zeroes KV-aware routing."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    component = args.component + ("_prefill" if args.is_prefill_worker
+                                  else "")
+    if args.mock:
+        from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+
+        name = args.served_model_name or "mock-model"
+        card = ModelDeploymentCard(
+            name=name, namespace=args.namespace, component=component,
+            endpoint=args.endpoint, tokenizer_kind="word",
+            tokenizer_path=name, migration_limit=args.migration_limit,
+            router_mode=args.router_mode)
+        engine = MockEngine(
+            MockEngineConfig(
+                block_size=card.kv_block_size,
+                total_kv_blocks=args.mock_total_blocks,
+                speedup=args.mock_speedup,
+                decode_ms_per_iter=args.mock_decode_ms,
+                worker_id=instance_id),
+            event_sink=event_sink, metrics_sink=metrics_sink)
+        return engine, card
+    if args.echo:
+        from dynamo_tpu.engines import EchoEngine
+
+        name = args.served_model_name or "echo"
+        card = ModelDeploymentCard(
+            name=name, namespace=args.namespace, component=component,
+            endpoint=args.endpoint, tokenizer_kind="word",
+            tokenizer_path=name, migration_limit=args.migration_limit,
+            router_mode=args.router_mode)
+        return EchoEngine(), card
+    if not args.model:
+        raise SystemExit("one of --model / --mock / --echo is required")
+
+    from dynamo_tpu.llm.entrypoint import build_tpu_engine
+
+    overrides = {}
+    if args.context_length is not None:
+        overrides["max_pages_per_seq"] = max(1, args.context_length // 16)
+    engine, card = build_tpu_engine(
+        args.model, served_name=args.served_model_name,
+        num_pages=args.num_pages, max_batch_size=args.max_batch_size,
+        decode_steps_per_sync=args.decode_steps_per_sync,
+        worker_id=instance_id,
+        random_init=args.random_init,
+        kvbm_host_blocks=args.kvbm_host_blocks, **overrides)
+    engine.config.prefill_chunk = args.prefill_chunk
+    card.namespace = args.namespace
+    card.component = component
+    card.endpoint = args.endpoint
+    card.migration_limit = args.migration_limit
+    card.router_mode = args.router_mode
+    if event_sink is not None or metrics_sink is not None:
+        engine.pool.event_sink = event_sink
+        engine.metrics_sink = metrics_sink
+    return engine, card
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    setup_logging(args.log_level)
+
+    async def start():
+        from dynamo_tpu.disagg.handlers import (
+            PrefillWorkerHandler,
+            serve_kv_pull,
+        )
+        from dynamo_tpu.llm.entrypoint import serve_engine, wire_engine_events
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.worker.monitor import EngineDeathMonitor
+
+        cfg = runtime_config_from_args(args)
+        rt = await DistributedRuntime.create(cfg)
+        # card needs the final component name before sinks are wired
+        probe_component = args.component + (
+            "_prefill" if args.is_prefill_worker else "")
+        sink_card = ModelDeploymentCard(
+            name="_", namespace=args.namespace, component=probe_component)
+        event_sink, metrics_sink = wire_engine_events(rt, sink_card)
+        instance_id = (args.instance_id if args.instance_id is not None
+                       else (os.getpid() << 16 | 1))
+        engine, card = build_engine_and_card(args, event_sink, metrics_sink,
+                                             instance_id)
+        extra = []
+        serving: object = engine
+        if args.is_prefill_worker:
+            handler = PrefillWorkerHandler(engine, instance_id)
+            serving = handler
+            extra.append(await serve_kv_pull(
+                rt, card.namespace, card.component, handler, instance_id))
+        handle = await serve_engine(rt, serving, card,
+                                    instance_id=instance_id)
+        monitor = EngineDeathMonitor(engine)
+        monitor.start()
+        print(f"WORKER_READY {card.namespace}/{card.component}/"
+              f"{card.endpoint}/{instance_id:x}", flush=True)
+        return rt, engine, handle, extra, monitor
+
+    async def stop(objs):
+        rt, engine, handle, extra, monitor = objs
+        monitor.stop()
+        await handle.stop()
+        for e in extra:
+            await e.shutdown()
+        close = getattr(engine, "close", None)
+        if close is not None:
+            await close()
+        await rt.close()
+
+    run_until_signal(start, shutdown=stop)
+
+
+if __name__ == "__main__":
+    main()

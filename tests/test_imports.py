@@ -19,6 +19,8 @@ def _module_names():
         parts = list(rel.with_suffix("").parts)
         if parts[-1] == "__init__":
             parts = parts[:-1]
+        if parts[-1] == "__main__":
+            continue  # entry scripts: importing as __main__ would run them
         yield ".".join(parts)
 
 

@@ -1,0 +1,236 @@
+"""Multi-process deployment e2e: real OS processes, real sockets, SIGKILL.
+
+Reference: `tests/fault_tolerance/test_request_migration.py:293` — start
+workers, kill the serving one mid-stream, assert the Migration operator
+finishes the stream on the survivor and the dead instance leaves the
+instance set once its lease expires.
+
+Processes: coordinator (`python -m dynamo_tpu.coordinator`) + mocker
+workers (`python -m dynamo_tpu.worker --mock`) + HTTP frontend
+(`python -m dynamo_tpu.frontend`) — every hop crosses a real socket.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.distributed import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEASE_TTL = "2.0"
+
+
+def spawn(mod, *args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["PYTHONUNBUFFERED"] = "1"
+    env["DYN_LEASE_TTL"] = LEASE_TTL
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO)
+
+
+async def wait_ready(proc, marker, timeout=30.0):
+    """Read stdout lines until the readiness marker appears."""
+    loop = asyncio.get_running_loop()
+
+    def read_until():
+        lines = []
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"process exited rc={proc.poll()} before '{marker}':\n"
+                    + "".join(lines[-30:]))
+            lines.append(line)
+            if marker in line:
+                return line.strip()
+
+    return await asyncio.wait_for(loop.run_in_executor(None, read_until),
+                                  timeout)
+
+
+@pytest.fixture
+def procs():
+    running = []
+    yield running
+    for p in running:
+        if p.poll() is None:
+            p.kill()
+    for p in running:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+async def sse_tokens(session, url, body):
+    """POST a streaming chat completion; yield content deltas."""
+    async with session.post(url, json=body) as resp:
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == "[DONE]":
+                return
+            yield json.loads(payload)
+
+
+async def test_sigkill_mid_stream_migrates(procs):
+    store_port = free_port()
+    http_port = free_port()
+    store = f"tcp://127.0.0.1:{store_port}"
+
+    coord = spawn("dynamo_tpu.coordinator", "--port", str(store_port))
+    procs.append(coord)
+    await wait_ready(coord, "COORDINATOR_READY")
+
+    worker_args = ["--mock", "--store", store, "--migration-limit", "3",
+                   "--router-mode", "round_robin",
+                   "--mock-decode-ms", "40", "--lease-ttl", LEASE_TTL]
+    w1 = spawn("dynamo_tpu.worker", *worker_args)
+    procs.append(w1)
+    await wait_ready(w1, "WORKER_READY")
+
+    fe = spawn("dynamo_tpu.frontend", "--store", store,
+               "--host", "127.0.0.1", "--port", str(http_port))
+    procs.append(fe)
+    await wait_ready(fe, "FRONTEND_READY")
+    url = f"http://127.0.0.1:{http_port}"
+
+    async with aiohttp.ClientSession() as s:
+        # model discovered?
+        for _ in range(100):
+            async with s.get(f"{url}/v1/models") as r:
+                if (await r.json()).get("data"):
+                    break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("model never discovered")
+
+        # start the stream against the ONLY worker (w1)
+        body = {"model": "mock-model", "max_tokens": 48, "stream": True,
+                "messages": [{"role": "user",
+                              "content": "tell me a long story"}]}
+        chunks = []
+        stream = sse_tokens(s, f"{url}/v1/chat/completions", body)
+        async for c in stream:
+            chunks.append(c)
+            if len(chunks) == 3:
+                break
+        assert len(chunks) == 3, "stream never started"
+
+        # bring up the survivor, then SIGKILL the serving worker
+        w2 = spawn("dynamo_tpu.worker", *worker_args)
+        procs.append(w2)
+        await wait_ready(w2, "WORKER_READY")
+        await asyncio.sleep(0.5)        # let the frontend's client see it
+        os.kill(w1.pid, signal.SIGKILL)
+
+        finish = None
+        async for c in stream:
+            chunks.append(c)
+            fr = c.get("choices", [{}])[0].get("finish_reason")
+            if fr:
+                finish = fr
+        assert finish == "length", (finish, chunks[-3:])
+        # migration replays with accumulated tokens: the client still gets
+        # exactly max_tokens deltas' worth of content
+        n_content = sum(1 for c in chunks
+                        if c["choices"][0].get("delta", {}).get("content"))
+        assert n_content >= 40, n_content
+
+        # the killed instance must leave the instance set on lease expiry:
+        # a fresh request succeeds end-to-end on the survivor
+        body2 = {"model": "mock-model", "max_tokens": 8,
+                 "messages": [{"role": "user", "content": "hi again"}]}
+        for _ in range(40):
+            async with s.post(f"{url}/v1/chat/completions",
+                              json=body2) as r:
+                if r.status == 200:
+                    data = await r.json()
+                    if data.get("choices"):
+                        break
+            await asyncio.sleep(0.25)
+        else:
+            raise AssertionError("post-kill request never succeeded")
+
+
+async def test_engine_death_monitor_detects_dead_loop():
+    """engine_dead() flags a crashed scheduler loop but not a clean stop
+    (the worker CLI wires it to os._exit so the lease drops)."""
+    from dynamo_tpu.worker.monitor import EngineDeathMonitor
+
+    class DeadLoop:
+        _stopped = False
+
+        def __init__(self):
+            async def boom():
+                raise RuntimeError("engine crashed")
+            self._loop_task = asyncio.get_running_loop().create_task(boom())
+
+    eng = DeadLoop()
+    await asyncio.sleep(0.01)
+    mon = EngineDeathMonitor(eng)
+    assert mon.engine_dead()
+    eng._stopped = True
+    assert not mon.engine_dead()
+
+
+async def test_standalone_router_service(procs):
+    """`python -m dynamo_tpu.router` routes and answers best_worker_id."""
+    store_port = free_port()
+    store = f"tcp://127.0.0.1:{store_port}"
+    coord = spawn("dynamo_tpu.coordinator", "--port", str(store_port))
+    procs.append(coord)
+    await wait_ready(coord, "COORDINATOR_READY")
+    w = spawn("dynamo_tpu.worker", "--mock", "--store", store)
+    procs.append(w)
+    await wait_ready(w, "WORKER_READY")
+    r = spawn("dynamo_tpu.router", "--store", store)
+    procs.append(r)
+    await wait_ready(r, "ROUTER_READY")
+
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.push import PushRouter
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url=store))
+    try:
+        client = await rt.namespace("dynamo").component("router") \
+            .endpoint("best_worker_id").client()
+        await client.start()
+        for _ in range(50):
+            if client.instances():
+                break
+            await asyncio.sleep(0.1)
+        push = PushRouter(client, mode="round_robin")
+        from dynamo_tpu.runtime.context import Context
+        outs = [o async for o in push.generate(
+            {"token_ids": [1, 2, 3, 4]}, Context())]
+        assert outs and "worker_id" in outs[0]
+        # route-and-forward through the router service's generate endpoint
+        gclient = await rt.namespace("dynamo").component("router") \
+            .endpoint("generate").client()
+        await gclient.start()
+        for _ in range(50):
+            if gclient.instances():
+                break
+            await asyncio.sleep(0.1)
+        gpush = PushRouter(gclient, mode="round_robin")
+        req = {"token_ids": [5, 6, 7, 8], "model": "mock-model",
+               "sampling": {}, "stop": {"max_tokens": 4}}
+        outs = [o async for o in gpush.generate(req, Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert len(toks) == 4, outs
+    finally:
+        await rt.close()

@@ -61,6 +61,22 @@ class HttpService:
                      0.5, 1.0))
         self._duration = m.histogram(
             "request_duration_seconds", "total request duration")
+        # ISL/OSL from the pipeline's final-chunk usage: the SLA planner
+        # scrapes these to predict load (planner_core.py observe_metrics)
+        self._isl = m.histogram(
+            "request_input_tokens", "prompt tokens per request",
+            buckets=(16, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384))
+        self._osl = m.histogram(
+            "request_output_tokens", "completion tokens per request",
+            buckets=(1, 4, 16, 64, 128, 256, 512, 1024, 2048, 4096))
+
+    def _observe_usage(self, usage: Optional[dict]) -> None:
+        if not usage:
+            return
+        if usage.get("prompt_tokens") is not None:
+            self._isl.observe(usage["prompt_tokens"])
+        if usage.get("completion_tokens") is not None:
+            self._osl.observe(usage["completion_tokens"])
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -126,6 +142,7 @@ class HttpService:
                 raise
             self._req_counter.inc(endpoint=endpoint, status="200")
             self._duration.observe(time.perf_counter() - start)
+            self._observe_usage(full.get("usage"))
             return web.json_response(full)
         finally:
             self._inflight.add(-1)
@@ -148,6 +165,7 @@ class HttpService:
                     self._itl.observe(time.perf_counter() - last_token_at)
                 if self._has_content(chunk):
                     last_token_at = time.perf_counter()
+                self._observe_usage(chunk.get("usage"))
                 if not resp.prepared:
                     await resp.prepare(request)
                 await resp.write(sse_encode(chunk))

@@ -1,0 +1,37 @@
+"""SLA planner: observe → predict → interpolate → scale.
+
+Reference: `components/src/dynamo/planner/` — the autoscaler that watches
+frontend metrics, predicts the next interval's load, maps it through
+pre-profiled prefill/decode performance surfaces, and sets target replica
+counts for the prefill/decode worker pools under a chip budget
+(`utils/planner_core.py:61,313-407`).
+
+TPU-native differences: chips instead of GPUs in the budget math; the
+profiler (`profile_sla.py`) sweeps the owned engine/mocker directly; the
+virtual connector writes targets into the runtime's KV store for any
+supervisor (k8s operator, systemd, a test harness) to act on.
+"""
+
+from dynamo_tpu.planner.connector import TargetReplica, VirtualConnector
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.load_predictor import (
+    LOAD_PREDICTORS,
+    ConstantPredictor,
+    EwmaPredictor,
+    LinearTrendPredictor,
+)
+from dynamo_tpu.planner.planner_core import (
+    IntervalMetrics,
+    Planner,
+    SlaPlannerConfig,
+)
+
+__all__ = [
+    "Planner", "SlaPlannerConfig", "IntervalMetrics",
+    "PrefillInterpolator", "DecodeInterpolator",
+    "LOAD_PREDICTORS", "ConstantPredictor", "LinearTrendPredictor",
+    "EwmaPredictor", "TargetReplica", "VirtualConnector",
+]

@@ -1,0 +1,98 @@
+"""`python -m dynamo_tpu.planner` — run the SLA planner.
+
+Reference: `components/src/dynamo/planner/planner_sla.py`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from dynamo_tpu.cli_util import (
+    add_runtime_args,
+    run_until_signal,
+    runtime_config_from_args,
+    setup_logging,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.planner",
+        description="SLA-based autoscaling planner")
+    add_runtime_args(p)
+    p.add_argument("--metrics-url", required=True,
+                   help="frontend /metrics URL to scrape")
+    p.add_argument("--profile-results", required=True,
+                   help="JSON written by planner.profile_sla")
+    p.add_argument("--adjustment-interval", type=float, default=60.0)
+    p.add_argument("--ttft", type=float, default=0.5,
+                   help="TTFT SLA seconds")
+    p.add_argument("--itl", type=float, default=0.05,
+                   help="ITL SLA seconds")
+    p.add_argument("--prefill-component", default="backend_prefill")
+    p.add_argument("--decode-component", default="backend")
+    p.add_argument("--chips-per-prefill-engine", type=int, default=1)
+    p.add_argument("--chips-per-decode-engine", type=int, default=1)
+    p.add_argument("--max-chip-budget", type=int, default=8)
+    p.add_argument("--min-endpoint", type=int, default=1)
+    p.add_argument("--load-predictor", default="constant",
+                   choices=["constant", "linear", "ewma"])
+    p.add_argument("--no-operation", action="store_true",
+                   help="observe and log, never write targets")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    setup_logging(args.log_level)
+
+    async def start():
+        from dynamo_tpu.planner import (
+            DecodeInterpolator,
+            Planner,
+            PrefillInterpolator,
+            SlaPlannerConfig,
+            VirtualConnector,
+        )
+        from dynamo_tpu.planner.prometheus_source import (
+            PrometheusScrapeSource,
+        )
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        rt = await DistributedRuntime.create(runtime_config_from_args(args))
+        cfg = SlaPlannerConfig(
+            namespace=args.namespace,
+            prefill_component=args.prefill_component,
+            decode_component=args.decode_component,
+            adjustment_interval=args.adjustment_interval,
+            ttft_sla=args.ttft, itl_sla=args.itl,
+            chips_per_prefill_engine=args.chips_per_prefill_engine,
+            chips_per_decode_engine=args.chips_per_decode_engine,
+            max_chip_budget=args.max_chip_budget,
+            min_endpoint=args.min_endpoint,
+            load_predictor=args.load_predictor)
+        connector = None if args.no_operation else VirtualConnector(
+            rt, args.namespace)
+        planner = Planner(
+            cfg,
+            PrefillInterpolator(profile_path=args.profile_results),
+            DecodeInterpolator(profile_path=args.profile_results),
+            PrometheusScrapeSource(args.metrics_url),
+            connector=connector)
+        planner.start()
+        print("PLANNER_READY", flush=True)
+        return rt, planner
+
+    async def stop(objs):
+        rt, planner = objs
+        planner.stop()
+        await rt.close()
+
+    run_until_signal(start, shutdown=stop)
+
+
+if __name__ == "__main__":
+    main()

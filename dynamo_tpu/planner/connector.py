@@ -1,0 +1,64 @@
+"""Planner connectors: publish target replica counts for a supervisor.
+
+Reference: `components/src/dynamo/planner/utils/virtual_connector.py` —
+for non-K8s environments the planner writes desired replica counts into
+the control-plane store; an external supervisor (or a test harness)
+watches the key and starts/stops workers. The K8s path (DGD CRD patch,
+`kube.py`) maps to a GKE operator later.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass
+
+PLANNER_PREFIX = "v1/planner/"
+
+
+def target_key(namespace: str) -> str:
+    return f"{PLANNER_PREFIX}{namespace}/target_replicas"
+
+
+@dataclass
+class TargetReplica:
+    component: str                 # e.g. "backend_prefill" / "backend"
+    sub_component_type: str        # "prefill" | "decode"
+    desired_replicas: int
+
+
+class VirtualConnector:
+    """Store-backed connector (virtual_connector.py analog)."""
+
+    def __init__(self, runtime, namespace: str = "dynamo") -> None:
+        self.runtime = runtime
+        self.namespace = namespace
+        self.revision = 0
+
+    async def set_component_replicas(
+            self, targets: list[TargetReplica]) -> None:
+        self.revision += 1
+        payload = {
+            "revision": self.revision,
+            "ts": time.time(),
+            "targets": [asdict(t) for t in targets],
+        }
+        await self.runtime.store.put(
+            target_key(self.namespace), json.dumps(payload).encode())
+
+    async def read_targets(self) -> dict:
+        kv = await self.runtime.store.get(target_key(self.namespace))
+        if kv is None:
+            return {"revision": 0, "targets": []}
+        return json.loads(kv.value)
+
+    async def current_replicas(self, component: str,
+                               endpoint: str = "generate") -> int:
+        """Live instance count for a component (deployment validation)."""
+        client = await self.runtime.namespace(self.namespace) \
+            .component(component).endpoint(endpoint).client()
+        await client.start()
+        try:
+            return len(client.instances())
+        finally:
+            await client.stop()

@@ -1,0 +1,127 @@
+"""Performance interpolation surfaces from pre-deployment profiling.
+
+Reference: `components/src/dynamo/planner/utils/perf_interpolation.py:36,92`
+— a 1-D cubic surface over ISL for prefill (TTFT, throughput/chip) and a
+2-D grid over (kv_usage, context_length) for decode (ITL,
+throughput/chip). Consumed by the planner's replica math; produced by
+`profile_sla.py` (or handed in as raw dicts in tests).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+
+class PrefillInterpolator:
+    """TTFT(isl) and throughput/chip(isl) from a profiled sweep."""
+
+    def __init__(self, raw_data: Optional[dict] = None,
+                 profile_path: Optional[str] = None) -> None:
+        if raw_data is None:
+            if profile_path is None:
+                raise ValueError("raw_data or profile_path required")
+            with open(profile_path) as f:
+                raw_data = json.load(f)["prefill"]
+        self.isl = np.asarray(raw_data["isl"], dtype=float)
+        self.ttft = np.asarray(raw_data["ttft_ms"], dtype=float) / 1000.0
+        self.thpt = np.asarray(raw_data["thpt_per_chip"], dtype=float)
+        order = np.argsort(self.isl)
+        self.isl, self.ttft, self.thpt = (
+            self.isl[order], self.ttft[order], self.thpt[order])
+        self.min_isl, self.max_isl = float(self.isl[0]), float(self.isl[-1])
+        kind = "cubic" if len(self.isl) >= 4 else "linear"
+        import scipy.interpolate
+
+        self._ttft = scipy.interpolate.interp1d(self.isl, self.ttft,
+                                                kind=kind)
+        self._thpt = scipy.interpolate.interp1d(self.isl, self.thpt,
+                                                kind=kind)
+
+    def _clamp(self, isl: float) -> float:
+        return max(self.min_isl, min(float(isl), self.max_isl))
+
+    def interpolate_ttft(self, isl: float) -> float:
+        """Seconds."""
+        return float(self._ttft(self._clamp(isl)))
+
+    def interpolate_thpt_per_chip(self, isl: float) -> float:
+        """Prefill tokens/sec/chip."""
+        return float(self._thpt(self._clamp(isl)))
+
+
+class DecodeInterpolator:
+    """ITL and throughput/chip over (kv_usage, context_length)."""
+
+    def __init__(self, raw_data: Optional[dict] = None,
+                 profile_path: Optional[str] = None,
+                 resolution: int = 100) -> None:
+        if raw_data is None:
+            if profile_path is None:
+                raise ValueError("raw_data or profile_path required")
+            with open(profile_path) as f:
+                raw_data = json.load(f)["decode"]
+        x = np.asarray(raw_data["x_kv_usage"], dtype=float)
+        y = np.asarray(raw_data["y_context_length"], dtype=float)
+        z_itl = np.asarray(raw_data["z_itl_ms"], dtype=float) / 1000.0
+        z_thpt = np.asarray(raw_data["z_thpt_per_chip"], dtype=float)
+        self.max_kv_tokens = float(raw_data["max_kv_tokens"])
+        self.resolution = resolution
+        self.xi = np.linspace(0, 1, resolution)
+        self.yi = np.linspace(0, float(y.max()), resolution)
+        import scipy.interpolate
+
+        grid = np.meshgrid(self.xi, self.yi)
+
+        def surface(z):
+            s = scipy.interpolate.griddata((x, y), z, tuple(grid),
+                                           method="cubic")
+            nan = np.isnan(s)
+            if nan.any():
+                s[nan] = scipy.interpolate.griddata(
+                    (x, y), z, tuple(grid), method="nearest")[nan]
+            return s
+
+        self._itl = surface(z_itl)
+        self._thpt = surface(z_thpt)
+
+    def _idx(self, kv_usage: float, context_length: float) -> tuple[int, int]:
+        ix = int(np.clip(round(kv_usage * (self.resolution - 1)), 0,
+                         self.resolution - 1))
+        step = self.yi[1] - self.yi[0]
+        iy = int(np.clip(round(context_length / step), 0,
+                         self.resolution - 1))
+        return ix, iy
+
+    def interpolate_itl(self, concurrency: float,
+                        context_length: float) -> float:
+        """Seconds, at the given decode concurrency/context."""
+        kv = concurrency * context_length / self.max_kv_tokens
+        ix, iy = self._idx(kv, context_length)
+        return float(self._itl[iy, ix])
+
+    def interpolate_thpt_per_chip(self, concurrency: float,
+                                  context_length: float) -> float:
+        kv = concurrency * context_length / self.max_kv_tokens
+        ix, iy = self._idx(kv, context_length)
+        return float(self._thpt[iy, ix])
+
+    def find_best_throughput_per_chip(
+            self, itl: float, context_length: float
+    ) -> tuple[float, float, float]:
+        """Max tokens/sec/chip achievable while ITL ≤ the SLA at this
+        context length. Returns (thpt_per_chip, kv_usage, itl_achieved) —
+        the reference's `find_best_throughput_per_gpu`
+        (perf_interpolation.py:~200)."""
+        _, iy = self._idx(0.0, context_length)
+        row_itl = self._itl[iy]
+        row_thpt = self._thpt[iy]
+        ok = row_itl <= itl
+        if ok.any():
+            best = int(np.argmax(np.where(ok, row_thpt, -np.inf)))
+        else:
+            best = int(np.argmin(row_itl))  # SLA unmeetable: least-bad
+        return float(row_thpt[best]), float(self.xi[best]), \
+            float(row_itl[best])

@@ -1,0 +1,95 @@
+"""Load predictors: next-interval forecasts of num_req / ISL / OSL.
+
+Reference: `components/src/dynamo/planner/utils/load_predictor.py` —
+constant, ARIMA (pmdarima) and Prophet predictors behind one interface.
+Those libraries aren't in this image; the linear-trend and EWMA
+predictors cover the same planning role (short-horizon one-step
+forecasts) with closed-form math.
+"""
+
+from __future__ import annotations
+
+import math
+from abc import ABC, abstractmethod
+
+
+class BasePredictor(ABC):
+    """Buffered one-step-ahead predictor (load_predictor.py:36-62)."""
+
+    def __init__(self, window_size: int = 100,
+                 minimum_data_points: int = 5) -> None:
+        self.window_size = window_size
+        self.minimum_data_points = minimum_data_points
+        self.data_buffer: list[float] = []
+
+    def add_data_point(self, value: float) -> None:
+        if value is None or (isinstance(value, float) and math.isnan(value)):
+            value = 0.0
+        if not self.data_buffer and value == 0:
+            return  # skip the initial idle period
+        self.data_buffer.append(float(value))
+        if len(self.data_buffer) > self.window_size:
+            self.data_buffer = self.data_buffer[-self.window_size:]
+
+    def get_last_value(self) -> float:
+        return self.data_buffer[-1] if self.data_buffer else 0.0
+
+    @abstractmethod
+    def predict_next(self) -> float:
+        ...
+
+
+class ConstantPredictor(BasePredictor):
+    """Next load = last load."""
+
+    def __init__(self, **kw) -> None:
+        super().__init__(minimum_data_points=1)
+
+    def predict_next(self) -> float:
+        return self.get_last_value()
+
+
+class LinearTrendPredictor(BasePredictor):
+    """Least-squares line over the window, extrapolated one step.
+
+    Captures ramps the constant predictor lags behind on (the planning
+    role ARIMA plays in the reference); clamped at zero.
+    """
+
+    def predict_next(self) -> float:
+        n = len(self.data_buffer)
+        if n < self.minimum_data_points:
+            return self.get_last_value()
+        if len(set(self.data_buffer)) == 1:
+            return self.data_buffer[0]
+        xs = range(n)
+        mean_x = (n - 1) / 2.0
+        mean_y = sum(self.data_buffer) / n
+        num = sum((x - mean_x) * (y - mean_y)
+                  for x, y in zip(xs, self.data_buffer))
+        den = sum((x - mean_x) ** 2 for x in xs)
+        slope = num / den if den else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))
+
+
+class EwmaPredictor(BasePredictor):
+    """Exponentially-weighted moving average (smooths bursty load)."""
+
+    def __init__(self, alpha: float = 0.5, **kw) -> None:
+        super().__init__(**kw)
+        self.alpha = alpha
+
+    def predict_next(self) -> float:
+        if not self.data_buffer:
+            return 0.0
+        est = self.data_buffer[0]
+        for v in self.data_buffer[1:]:
+            est = self.alpha * v + (1 - self.alpha) * est
+        return est
+
+
+LOAD_PREDICTORS = {
+    "constant": ConstantPredictor,
+    "linear": LinearTrendPredictor,
+    "ewma": EwmaPredictor,
+}

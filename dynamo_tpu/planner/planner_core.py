@@ -1,0 +1,224 @@
+"""SLA planner core loop: observe → correct → predict → size → scale.
+
+Reference semantics (`components/src/dynamo/planner/utils/planner_core.py`):
+
+- observe: per-interval frontend metrics (num_req, isl, osl, ttft, itl,
+  request_duration)
+- correction factors (:420-441): p = observed_ttft / interpolated_ttft
+  (≪1 means queueing headroom, >1 means prefill pool is behind);
+  d = observed_itl / interpolated_itl at current decode concurrency
+- replica math (:313-407):
+    prefill: ceil(num_req·isl/interval · min(1, p_corr)
+                  / prefill_thpt_per_chip(isl) / chips_per_prefill)
+    decode:  corrected_itl = itl_sla / d_corr; find the best
+             thpt/chip meeting corrected_itl at context isl+osl/2;
+             ceil(num_req·osl/interval / that / chips_per_decode)
+  both floored at min_endpoint, then clamped to the chip budget with
+  prefill sized first.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from dynamo_tpu.planner.connector import TargetReplica
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.load_predictor import LOAD_PREDICTORS
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class IntervalMetrics:
+    """One adjustment interval's observed frontend metrics."""
+
+    num_req: float = float("nan")
+    isl: float = float("nan")
+    osl: float = float("nan")
+    ttft: float = float("nan")          # seconds
+    itl: float = float("nan")           # seconds
+    request_duration: float = float("nan")
+
+    def is_valid(self) -> bool:
+        return all(not math.isnan(v) for v in
+                   (self.num_req, self.isl, self.osl, self.ttft, self.itl)) \
+            and self.num_req > 0
+
+
+class MetricsSource(Protocol):
+    async def interval_metrics(self) -> IntervalMetrics: ...
+
+
+@dataclass
+class SlaPlannerConfig:
+    namespace: str = "dynamo"
+    prefill_component: str = "backend_prefill"
+    decode_component: str = "backend"
+    adjustment_interval: float = 60.0   # seconds
+    ttft_sla: float = 0.5               # seconds
+    itl_sla: float = 0.05               # seconds
+    chips_per_prefill_engine: int = 1
+    chips_per_decode_engine: int = 1
+    max_chip_budget: int = 8
+    min_endpoint: int = 1
+    load_predictor: str = "constant"
+    load_window: int = 50
+    no_correction: bool = False
+
+
+class Planner:
+    """The SLA planner (planner_core.py:61)."""
+
+    def __init__(self, config: SlaPlannerConfig,
+                 prefill_interpolator: PrefillInterpolator,
+                 decode_interpolator: DecodeInterpolator,
+                 metrics_source: MetricsSource,
+                 connector=None) -> None:
+        self.config = config
+        self.prefill_interpolator = prefill_interpolator
+        self.decode_interpolator = decode_interpolator
+        self.metrics_source = metrics_source
+        self.connector = connector
+        pred = LOAD_PREDICTORS[config.load_predictor]
+        self.num_req_predictor = pred(window_size=config.load_window)
+        self.isl_predictor = pred(window_size=config.load_window)
+        self.osl_predictor = pred(window_size=config.load_window)
+        self.p_correction_factor = 1.0
+        self.d_correction_factor = 1.0
+        self.last_metrics = IntervalMetrics()
+        self.last_targets: tuple[int, int] = (0, 0)
+        self._task: Optional[asyncio.Task] = None
+        self.decode_replicas = config.min_endpoint  # for concurrency calc
+
+    # -- observe ------------------------------------------------------------
+
+    async def observe_metrics(self) -> None:
+        m = await self.metrics_source.interval_metrics()
+        self.last_metrics = m
+        self.num_req_predictor.add_data_point(m.num_req)
+        self.isl_predictor.add_data_point(m.isl)
+        self.osl_predictor.add_data_point(m.osl)
+
+    def update_correction_factors(self) -> None:
+        """planner_core.py:424-441."""
+        m = self.last_metrics
+        if self.config.no_correction or not m.is_valid():
+            return
+        expect_ttft = self.prefill_interpolator.interpolate_ttft(m.isl)
+        if expect_ttft > 0:
+            self.p_correction_factor = m.ttft / expect_ttft
+        dur = m.request_duration if not math.isnan(m.request_duration) \
+            else self.config.adjustment_interval
+        concurrency = (m.num_req / max(1, self.decode_replicas)
+                       * dur / self.config.adjustment_interval)
+        expect_itl = self.decode_interpolator.interpolate_itl(
+            concurrency=concurrency, context_length=m.isl + m.osl / 2)
+        if expect_itl > 0:
+            self.d_correction_factor = m.itl / expect_itl
+        logger.info("correction factors: ttft %.3f itl %.3f",
+                    self.p_correction_factor, self.d_correction_factor)
+
+    # -- predict + size -----------------------------------------------------
+
+    def predict_load(self) -> tuple[float, float, float]:
+        return (self.num_req_predictor.predict_next(),
+                self.isl_predictor.predict_next(),
+                self.osl_predictor.predict_next())
+
+    def compute_replica_requirements(self, next_num_req: float,
+                                     next_isl: float, next_osl: float
+                                     ) -> tuple[int, int]:
+        """planner_core.py:313-407 — see module docstring."""
+        cfg = self.config
+        interval = cfg.adjustment_interval
+
+        pred_prefill_thpt = (next_num_req * next_isl / interval
+                             * min(1.0, self.p_correction_factor))
+        p_chip_thpt = self.prefill_interpolator.interpolate_thpt_per_chip(
+            next_isl)
+        # epsilon guards interpolation float noise at exact SLA
+        # boundaries (thpt of 999.9999959 must not ceil 1.0 -> 2)
+        next_num_p = math.ceil(
+            pred_prefill_thpt / p_chip_thpt / cfg.chips_per_prefill_engine
+            - 1e-6)
+
+        if self.d_correction_factor <= 0:
+            corrected_itl = cfg.itl_sla
+        else:
+            corrected_itl = cfg.itl_sla / self.d_correction_factor
+        d_chip_thpt, _, _ = \
+            self.decode_interpolator.find_best_throughput_per_chip(
+                itl=corrected_itl, context_length=next_isl + next_osl / 2)
+        pred_decode_thpt = next_num_req * next_osl / interval
+        next_num_d = math.ceil(
+            pred_decode_thpt / d_chip_thpt / cfg.chips_per_decode_engine
+            - 1e-6)
+
+        next_num_p = max(next_num_p, cfg.min_endpoint)
+        next_num_d = max(next_num_d, cfg.min_endpoint)
+
+        total = (next_num_p * cfg.chips_per_prefill_engine
+                 + next_num_d * cfg.chips_per_decode_engine)
+        if total > cfg.max_chip_budget:
+            scale = cfg.max_chip_budget / total
+            next_num_p = max(cfg.min_endpoint, round(next_num_p * scale))
+            next_num_d = max(cfg.min_endpoint, round(
+                (cfg.max_chip_budget
+                 - next_num_p * cfg.chips_per_prefill_engine)
+                / cfg.chips_per_decode_engine))
+            logger.warning("chip budget clamp: -> p=%d d=%d",
+                           next_num_p, next_num_d)
+        return next_num_p, next_num_d
+
+    # -- the loop -----------------------------------------------------------
+
+    async def make_adjustments(self) -> Optional[tuple[int, int]]:
+        if not self.last_metrics.is_valid():
+            logger.info("no traffic this interval; skipping adjustment")
+            return None
+        self.update_correction_factors()
+        num_req, isl, osl = self.predict_load()
+        if num_req <= 0 or isl <= 0:
+            return None
+        num_p, num_d = self.compute_replica_requirements(num_req, isl, osl)
+        self.last_targets = (num_p, num_d)
+        self.decode_replicas = num_d
+        if self.connector is not None:
+            await self.connector.set_component_replicas([
+                TargetReplica(self.config.prefill_component, "prefill",
+                              num_p),
+                TargetReplica(self.config.decode_component, "decode",
+                              num_d),
+            ])
+        return num_p, num_d
+
+    async def step(self) -> Optional[tuple[int, int]]:
+        """One observe+adjust cycle (tests drive this directly)."""
+        await self.observe_metrics()
+        return await self.make_adjustments()
+
+    async def run(self) -> None:
+        while True:
+            started = time.monotonic()
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("planner step failed")
+            elapsed = time.monotonic() - started
+            await asyncio.sleep(
+                max(0.0, self.config.adjustment_interval - elapsed))
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

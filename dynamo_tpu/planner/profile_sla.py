@@ -1,0 +1,105 @@
+"""Pre-deployment SLA profiler: sweep an engine, fit the planner surfaces.
+
+Reference: `benchmarks/profiler/profile_sla.py` +
+`utils/profile_{prefill,decode}.py` — before deploying, sweep prefill
+over ISLs (TTFT + throughput/chip) and decode over (kv_usage,
+context_length) (ITL + throughput/chip), and persist the raw surfaces
+the planner's interpolators load.
+
+Works against any engine honoring the PreprocessedRequest contract —
+the mocker (no chips; used by tests) or the owned TPU engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+from dynamo_tpu.runtime.context import Context
+
+
+def _req(n_tokens: int, max_tokens: int, offset: int = 0) -> dict:
+    return {"token_ids": [(offset + i) % 8000 + 1 for i in range(n_tokens)],
+            "model": "profile", "sampling": {"temperature": 0.0},
+            "stop": {"max_tokens": max_tokens}}
+
+
+async def profile_prefill(engine, isls: list[int],
+                          reps: int = 3) -> dict:
+    """TTFT(isl) + prefill tokens/sec/chip(isl): one request at a time,
+    max_tokens=1, distinct prompts (no prefix-cache hits)."""
+    out = {"isl": [], "ttft_ms": [], "thpt_per_chip": []}
+    salt = 0
+    for isl in isls:
+        ttfts = []
+        for _ in range(reps):
+            salt += isl
+            t0 = time.perf_counter()
+            async for _o in engine.generate(_req(isl, 1, salt), Context()):
+                break
+            ttfts.append(time.perf_counter() - t0)
+        ttft = sorted(ttfts)[len(ttfts) // 2]
+        out["isl"].append(isl)
+        out["ttft_ms"].append(ttft * 1000)
+        out["thpt_per_chip"].append(isl / ttft)
+    return out
+
+
+async def profile_decode(engine, context_lengths: list[int],
+                         concurrencies: list[int],
+                         max_kv_tokens: int,
+                         osl: int = 32) -> dict:
+    """ITL + decode tokens/sec/chip over (kv_usage, context_length)."""
+    out = {"x_kv_usage": [], "y_context_length": [], "z_itl_ms": [],
+           "z_thpt_per_chip": [], "max_kv_tokens": max_kv_tokens}
+    salt = 0
+    for ctx_len in context_lengths:
+        for conc in concurrencies:
+            salt += 1
+
+            async def one(i):
+                toks = []
+                t_first = None
+                async for o in engine.generate(
+                        _req(ctx_len, osl, salt * 1000 + i * 97), Context()):
+                    if t_first is None:
+                        t_first = time.perf_counter()
+                    toks.extend(o.get("token_ids", ()))
+                return t_first, time.perf_counter(), len(toks)
+
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*(one(i) for i in range(conc)))
+            total_tokens = sum(r[2] for r in results)
+            # ITL: time from first token to done, per token, averaged
+            itls = [(r[1] - r[0]) / max(1, r[2] - 1) for r in results
+                    if r[0] is not None and r[2] > 1]
+            itl = sum(itls) / len(itls) if itls else 0.0
+            wall = time.perf_counter() - t0
+            out["x_kv_usage"].append(
+                min(1.0, conc * (ctx_len + osl / 2) / max_kv_tokens))
+            out["y_context_length"].append(ctx_len + osl / 2)
+            out["z_itl_ms"].append(itl * 1000)
+            out["z_thpt_per_chip"].append(total_tokens / wall)
+    return out
+
+
+async def profile_engine(engine, *, isls: Optional[list[int]] = None,
+                         context_lengths: Optional[list[int]] = None,
+                         concurrencies: Optional[list[int]] = None,
+                         max_kv_tokens: int = 16384,
+                         output_path: Optional[str] = None) -> dict:
+    """Full sweep → {"prefill": ..., "decode": ...} (JSON-serializable)."""
+    isls = isls or [64, 256, 1024, 4096]
+    context_lengths = context_lengths or [128, 512, 2048]
+    concurrencies = concurrencies or [1, 4, 16]
+    profile = {
+        "prefill": await profile_prefill(engine, isls),
+        "decode": await profile_decode(engine, context_lengths,
+                                       concurrencies, max_kv_tokens),
+    }
+    if output_path:
+        with open(output_path, "w") as f:
+            json.dump(profile, f)
+    return profile

@@ -1,0 +1,88 @@
+"""Frontend-metrics source: scrape /metrics and diff per interval.
+
+Reference: `components/src/dynamo/planner/utils/prometheus.py` — the
+planner reads the frontend's TTFT/ITL/request metrics from Prometheus.
+Here we scrape the frontend's own Prometheus text endpoint directly
+(no external Prometheus needed) and compute per-interval averages from
+counter/histogram deltas.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import aiohttp
+
+from dynamo_tpu.planner.planner_core import IntervalMetrics
+
+logger = logging.getLogger(__name__)
+
+NAMES = {
+    "ttft": "dynamo_http_time_to_first_token_seconds",
+    "itl": "dynamo_http_inter_token_latency_seconds",
+    "duration": "dynamo_http_request_duration_seconds",
+    "isl": "dynamo_http_request_input_tokens",
+    "osl": "dynamo_http_request_output_tokens",
+}
+
+
+def parse_prom_text(text: str) -> dict[str, float]:
+    """name{labels} value lines → {bare_name_suffix: summed value}.
+
+    Histogram _sum/_count series are summed across label sets.
+    """
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(" ", 1)
+            name = key.split("{", 1)[0]
+            out[name] = out.get(name, 0.0) + float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class PrometheusScrapeSource:
+    """Scrapes a frontend /metrics URL; interval averages from deltas."""
+
+    def __init__(self, metrics_url: str) -> None:
+        self.metrics_url = metrics_url
+        self._prev: Optional[dict[str, float]] = None
+
+    async def _scrape(self) -> dict[str, float]:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(self.metrics_url) as r:
+                return parse_prom_text(await r.text())
+
+    async def interval_metrics(self) -> IntervalMetrics:
+        cur = await self._scrape()
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return IntervalMetrics()
+
+        def delta(name: str) -> float:
+            return cur.get(name, 0.0) - prev.get(name, 0.0)
+
+        def avg(metric: str) -> float:
+            s = delta(NAMES[metric] + "_sum")
+            c = delta(NAMES[metric] + "_count")
+            return s / c if c > 0 else float("nan")
+
+        n_req = delta(NAMES["isl"] + "_count")
+        if n_req <= 0:
+            return IntervalMetrics()
+        m = IntervalMetrics(
+            num_req=n_req, isl=avg("isl"), osl=avg("osl"),
+            ttft=avg("ttft"), itl=avg("itl"),
+            request_duration=avg("duration"))
+        if math.isnan(m.itl):
+            # unary-only traffic has no per-token gaps; approximate from
+            # duration spread over the output tokens
+            if not math.isnan(m.request_duration) and m.osl > 1:
+                m.itl = m.request_duration / m.osl
+        return m

@@ -1,0 +1,286 @@
+"""SLA planner: replica math vs reference semantics, predictors,
+interpolators, profiler-on-mocker, and a scaling e2e where a supervisor
+acts on the virtual connector's targets.
+
+Reference tests: `tests/planner/test_replica_calculation.py`,
+`tests/planner/test_scaling_e2e.py`.
+"""
+
+import asyncio
+import math
+
+import pytest
+
+from dynamo_tpu.planner import (
+    ConstantPredictor,
+    DecodeInterpolator,
+    EwmaPredictor,
+    IntervalMetrics,
+    LinearTrendPredictor,
+    Planner,
+    PrefillInterpolator,
+    SlaPlannerConfig,
+    TargetReplica,
+    VirtualConnector,
+)
+
+# -- fixtures: synthetic profile surfaces -----------------------------------
+# prefill: ttft grows linearly with isl; thpt/chip flat 10_000 tok/s
+PREFILL_RAW = {
+    "isl": [64, 256, 1024, 4096],
+    "ttft_ms": [10.0, 30.0, 110.0, 430.0],
+    "thpt_per_chip": [10000.0, 10000.0, 10000.0, 10000.0],
+}
+# decode: itl rises with kv_usage; thpt/chip rises with kv_usage
+_x, _y, _itl, _thpt = [], [], [], []
+for ctx in (128.0, 512.0, 2048.0):
+    for kv in (0.0, 0.25, 0.5, 0.75, 1.0):
+        _x.append(kv)
+        _y.append(ctx)
+        _itl.append(10.0 + 40.0 * kv)          # ms: 10..50
+        _thpt.append(100.0 + 900.0 * kv)       # tok/s/chip: 100..1000
+DECODE_RAW = {
+    "x_kv_usage": _x, "y_context_length": _y, "z_itl_ms": _itl,
+    "z_thpt_per_chip": _thpt, "max_kv_tokens": 100000,
+}
+
+
+def make_planner(connector=None, **cfg_kw):
+    defaults = dict(adjustment_interval=10.0, ttft_sla=0.5,
+                    itl_sla=0.05, max_chip_budget=16)
+    defaults.update(cfg_kw)
+    cfg = SlaPlannerConfig(**defaults)
+
+    class NullSource:
+        async def interval_metrics(self):
+            return IntervalMetrics()
+
+    return Planner(cfg, PrefillInterpolator(raw_data=PREFILL_RAW),
+                   DecodeInterpolator(raw_data=DECODE_RAW),
+                   NullSource(), connector=connector)
+
+
+# -- predictors -------------------------------------------------------------
+
+
+def test_constant_predictor_and_idle_skip():
+    p = ConstantPredictor()
+    p.add_data_point(0)          # leading idle skipped
+    assert p.predict_next() == 0
+    p.add_data_point(5)
+    p.add_data_point(7)
+    assert p.predict_next() == 7
+
+
+def test_linear_trend_extrapolates_ramp():
+    p = LinearTrendPredictor(minimum_data_points=3)
+    for v in (10, 20, 30, 40):
+        p.add_data_point(v)
+    assert p.predict_next() == pytest.approx(50, rel=0.01)
+    # constant series stays constant
+    p2 = LinearTrendPredictor(minimum_data_points=3)
+    for _ in range(5):
+        p2.add_data_point(8)
+    assert p2.predict_next() == 8
+
+
+def test_ewma_smooths():
+    p = EwmaPredictor(alpha=0.5)
+    for v in (10, 10, 30):
+        p.add_data_point(v)
+    assert 10 < p.predict_next() < 30
+
+
+# -- interpolators -----------------------------------------------------------
+
+
+def test_prefill_interpolator_exact_and_clamped():
+    pi = PrefillInterpolator(raw_data=PREFILL_RAW)
+    assert pi.interpolate_ttft(256) == pytest.approx(0.030, abs=1e-3)
+    assert pi.interpolate_thpt_per_chip(9999999) == pytest.approx(10000.0)
+    assert pi.interpolate_ttft(1) == pytest.approx(0.010, abs=2e-3)
+
+
+def test_decode_interpolator_surfaces_and_best_thpt():
+    di = DecodeInterpolator(raw_data=DECODE_RAW)
+    # kv=0.5 at ctx 512: itl ≈ 30ms
+    itl = di.interpolate_itl(concurrency=0.5 * 100000 / 512,
+                             context_length=512)
+    assert itl == pytest.approx(0.030, abs=0.004)
+    # best thpt under a 30ms SLA must pick kv_usage ≈ 0.5 → thpt ≈ 550
+    thpt, kv, achieved = di.find_best_throughput_per_chip(
+        itl=0.030, context_length=512)
+    assert achieved <= 0.0305
+    assert thpt == pytest.approx(100 + 900 * kv, rel=0.05)
+    assert 0.4 < kv < 0.6
+    # unmeetable SLA falls back to the least-bad point
+    thpt2, kv2, achieved2 = di.find_best_throughput_per_chip(
+        itl=0.001, context_length=512)
+    assert kv2 == pytest.approx(0.0, abs=0.05)
+
+
+# -- replica math (reference planner_core.py:313-407 semantics) -------------
+
+
+def test_replica_requirements_basic():
+    pl = make_planner()
+    # 100 req / 10s interval, isl 1000, osl 100
+    # prefill: 100*1000/10 = 10_000 tok/s / 10_000 per chip = 1 chip
+    # decode: 100*100/10 = 1000 tok/s; itl sla 50ms ⇒ kv=1.0 usable,
+    #   thpt/chip = 1000 ⇒ 1 chip
+    num_p, num_d = pl.compute_replica_requirements(100, 1000, 100)
+    assert num_p == 1 and num_d == 1
+
+
+def test_replica_requirements_scale_with_load():
+    pl = make_planner(max_chip_budget=64)
+    num_p, num_d = pl.compute_replica_requirements(1000, 1000, 100)
+    # prefill: 100_000 tok/s / 10_000 = 10 chips
+    assert num_p == 10
+    assert num_d >= 10
+
+
+def test_prefill_correction_factor_caps_at_one():
+    pl = make_planner(max_chip_budget=64)
+    pl.p_correction_factor = 0.25   # heavy queueing headroom
+    num_p, _ = pl.compute_replica_requirements(1000, 1000, 100)
+    assert num_p == math.ceil(1000 * 1000 / 10.0 * 0.25 / 10000)
+    pl.p_correction_factor = 4.0    # worse than profiled: min(1, f)
+    num_p2, _ = pl.compute_replica_requirements(1000, 1000, 100)
+    assert num_p2 == 10
+
+
+def test_decode_correction_tightens_itl():
+    pl = make_planner()
+    pl.d_correction_factor = 2.0    # observed itl 2x the surface
+    # corrected sla = 25ms ⇒ kv ≈ 0.375 ⇒ thpt/chip ≈ 437 < 1000
+    _, num_d = pl.compute_replica_requirements(100, 1000, 100)
+    base_pl = make_planner()
+    _, num_d_base = base_pl.compute_replica_requirements(100, 1000, 100)
+    assert num_d >= num_d_base
+
+
+def test_chip_budget_clamp_prefers_min_endpoint():
+    pl = make_planner(max_chip_budget=4)
+    num_p, num_d = pl.compute_replica_requirements(1000, 1000, 100)
+    assert num_p * 1 + num_d * 1 <= 4 + 1  # round() slack, ref semantics
+    assert num_p >= 1 and num_d >= 1
+
+
+def test_min_endpoint_floor():
+    pl = make_planner(min_endpoint=2)
+    num_p, num_d = pl.compute_replica_requirements(1, 64, 4)
+    assert num_p == 2 and num_d == 2
+
+
+# -- profiler on the mocker --------------------------------------------------
+
+
+async def test_profile_sla_on_mocker(tmp_path):
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.planner.profile_sla import profile_engine
+
+    eng = MockEngine(MockEngineConfig(speedup=500.0,
+                                      default_max_tokens=64))
+    try:
+        path = str(tmp_path / "profile.json")
+        profile = await profile_engine(
+            eng, isls=[32, 64, 128], context_lengths=[64, 128],
+            concurrencies=[1, 4], max_kv_tokens=1024 * 16,
+            output_path=path)
+        pi = PrefillInterpolator(profile_path=path)
+        di = DecodeInterpolator(profile_path=path)
+        assert pi.interpolate_thpt_per_chip(64) > 0
+        assert di.interpolate_itl(1, 96) >= 0
+        # longer prompts must not be *faster* to prefill end-to-end
+        assert pi.interpolate_ttft(128) >= pi.interpolate_ttft(32) * 0.5
+    finally:
+        await eng.close()
+
+
+# -- e2e: planner scales mocker workers through the virtual connector -------
+
+
+async def test_planner_scaling_e2e_with_mockers():
+    """Synthetic load ramps up then down; a supervisor coroutine applies
+    the virtual connector's targets by starting/stopping in-proc mocker
+    workers; live instance counts must follow."""
+    from dynamo_tpu.llm.entrypoint import serve_engine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    connector = VirtualConnector(rt, "dynamo")
+
+    class Source:
+        """Scripted load: low → high → low."""
+
+        def __init__(self):
+            self.script = [
+                IntervalMetrics(10, 1000, 100, 0.05, 0.02, 2.0),
+                IntervalMetrics(1000, 1000, 100, 0.3, 0.04, 5.0),
+                IntervalMetrics(1000, 1000, 100, 0.3, 0.04, 5.0),
+                IntervalMetrics(10, 1000, 100, 0.05, 0.02, 2.0),
+            ]
+            self.i = 0
+
+        async def interval_metrics(self):
+            m = self.script[min(self.i, len(self.script) - 1)]
+            self.i += 1
+            return m
+
+    cfg = SlaPlannerConfig(adjustment_interval=10.0, max_chip_budget=32,
+                           no_correction=True)
+    planner = Planner(cfg, PrefillInterpolator(raw_data=PREFILL_RAW),
+                      DecodeInterpolator(raw_data=DECODE_RAW),
+                      Source(), connector=connector)
+
+    # supervisor: reconcile decode-pool mocker workers to the target
+    card = ModelDeploymentCard(name="mock-model", namespace="dynamo",
+                               component="backend", tokenizer_kind="word",
+                               tokenizer_path="mock-model")
+    workers: list = []
+
+    async def reconcile():
+        targets = await connector.read_targets()
+        want = {t["component"]: t["desired_replicas"]
+                for t in targets["targets"]}
+        n = want.get("backend", 0)
+        while len(workers) < n:
+            eng = MockEngine(MockEngineConfig(worker_id=len(workers) + 1,
+                                              speedup=200.0))
+            h = await serve_engine(rt, eng, card,
+                                   instance_id=len(workers) + 1)
+            workers.append((eng, h))
+        while len(workers) > n:
+            eng, h = workers.pop()
+            await h.stop()
+            await eng.close()
+
+    try:
+        # interval 1: low load → minimal pools
+        await planner.step()
+        await reconcile()
+        low_n = len(workers)
+        assert low_n >= 1
+        # interval 2-3: high load → scale up
+        await planner.step()
+        await reconcile()
+        await planner.step()
+        await reconcile()
+        high_n = len(workers)
+        assert high_n > low_n
+        assert planner.last_targets[0] >= 1  # prefill pool sized too
+        # interval 4: load drops → scale back down
+        await planner.step()
+        await reconcile()
+        assert len(workers) < high_n
+        # live instance count matches the reconciled worker set
+        assert await connector.current_replicas("backend") == len(workers)
+    finally:
+        for eng, h in workers:
+            await h.stop()
+            await eng.close()
+        await rt.close()

@@ -42,6 +42,8 @@ class ModelDeploymentCard:
     kv_block_size: int = 16
     migration_limit: int = 0
     router_mode: str = "kv"         # kv | round_robin | random
+    tool_call_parser: str = ""      # see dynamo_tpu.parsers (hermes, ...)
+    reasoning_parser: str = ""      # basic | deepseek_r1 | granite | ...
     runtime_config: ModelRuntimeConfig = field(
         default_factory=ModelRuntimeConfig)
 

@@ -87,7 +87,9 @@ class ModelManager:
             router_engine = PushRouter(client, mode=router_mode)
         tokenizer = make_tokenizer(card.tokenizer_kind, card.tokenizer_path)
         engine = build_pipeline(
-            OpenAIPreprocessor(tokenizer, card.name, card.context_length),
+            OpenAIPreprocessor(tokenizer, card.name, card.context_length,
+                               tool_call_parser=card.tool_call_parser,
+                               reasoning_parser=card.reasoning_parser),
             Backend(tokenizer),
             Migration(card.migration_limit),
             sink=router_engine,

@@ -57,12 +57,16 @@ class OpenAIPreprocessor(Operator):
 
     def __init__(self, tokenizer: Tokenizer, model_name: str,
                  context_length: int = 0,
-                 default_max_tokens: int = 1024) -> None:
+                 default_max_tokens: int = 1024,
+                 tool_call_parser: str = "",
+                 reasoning_parser: str = "") -> None:
         super().__init__()
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.context_length = context_length
         self.default_max_tokens = default_max_tokens
+        self.tool_call_parser = tool_call_parser
+        self.reasoning_parser = reasoning_parser
 
     # -- request path -------------------------------------------------------
 
@@ -121,10 +125,38 @@ class OpenAIPreprocessor(Operator):
                     pre, oai_c, request_id, created, context):
                 yield chunk
 
+    def _chat_parsers(self, oai: ChatCompletionRequest):
+        """Jail + reasoning wrap for this request, or None when neither
+        applies (preprocessor.rs:629-700: parsers engage only when the
+        model declares them; the jail only when the request has tools)."""
+        from dynamo_tpu.parsers import (
+            JailedStream, get_reasoning_parser, get_tool_parser)
+        want_tools = bool(oai.raw.get("tools")) and bool(
+            self.tool_call_parser)
+        want_reasoning = bool(self.reasoning_parser)
+        if not (want_tools or want_reasoning):
+            return None
+        return JailedStream(
+            tool_config=(get_tool_parser(self.tool_call_parser)
+                         if want_tools else None),
+            reasoning=(get_reasoning_parser(self.reasoning_parser)
+                       if want_reasoning else None))
+
     async def _postprocess_chat(self, pre: PreprocessedRequest,
                                 oai: ChatCompletionRequest, request_id: str,
                                 created: int, context: Context
                                 ) -> AsyncIterator[dict]:
+        stream = self._chat_chunks(pre, oai, request_id, created, context)
+        jail = self._chat_parsers(oai)
+        if jail is not None:
+            stream = jail.apply(stream)
+        async for chunk in stream:
+            yield chunk
+
+    async def _chat_chunks(self, pre: PreprocessedRequest,
+                           oai: ChatCompletionRequest, request_id: str,
+                           created: int, context: Context
+                           ) -> AsyncIterator[dict]:
         prompt_tokens = len(pre.token_ids)
         completion_tokens = 0
         yield chat_chunk(request_id, oai.model, created, role="assistant")

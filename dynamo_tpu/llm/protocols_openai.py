@@ -205,13 +205,23 @@ def chat_chunk(request_id: str, model: str, created: int,
 
 
 def chat_completion(request_id: str, model: str, created: int, text: str,
-                    finish_reason: str, usage: dict) -> dict:
+                    finish_reason: str, usage: dict,
+                    tool_calls: Optional[list[dict]] = None,
+                    reasoning: str = "") -> dict:
+    message: dict[str, Any] = {"role": "assistant", "content": text}
+    if tool_calls:
+        # unary shape carries no streaming 'index' field
+        message["tool_calls"] = [
+            {k: v for k, v in tc.items() if k != "index"}
+            for tc in tool_calls]
+    if reasoning:
+        message["reasoning_content"] = reasoning
     return {
         "id": request_id, "object": "chat.completion", "created": created,
         "model": model,
         "choices": [{
             "index": 0,
-            "message": {"role": "assistant", "content": text},
+            "message": message,
             "finish_reason": finish_reason,
         }],
         "usage": usage,
@@ -280,10 +290,28 @@ async def _aggregate_stream(chunks: AsyncIterator[dict], extract_text,
 
 
 async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
-    """Fold chat.completion.chunk stream into one chat.completion."""
-    return await _aggregate_stream(
-        chunks, lambda ch: ch.get("delta", {}).get("content"),
-        chat_completion)
+    """Fold chat.completion.chunk stream into one chat.completion —
+    including `delta.tool_calls` and `delta.reasoning_content` from the
+    jailed stream (aggregator.rs folds the same three delta kinds)."""
+    tool_calls: list[dict] = []
+    reasoning_parts: list[str] = []
+
+    def extract(ch: dict):
+        delta = ch.get("delta", {})
+        for tc in delta.get("tool_calls") or ():
+            tc = dict(tc)
+            tc["index"] = len(tool_calls)
+            tool_calls.append(tc)
+        if delta.get("reasoning_content"):
+            reasoning_parts.append(delta["reasoning_content"])
+        return delta.get("content")
+
+    def build(request_id, model, created, text, finish, usage):
+        return chat_completion(
+            request_id, model, created, text, finish, usage,
+            tool_calls=tool_calls, reasoning="".join(reasoning_parts))
+
+    return await _aggregate_stream(chunks, extract, build)
 
 
 async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:

@@ -1,0 +1,42 @@
+"""Model-output parsers: tool calls + reasoning blocks + the jailed stream.
+
+TPU-native analog of the reference's `lib/parsers/` crate
+(`lib/parsers/src/tool_calling/`, `lib/parsers/src/reasoning/`) and the
+chat-completions jailed stream
+(`lib/llm/src/protocols/openai/chat_completions/jail.rs`). Pure-Python
+stream transforms — these run on the frontend host, off the TPU hot path.
+"""
+
+from dynamo_tpu.parsers.tool_calls import (
+    ToolCall,
+    ToolCallConfig,
+    JsonParserConfig,
+    detect_tool_call_start,
+    get_tool_parser,
+    get_available_tool_parsers,
+    parse_tool_calls,
+)
+from dynamo_tpu.parsers.reasoning import (
+    ParserResult,
+    ReasoningParser,
+    get_reasoning_parser,
+    get_available_reasoning_parsers,
+)
+from dynamo_tpu.parsers.jail import JailedStream
+from dynamo_tpu.parsers.util import MarkerMatcher
+
+__all__ = [
+    "ToolCall",
+    "ToolCallConfig",
+    "JsonParserConfig",
+    "detect_tool_call_start",
+    "get_tool_parser",
+    "get_available_tool_parsers",
+    "parse_tool_calls",
+    "ParserResult",
+    "ReasoningParser",
+    "get_reasoning_parser",
+    "get_available_reasoning_parsers",
+    "JailedStream",
+    "MarkerMatcher",
+]

@@ -1,0 +1,220 @@
+"""Jailed stream: buffer chat deltas while a tool call may be forming.
+
+Reference behavior: `lib/llm/src/protocols/openai/chat_completions/jail.rs`
+(911 LoC) + `JAILED_STREAM_README.md` — when a start marker (or bare JSON)
+is detected in the content stream the choice is "jailed": content stops
+flowing to the client and accumulates until the tool-call region closes or
+the stream ends. Then the buffer is parsed: tool calls are emitted as
+`delta.tool_calls` (finish_reason becomes ``tool_calls``); a failed parse
+releases the accumulated text as ordinary content. Partial marker matches
+straddling chunk boundaries are held back (MarkerMatcher analog,
+`utils::MarkerMatcher`).
+
+Operates on our wire chunks (plain dicts from `protocols_openai.chat_chunk`);
+reasoning splitting runs first so `<think>` text is never mistaken for
+content or jailed (preprocessor.rs:629-700 ordering).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.parsers.reasoning import ReasoningParser
+from dynamo_tpu.parsers.util import MarkerMatcher
+from dynamo_tpu.parsers.tool_calls import (
+    ToolCallConfig,
+    detect_tool_call_start,
+    find_tool_call_end,
+    parse_tool_calls,
+)
+
+
+def _delta_content(chunk: dict) -> Optional[str]:
+    choices = chunk.get("choices") or []
+    if not choices:
+        return None
+    return choices[0].get("delta", {}).get("content")
+
+
+def _rewrite(chunk: dict, *, content: Optional[str] = None,
+             reasoning: Optional[str] = None,
+             tool_calls: Optional[list[dict]] = None,
+             finish_reason: Any = "__keep__") -> dict:
+    out = copy.deepcopy(chunk)
+    delta: dict = {}
+    role = out["choices"][0].get("delta", {}).get("role")
+    if role:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    if reasoning is not None:
+        delta["reasoning_content"] = reasoning
+    if tool_calls is not None:
+        delta["tool_calls"] = tool_calls
+    out["choices"][0]["delta"] = delta
+    if finish_reason != "__keep__":
+        out["choices"][0]["finish_reason"] = finish_reason
+    return out
+
+
+class JailedStream:
+    """Async transform over chat completion chunks for one request."""
+
+    def __init__(self, tool_config: Optional[ToolCallConfig] = None,
+                 reasoning: Optional[ReasoningParser] = None) -> None:
+        self.tool_config = tool_config
+        self.reasoning = reasoning
+        self._jailed = False
+        self._jail_bare = False   # jail opened by bare JSON, not a marker
+        self._jail_buf = ""       # accumulated content while jailed
+        self._hold = ""           # partial-marker holdback while unjailed
+        self._calls_emitted = False
+        if tool_config is not None:
+            self._matcher = MarkerMatcher(tool_config.json.start_tokens)
+        else:
+            self._matcher = MarkerMatcher([])
+
+    async def apply(self, stream: AsyncIterator[dict]
+                    ) -> AsyncIterator[dict]:
+        template: Optional[dict] = None
+        async for chunk in stream:
+            choices = chunk.get("choices") or []
+            if not choices:
+                yield chunk
+                continue
+            template = template or chunk
+            content = _delta_content(chunk)
+            finish = choices[0].get("finish_reason")
+            if content:
+                for out in self._feed(chunk, content):
+                    yield out
+            elif not finish:
+                yield chunk  # role-only prologue etc.
+            if finish:
+                for out in self._flush(chunk, finish):
+                    yield out
+
+    # -- internals -----------------------------------------------------------
+
+    def _feed(self, chunk: dict, content: str) -> list[dict]:
+        outs: list[dict] = []
+        if self.reasoning is not None:
+            r = self.reasoning.parse_streaming_incremental(content)
+            if r.reasoning_text:
+                outs.append(_rewrite(chunk, reasoning=r.reasoning_text))
+            content = r.normal_text
+            if not content:
+                return outs
+        if self.tool_config is None:
+            outs.append(_rewrite(chunk, content=content))
+            return outs
+        if self._jailed:
+            self._jail_buf += content
+            outs.extend(self._try_unjail(chunk))
+            return outs
+        text = self._hold + content
+        self._hold = ""
+        pos, tok = self._matcher.find(text)
+        bare = -1
+        if self.tool_config.allow_bare_json and not self._calls_emitted:
+            s = text.lstrip()
+            if s and s[0] in "{[":
+                bare = len(text) - len(s)
+        if 0 <= bare and (pos < 0 or bare < pos):
+            before, self._jail_buf = text[:bare], text[bare:]
+            self._jailed = True
+            self._jail_bare = True
+            if before.strip():
+                outs.append(_rewrite(chunk, content=before))
+            outs.extend(self._try_unjail(chunk))
+            return outs
+        if pos >= 0:
+            before = text[:pos]
+            self._jail_buf = text[pos:]
+            self._jailed = True
+            self._jail_bare = False
+            if before:
+                outs.append(_rewrite(chunk, content=before))
+            outs.extend(self._try_unjail(chunk))
+            return outs
+        hold = self._matcher.partial_len(text)
+        if hold:
+            self._hold = text[-hold:]
+            text = text[:-hold]
+        if text:
+            outs.append(_rewrite(chunk, content=text))
+        return outs
+
+    def _try_unjail(self, chunk: dict) -> list[dict]:
+        """While jailed: if the call region has closed, parse and release."""
+        assert self.tool_config is not None
+        end = find_tool_call_end(self._jail_buf, self.tool_config,
+                                 bare=self._jail_bare)
+        if end < 0:
+            return []
+        region, trailing = self._jail_buf[:end], self._jail_buf[end:]
+        normal, calls = parse_tool_calls(region, self.tool_config)
+        if not calls:
+            return []  # keep buffering; decide at flush
+        self._jailed = False
+        self._jail_bare = False
+        self._jail_buf = ""
+        self._calls_emitted = True
+        outs = []
+        if normal:
+            outs.append(_rewrite(chunk, content=normal))
+        outs.append(_rewrite(chunk, tool_calls=[
+            c.to_openai(i) for i, c in enumerate(calls)]))
+        if trailing.strip():
+            outs.append(_rewrite(chunk, content=trailing))
+        return outs
+
+    def _flush(self, finish_chunk: dict, finish: str) -> list[dict]:
+        """Stream is ending: resolve any jailed/held text, then emit the
+        finish chunk (finish_reason → tool_calls when calls were made)."""
+        outs: list[dict] = []
+        if self.reasoning is not None:
+            # drain the reasoning parser's held partial-marker text
+            r = self.reasoning.flush()
+            if r.reasoning_text:
+                outs.append(_rewrite(finish_chunk, reasoning=r.reasoning_text,
+                                     finish_reason=None))
+            if r.normal_text:
+                if self._jailed:
+                    self._jail_buf += r.normal_text
+                else:
+                    self._hold += r.normal_text
+        leftover = self._hold
+        self._hold = ""
+        if self._jailed and self.tool_config is not None and leftover:
+            # held partial-marker text belongs to the jail buffer
+            self._jail_buf += leftover
+            leftover = ""
+        if self._jailed and self.tool_config is not None:
+            normal, calls = parse_tool_calls(self._jail_buf,
+                                             self.tool_config)
+            if calls:
+                self._calls_emitted = True
+                if normal:
+                    outs.append(_rewrite(finish_chunk, content=normal,
+                                         finish_reason=None))
+                outs.append(_rewrite(finish_chunk, tool_calls=[
+                    c.to_openai(i) for i, c in enumerate(calls)],
+                    finish_reason=None))
+            elif self._jail_buf:
+                outs.append(_rewrite(finish_chunk, content=self._jail_buf,
+                                     finish_reason=None))
+            self._jailed = False
+            self._jail_buf = ""
+        elif leftover:
+            outs.append(_rewrite(finish_chunk, content=leftover,
+                                 finish_reason=None))
+        for out in outs:  # usage rides only the true final chunk
+            out.pop("usage", None)
+        final = copy.deepcopy(finish_chunk)
+        final["choices"][0]["delta"] = {}
+        if self._calls_emitted:
+            final["choices"][0]["finish_reason"] = "tool_calls"
+        outs.append(final)
+        return outs

@@ -1,0 +1,167 @@
+"""Reasoning-block parsers: split model output into reasoning vs normal text.
+
+Reference behavior: `lib/parsers/src/reasoning/` — `ReasoningParser` trait
+(`mod.rs:70-83`: complete + streaming-incremental entry points, marker
+tokens never appear in either output), `BasicReasoningParser`
+(`base_parser.rs`) with per-model marker presets, granite's phrase markers
+(`granite_parser.rs`).
+
+Streaming contract: ``parse_streaming_incremental`` returns only the DELTA
+attributable to this chunk; partial marker matches are held back across
+chunks so a marker split over two deltas is still recognized. Call
+``flush()`` at end of stream to drain any held-back text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.parsers.util import partial_suffix_len
+
+
+@dataclass
+class ParserResult:
+    normal_text: str = ""
+    reasoning_text: str = ""
+
+
+class ReasoningParser:
+    """Marker-driven reasoning splitter (BasicReasoningParser analog).
+
+    ``force_reasoning``: model starts inside a reasoning block with no
+    opening marker (deepseek-r1 style). Multiple start/end spellings are
+    supported (granite phrases its markers two ways)."""
+
+    def __init__(self, think_start: str = "<think>",
+                 think_end: str = "</think>",
+                 force_reasoning: bool = False,
+                 extra_starts: Optional[list[str]] = None,
+                 extra_ends: Optional[list[str]] = None) -> None:
+        self.starts = [think_start] + list(extra_starts or [])
+        self.ends = [think_end] + list(extra_ends or [])
+        self.force_reasoning = force_reasoning
+        self.reset()
+
+    def reset(self) -> None:
+        self._in_reasoning = self.force_reasoning
+        self._ended = False       # end marker already seen (one block max)
+        self._buffer = ""         # held-back partial marker text
+
+    # -- complete text -------------------------------------------------------
+
+    def detect_and_parse_reasoning(self, text: str) -> ParserResult:
+        """Standalone parse of a complete output; resets streaming state."""
+        self.reset()
+        normal = []
+        reasoning = []
+        rest = text
+        if not self._in_reasoning:
+            start, tok = self._find_first(rest, self.starts)
+            if start < 0:
+                return ParserResult(normal_text=text)
+            normal.append(rest[:start])
+            rest = rest[start + len(tok):]
+        end, etok = self._find_first(rest, self.ends)
+        if end < 0:
+            reasoning.append(rest)
+        else:
+            reasoning.append(rest[:end])
+            normal.append(rest[end + len(etok):])
+        self.reset()
+        return ParserResult(normal_text="".join(normal).strip(),
+                            reasoning_text="".join(reasoning).strip())
+
+    @staticmethod
+    def _find_first(text: str, markers: list[str]) -> tuple[int, str]:
+        best, best_tok = -1, ""
+        for tok in markers:
+            p = text.find(tok)
+            if p >= 0 and (best < 0 or p < best):
+                best, best_tok = p, tok
+        return best, best_tok
+
+    # -- streaming -----------------------------------------------------------
+
+    def parse_streaming_incremental(self, chunk: str) -> ParserResult:
+        text = self._buffer + chunk
+        self._buffer = ""
+        out = ParserResult()
+        while text:
+            if self._ended:
+                out.normal_text += text
+                return out
+            if self._in_reasoning:
+                pos, tok = self._find_first(text, self.ends)
+                if pos >= 0:
+                    out.reasoning_text += text[:pos]
+                    text = text[pos + len(tok):]
+                    self._in_reasoning = False
+                    self._ended = True
+                    continue
+                hold = partial_suffix_len(text, self.ends)
+                if hold:
+                    self._buffer = text[-hold:]
+                    text = text[:-hold]
+                out.reasoning_text += text
+                return out
+            start, tok = self._find_first(text, self.starts)
+            if start >= 0:
+                out.normal_text += text[:start]
+                text = text[start + len(tok):]
+                self._in_reasoning = True
+                continue
+            hold = partial_suffix_len(text, self.starts)
+            if hold:
+                self._buffer = text[-hold:]
+                text = text[:-hold]
+            out.normal_text += text
+            return out
+        return out
+
+    def flush(self) -> ParserResult:
+        """End of stream: release held-back text (a marker prefix that never
+        completed) attributed to the state it was held in."""
+        held, self._buffer = self._buffer, ""
+        if not held:
+            return ParserResult()
+        if self._in_reasoning:
+            return ParserResult(reasoning_text=held)
+        return ParserResult(normal_text=held)
+
+
+_REASONING = {
+    "basic": lambda: ReasoningParser(),
+    "deepseek_r1": lambda: ReasoningParser(force_reasoning=True),
+    "qwen3": lambda: ReasoningParser(),
+    "nemotron_deci": lambda: ReasoningParser(),
+    "kimi": lambda: ReasoningParser(think_start="◁think▷",
+                                    think_end="◁/think▷"),
+    "step3": lambda: ReasoningParser(force_reasoning=True),
+    "mistral": lambda: ReasoningParser(think_start="[THINK]",
+                                       think_end="[/THINK]"),
+    "gpt_oss": lambda: ReasoningParser(
+        think_start="<|channel|>analysis<|message|>",
+        think_end="<|end|>",
+        extra_starts=["<|channel|>final<|message|>"]),
+    "granite": lambda: ReasoningParser(
+        think_start="Here is my thought process:",
+        think_end="Here is my response:",
+        extra_starts=["Here's my thought process:"],
+        extra_ends=["Here's my response:"]),
+}
+
+
+def get_available_reasoning_parsers() -> list[str]:
+    return sorted(_REASONING)
+
+
+def get_reasoning_parser(name: Optional[str]) -> ReasoningParser:
+    if not name:
+        return ReasoningParser()
+    try:
+        return _REASONING[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown reasoning parser {name!r}; "
+            f"available: {get_available_reasoning_parsers()}") from None

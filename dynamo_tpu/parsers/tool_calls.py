@@ -1,0 +1,369 @@
+"""Tool-call parsing: model text → OpenAI tool_calls.
+
+Reference behavior: `lib/parsers/src/tool_calling/` — per-model configs
+(`config.rs`), JSON payload extraction between start/end markers
+(`json/base_json_parser.rs`), pythonic call lists
+(`pythonic/pythonic_parser.rs`), and the parser registry (`parsers.rs`).
+
+A parse takes the COMPLETE accumulated text (the jail buffers the stream
+until a decision can be made — see `jail.py`) and returns the text outside
+tool-call markers plus the structured calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ToolCall:
+    """One parsed call, OpenAI wire shape: arguments is a JSON string."""
+
+    name: str
+    arguments: str = "{}"
+    id: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.id:
+            self.id = f"call-{uuid.uuid4().hex[:24]}"
+
+    def to_openai(self, index: int = 0) -> dict:
+        return {
+            "index": index,
+            "id": self.id,
+            "type": "function",
+            "function": {"name": self.name, "arguments": self.arguments},
+        }
+
+
+@dataclass
+class JsonParserConfig:
+    """Marker + key config for JSON-format tool calls (ref config.rs:21-50).
+
+    An empty string in ``end_tokens`` means "end of text closes the call"
+    (llama3/mistral emit no closing marker)."""
+
+    start_tokens: list[str] = field(
+        default_factory=lambda: ["<TOOLCALL>", "<|python_tag|>"])
+    end_tokens: list[str] = field(
+        default_factory=lambda: ["</TOOLCALL>", ""])
+    name_keys: list[str] = field(default_factory=lambda: ["name"])
+    args_keys: list[str] = field(
+        default_factory=lambda: ["arguments", "parameters"])
+
+
+@dataclass
+class ToolCallConfig:
+    format: str = "json"  # json | pythonic
+    json: JsonParserConfig = field(default_factory=JsonParserConfig)
+    # when True, a bare leading '{' or '[' (no marker) may open a call
+    allow_bare_json: bool = True
+
+
+def _preset_hermes() -> ToolCallConfig:
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["<tool_call>"], end_tokens=["</tool_call>"]))
+
+
+def _preset_nemotron() -> ToolCallConfig:
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["<TOOLCALL>"], end_tokens=["</TOOLCALL>"]))
+
+
+def _preset_llama3() -> ToolCallConfig:
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["<|python_tag|>"], end_tokens=[""]))
+
+
+def _preset_mistral() -> ToolCallConfig:
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["[TOOL_CALLS]"], end_tokens=["[/TOOL_CALLS]", ""]))
+
+
+def _preset_phi4() -> ToolCallConfig:
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["functools"], end_tokens=[""]))
+
+
+def _preset_deepseek() -> ToolCallConfig:
+    # <｜tool▁calls▁begin｜><｜tool▁call▁begin｜>... JSON-ish; we accept the
+    # outer markers and parse each inner payload as JSON.
+    return ToolCallConfig(json=JsonParserConfig(
+        start_tokens=["<｜tool▁calls▁begin｜>", "<｜tool▁call▁begin｜>"],
+        end_tokens=["<｜tool▁calls▁end｜>", "<｜tool▁call▁end｜>", ""]))
+
+
+def _preset_pythonic() -> ToolCallConfig:
+    return ToolCallConfig(format="pythonic", json=JsonParserConfig(
+        start_tokens=["[", "<|python_start|>"],
+        end_tokens=["]", "<|python_end|>"]))
+
+
+_PARSERS = {
+    "default": ToolCallConfig,
+    "hermes": _preset_hermes,
+    "qwen": _preset_hermes,          # qwen uses hermes-style <tool_call>
+    "nemotron_deci": _preset_nemotron,
+    "llama3_json": _preset_llama3,
+    "mistral": _preset_mistral,
+    "phi4": _preset_phi4,
+    "deepseek_v3_1": _preset_deepseek,
+    "pythonic": _preset_pythonic,
+    "llama4_pythonic": _preset_pythonic,
+}
+
+
+def get_available_tool_parsers() -> list[str]:
+    return sorted(_PARSERS)
+
+
+def get_tool_parser(name: Optional[str]) -> ToolCallConfig:
+    if not name:
+        return ToolCallConfig()
+    try:
+        return _PARSERS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown tool-call parser {name!r}; "
+            f"available: {get_available_tool_parsers()}") from None
+
+
+# ---------------------------------------------------------------------------
+# detection (drives jail entry)
+
+def detect_tool_call_start(chunk: str, config: ToolCallConfig) -> bool:
+    """True if ``chunk`` could be the beginning of a tool call — either a
+    complete/partial start marker or (for bare-JSON formats) a leading
+    brace. Mirrors the reference's `detect_tool_call_start`."""
+    from dynamo_tpu.parsers.util import MarkerMatcher
+
+    m = MarkerMatcher(config.json.start_tokens)
+    if m.find(chunk)[0] >= 0 or m.partial_len(chunk) > 0:
+        return True
+    stripped = chunk.lstrip()
+    if config.allow_bare_json and config.format == "json" and (
+            stripped.startswith("{") or stripped.startswith("[")):
+        return True
+    if config.format == "pythonic" and stripped.startswith("["):
+        return True
+    return False
+
+
+def find_tool_call_end(text: str, config: ToolCallConfig,
+                       bare: bool = False) -> int:
+    """Index just past the end of the tool-call region, or -1 if it has not
+    closed yet (ref `find_tool_call_end_position`). Used by the jail to
+    release trailing text.
+
+    ``bare``: the region was opened by a bare JSON brace (no start marker),
+    so it closes when the JSON structure balances. Otherwise a config with
+    explicit end markers closes ONLY on a marker — a balanced payload must
+    keep waiting for "</tool_call>" or the marker would leak as content.
+    A config listing "" among its end tokens (llama3/mistral style) closes
+    at a balanced structure too."""
+    markerless_ok = bare or ("" in config.json.end_tokens) or not any(
+        config.json.end_tokens)
+    best = -1
+    for tok in config.json.end_tokens:
+        if not tok:
+            continue
+        pos = text.rfind(tok)
+        if pos >= 0:
+            best = max(best, pos + len(tok))
+    if best >= 0:
+        return best
+    if not markerless_ok:
+        return -1
+    # marker-less close: balanced-structure scan from the first brace
+    start = _first_json_start(text)
+    if start < 0:
+        return -1
+    end = _balanced_end(text, start)
+    return end if end >= 0 else -1
+
+
+# ---------------------------------------------------------------------------
+# complete-text parsing
+
+def parse_tool_calls(text: str, config: Optional[ToolCallConfig] = None
+                     ) -> tuple[str, list[ToolCall]]:
+    """Parse the complete text → (normal_text, calls).
+
+    Normal text is everything outside the marker-delimited call region(s);
+    marker tokens themselves are never part of either output."""
+    config = config or ToolCallConfig()
+    if config.format == "pythonic":
+        return _parse_pythonic(text, config)
+    return _parse_json(text, config)
+
+
+def _parse_json(text: str, config: ToolCallConfig
+                ) -> tuple[str, list[ToolCall]]:
+    jc = config.json
+    normal = text
+    payload = None
+
+    # 1) marker-delimited region wins
+    for tok in jc.start_tokens:
+        if tok and tok in text:
+            before, _, rest = text.partition(tok)
+            after = ""
+            for end in jc.end_tokens:
+                if end and end in rest:
+                    rest, _, after = rest.partition(end)
+                    break
+            payload, normal = rest.strip(), before + after
+            break
+
+    # 2) bare JSON: the text itself starts with a {...} / [...] structure
+    if payload is None and config.allow_bare_json:
+        start = _first_json_start(text)
+        if start >= 0 and not text[:start].strip():
+            end = _balanced_end(text, start)
+            if end > start:
+                payload = text[start:end]
+                normal = text[:start] + text[end:]
+    if payload is None:
+        return text, []
+
+    calls = []
+    for obj in _iter_json_objects(payload):
+        call = _call_from_obj(obj, jc)
+        if call is not None:
+            calls.append(call)
+    if not calls:
+        return text, []  # looked like a call but wasn't: leave text alone
+    return normal.strip(), calls
+
+
+def _call_from_obj(obj, jc: JsonParserConfig) -> Optional[ToolCall]:
+    if not isinstance(obj, dict):
+        return None
+    name = next((obj[k] for k in jc.name_keys if k in obj), None)
+    if not isinstance(name, str) or not name:
+        return None
+    args = next((obj[k] for k in jc.args_keys if k in obj), {})
+    if isinstance(args, str):
+        try:
+            json.loads(args)
+            args_s = args
+        except ValueError:
+            args_s = json.dumps({"value": args})
+    else:
+        args_s = json.dumps(args)
+    return ToolCall(name=name, arguments=args_s)
+
+
+def _iter_json_objects(payload: str):
+    """Yield dicts from a payload that may be one object, an array of
+    objects, or several concatenated/semicolon-separated objects."""
+    payload = payload.strip()
+    if not payload:
+        return
+    try:
+        doc = json.loads(payload)
+        if isinstance(doc, list):
+            yield from doc
+        else:
+            yield doc
+        return
+    except ValueError:
+        pass
+    # concatenated objects: scan balanced regions
+    i = 0
+    while i < len(payload):
+        start = _first_json_start(payload[i:])
+        if start < 0:
+            return
+        start += i
+        end = _balanced_end(payload, start)
+        if end < 0:
+            return
+        try:
+            yield json.loads(payload[start:end])
+        except ValueError:
+            pass
+        i = end
+
+
+def _first_json_start(text: str) -> int:
+    for i, ch in enumerate(text):
+        if ch in "{[":
+            return i
+    return -1
+
+
+def _balanced_end(text: str, start: int) -> int:
+    """End index (exclusive) of the balanced JSON structure at ``start``,
+    or -1 if unbalanced. String-literal aware."""
+    depth = 0
+    in_str = False
+    esc = False
+    for i in range(start, len(text)):
+        ch = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch in "{[":
+            depth += 1
+        elif ch in "}]":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return -1
+
+
+# ---------------------------------------------------------------------------
+# pythonic format: [get_weather(location="SF"), f2(x=1)]
+
+def _parse_pythonic(text: str, config: ToolCallConfig
+                    ) -> tuple[str, list[ToolCall]]:
+    body = text
+    for tok in ("<|python_start|>",):
+        if tok in body:
+            body = body.split(tok, 1)[1]
+    for tok in ("<|python_end|>",):
+        if tok in body:
+            body = body.split(tok, 1)[0]
+    start = body.find("[")
+    if start < 0:
+        return text, []
+    end = _balanced_end(body, start)
+    if end < 0:
+        return text, []
+    try:
+        tree = ast.parse(body[start:end].strip(), mode="eval")
+    except SyntaxError:
+        return text, []
+    if not isinstance(tree.body, ast.List):
+        return text, []
+    calls = []
+    for node in tree.body.elts:
+        if not isinstance(node, ast.Call):
+            return text, []
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else None)
+        if not name:
+            return text, []
+        try:
+            kwargs = {kw.arg: ast.literal_eval(kw.value)
+                      for kw in node.keywords if kw.arg}
+        except (ValueError, SyntaxError):
+            return text, []
+        calls.append(ToolCall(name=name, arguments=json.dumps(kwargs)))
+    if not calls:
+        return text, []
+    normal = (body[:start] + body[end:]).strip()
+    return normal, calls

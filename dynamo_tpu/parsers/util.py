@@ -1,0 +1,39 @@
+"""Shared marker-scanning primitives for the parser package.
+
+Reference analog: `lib/llm/src/utils.rs` MarkerMatcher/MatchResult used by
+the jailed stream — complete-match, partial-suffix (a marker may straddle
+chunk boundaries), or no match.
+"""
+
+from __future__ import annotations
+
+
+def partial_suffix_len(text: str, markers: list[str]) -> int:
+    """Length of the longest suffix of ``text`` that is a proper prefix of
+    any marker (i.e. might complete into a marker with more input)."""
+    best = 0
+    for m in markers:
+        for k in range(min(len(text), len(m) - 1), 0, -1):
+            if text.endswith(m[:k]):
+                best = max(best, k)
+                break
+    return best
+
+
+class MarkerMatcher:
+    """Finds complete markers and held-back partial tails in a text window."""
+
+    def __init__(self, markers: list[str]) -> None:
+        self.markers = [m for m in markers if m]
+
+    def find(self, text: str) -> tuple[int, str]:
+        """(position, marker) of the earliest complete marker, else (-1, '')."""
+        best, tok = -1, ""
+        for m in self.markers:
+            p = text.find(m)
+            if p >= 0 and (best < 0 or p < best):
+                best, tok = p, m
+        return best, tok
+
+    def partial_len(self, text: str) -> int:
+        return partial_suffix_len(text, self.markers)

@@ -1,0 +1,381 @@
+"""Tool-call/reasoning parsers + jailed stream.
+
+Mirrors the reference's parser test style (`lib/parsers/src/tool_calling/*`
+inline tests, `lib/llm/tests/test_jail.rs`): fixture strings per model
+format, complete + streaming splits, jail buffering semantics end-to-end
+through the chat postprocess path.
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.parsers import (
+    JailedStream,
+    MarkerMatcher,
+    detect_tool_call_start,
+    get_available_reasoning_parsers,
+    get_available_tool_parsers,
+    get_reasoning_parser,
+    get_tool_parser,
+    parse_tool_calls,
+)
+
+# ---------------------------------------------------------------------------
+# tool-call parsing (complete text)
+
+HERMES = ('<tool_call>{"name": "get_weather", "arguments": '
+          '{"location": "SF", "unit": "f"}}</tool_call>')
+NEMOTRON = ('<TOOLCALL>[{"name": "get_weather", "arguments": '
+            '{"location": "SF"}}]</TOOLCALL>')
+LLAMA3 = ('<|python_tag|>{ "name": "get_weather", "arguments": '
+          '{"location": "SF"} }')
+MISTRAL = ('[TOOL_CALLS][{"name": "get_weather", "arguments": '
+           '{"location": "SF"}}]')
+BARE = '{"name": "get_weather", "parameters": {"location": "SF"}}'
+PYTHONIC = '[get_weather(location="SF"), get_time(tz="PST")]'
+
+
+@pytest.mark.parametrize("parser,text", [
+    ("hermes", HERMES),
+    ("nemotron_deci", NEMOTRON),
+    ("llama3_json", LLAMA3),
+    ("mistral", MISTRAL),
+    ("default", BARE),
+])
+def test_parse_single_call(parser, text):
+    normal, calls = parse_tool_calls(text, get_tool_parser(parser))
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments)["location"] == "SF"
+    assert normal == ""
+    assert calls[0].id.startswith("call-")
+
+
+def test_parse_with_surrounding_text():
+    text = f"Let me check. {HERMES} Done."
+    normal, calls = parse_tool_calls(text, get_tool_parser("hermes"))
+    assert len(calls) == 1
+    assert "Let me check." in normal and "Done." in normal
+
+
+def test_parse_multiple_calls_array():
+    text = ('<TOOLCALL>[{"name": "a", "arguments": {}}, '
+            '{"name": "b", "arguments": {"x": 1}}]</TOOLCALL>')
+    _, calls = parse_tool_calls(text, get_tool_parser("nemotron_deci"))
+    assert [c.name for c in calls] == ["a", "b"]
+    assert json.loads(calls[1].arguments) == {"x": 1}
+
+
+def test_parse_pythonic():
+    normal, calls = parse_tool_calls(PYTHONIC, get_tool_parser("pythonic"))
+    assert [c.name for c in calls] == ["get_weather", "get_time"]
+    assert json.loads(calls[0].arguments) == {"location": "SF"}
+    assert normal == ""
+
+
+def test_non_call_text_untouched():
+    text = "The answer is 42. Braces like {this} are not calls."
+    normal, calls = parse_tool_calls(text, get_tool_parser("hermes"))
+    assert calls == []
+    assert normal == text
+
+
+def test_bare_json_non_call_schema():
+    # JSON without a function-name key is NOT a tool call
+    text = '{"answer": 42}'
+    normal, calls = parse_tool_calls(text, get_tool_parser("default"))
+    assert calls == []
+    assert normal == text
+
+
+def test_detect_start_partial_marker():
+    cfg = get_tool_parser("hermes")
+    assert detect_tool_call_start("prefix <tool_", cfg)
+    assert detect_tool_call_start("<tool_call>", cfg)
+    assert detect_tool_call_start('  {"name":', cfg)
+    assert not detect_tool_call_start("plain text", cfg)
+
+
+def test_parser_registry():
+    assert "hermes" in get_available_tool_parsers()
+    with pytest.raises(ValueError):
+        get_tool_parser("nope")
+    with pytest.raises(ValueError):
+        get_reasoning_parser("nope")
+    assert "deepseek_r1" in get_available_reasoning_parsers()
+
+
+# ---------------------------------------------------------------------------
+# reasoning parsers
+
+def test_reasoning_complete():
+    p = get_reasoning_parser("basic")
+    r = p.detect_and_parse_reasoning(
+        "<think>step 1, step 2</think>The answer is 4.")
+    assert r.reasoning_text == "step 1, step 2"
+    assert r.normal_text == "The answer is 4."
+
+
+def test_reasoning_force_start():
+    # deepseek-r1 starts inside the think block with no opening marker
+    p = get_reasoning_parser("deepseek_r1")
+    r = p.detect_and_parse_reasoning("chain of thought</think>final")
+    assert r.reasoning_text == "chain of thought"
+    assert r.normal_text == "final"
+
+
+def test_reasoning_streaming_marker_split_across_chunks():
+    p = get_reasoning_parser("basic")
+    chunks = ["<thi", "nk>rea", "soning</th", "ink>ans", "wer"]
+    normal, reasoning = "", ""
+    for c in chunks:
+        r = p.parse_streaming_incremental(c)
+        normal += r.normal_text
+        reasoning += r.reasoning_text
+    assert reasoning == "reasoning"
+    assert normal == "answer"
+
+
+def test_reasoning_streaming_no_marker():
+    p = get_reasoning_parser("basic")
+    r1 = p.parse_streaming_incremental("hello ")
+    r2 = p.parse_streaming_incremental("world")
+    assert r1.normal_text + r2.normal_text == "hello world"
+    assert r1.reasoning_text == r2.reasoning_text == ""
+
+
+def test_reasoning_granite():
+    p = get_reasoning_parser("granite")
+    r = p.detect_and_parse_reasoning(
+        "Here is my thought process: hmm. Here is my response: yes.")
+    assert "hmm." in r.reasoning_text
+    assert r.normal_text == "yes."
+
+
+def test_marker_matcher():
+    m = MarkerMatcher(["<tool_call>"])
+    assert m.find("ab <tool_call> cd") == (3, "<tool_call>")
+    assert m.find("none") == (-1, "")
+    assert m.partial_len("text <tool_ca") == len("<tool_ca")
+    assert m.partial_len("text") == 0
+
+
+# ---------------------------------------------------------------------------
+# jailed stream
+
+def _chunk(content=None, finish=None, role=None, usage=None):
+    delta = {}
+    if role:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    out = {"id": "c1", "object": "chat.completion.chunk", "created": 1,
+           "model": "m",
+           "choices": [{"index": 0, "delta": delta,
+                        "finish_reason": finish}]}
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+async def _agen(items):
+    for it in items:
+        yield it
+
+
+async def _collect(stream):
+    return [c async for c in stream]
+
+
+def _texts(chunks):
+    return "".join(c["choices"][0]["delta"].get("content") or ""
+                   for c in chunks)
+
+
+def _tool_calls(chunks):
+    out = []
+    for c in chunks:
+        out.extend(c["choices"][0]["delta"].get("tool_calls") or [])
+    return out
+
+
+async def test_jail_buffers_and_emits_tool_call():
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    pieces = ["I will call. ", "<tool_call>{\"name\": \"f\",",
+              " \"arguments\": {\"x\": 1}}", "</tool_call>"]
+    chunks = ([_chunk(role="assistant")] + [_chunk(p) for p in pieces]
+              + [_chunk(finish="stop", usage={"total_tokens": 5})])
+    outs = await _collect(js.apply(_agen(chunks)))
+    calls = _tool_calls(outs)
+    assert len(calls) == 1
+    assert calls[0]["function"]["name"] == "f"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"x": 1}
+    # content before the call flows through; marker text never appears
+    assert "I will call." in _texts(outs)
+    assert "<tool_call>" not in _texts(outs)
+    # finish_reason overridden to tool_calls on the final chunk
+    assert outs[-1]["choices"][0]["finish_reason"] == "tool_calls"
+    assert outs[-1]["usage"] == {"total_tokens": 5}
+
+
+async def test_jail_releases_non_call_text():
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    # looks like it may start a call (partial marker) but never does
+    chunks = [_chunk("half a <tool"), _chunk(" but not really"),
+              _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _texts(outs) == "half a <tool but not really"
+    assert _tool_calls(outs) == []
+    assert outs[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+async def test_jail_stream_end_parses_markerless_call():
+    # llama3 style: no end marker; the call closes at stream end
+    js = JailedStream(tool_config=get_tool_parser("llama3_json"))
+    chunks = [_chunk('<|python_tag|>{"name": "f", "arguments"'),
+              _chunk(': {"q": "x"}}'), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    calls = _tool_calls(outs)
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "f"
+    assert outs[-1]["choices"][0]["finish_reason"] == "tool_calls"
+
+
+async def test_jail_with_reasoning():
+    js = JailedStream(tool_config=get_tool_parser("hermes"),
+                      reasoning=get_reasoning_parser("basic"))
+    chunks = [_chunk("<think>let me th"), _chunk("ink</think>done "),
+              _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    reasoning = "".join(
+        c["choices"][0]["delta"].get("reasoning_content") or ""
+        for c in outs)
+    assert reasoning == "let me think"
+    assert _texts(outs).strip() == "done"
+
+
+async def test_jail_passthrough_without_config():
+    js = JailedStream(tool_config=None,
+                      reasoning=get_reasoning_parser("basic"))
+    chunks = [_chunk("plain"), _chunk(" text"), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _texts(outs) == "plain text"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the preprocessor postprocess path
+
+async def test_chat_pipeline_emits_tool_calls():
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import make_tokenizer
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.engine import FnEngine, build_pipeline
+
+    tok = make_tokenizer("word")
+
+    async def gen(req, ctx):
+        # engine emits a hermes tool call as detokenized text
+        yield {"token_ids": [1], "text": '<tool_call>{"name": "f", '}
+        yield {"token_ids": [2], "text": '"arguments": {}}</tool_call>',
+               "finish_reason": "stop"}
+
+    pre = OpenAIPreprocessor(tok, "m", tool_call_parser="hermes")
+    pipe = build_pipeline(pre, sink=FnEngine(gen))
+    req = {"_kind": "chat", "body": {
+        "model": "m", "messages": [{"role": "user", "content": "hi"}],
+        "tools": [{"type": "function",
+                   "function": {"name": "f", "parameters": {}}}]}}
+    outs = [c async for c in pipe.generate(req, Context())]
+    calls = _tool_calls(outs)
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "f"
+    assert outs[-1]["choices"][0]["finish_reason"] == "tool_calls"
+
+
+async def test_chat_pipeline_no_tools_no_jail():
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import make_tokenizer
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.engine import FnEngine, build_pipeline
+
+    tok = make_tokenizer("word")
+
+    async def gen(req, ctx):
+        yield {"token_ids": [1], "text": "hello", "finish_reason": "stop"}
+
+    # parser configured on the model, but the request carries no tools
+    pre = OpenAIPreprocessor(tok, "m", tool_call_parser="hermes")
+    pipe = build_pipeline(pre, sink=FnEngine(gen))
+    req = {"_kind": "chat", "body": {
+        "model": "m", "messages": [{"role": "user", "content": "hi"}]}}
+    outs = [c async for c in pipe.generate(req, Context())]
+    assert _texts(outs) == "hello"
+    assert outs[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+# ---------------------------------------------------------------------------
+# regressions from review: marker-close discipline, whitespace, flush paths
+
+async def test_jail_end_marker_split_across_chunks_no_leak():
+    # the closing marker arrives in a LATER chunk than the balanced JSON;
+    # it must never leak into content (review: premature markerless close)
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    chunks = [_chunk("<tool_call>"), _chunk('{"name":"f","arguments":{}}'),
+              _chunk("</tool_call>"), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert len(_tool_calls(outs)) == 1
+    assert "</tool_call>" not in _texts(outs)
+    assert "{" not in _texts(outs)
+
+
+async def test_jail_whitespace_first_chunk_streams_through():
+    # review: a leading whitespace-only chunk must not jail the stream
+    js = JailedStream(tool_config=get_tool_parser("default"))
+    chunks = [_chunk("\n"), _chunk("Hello"), _chunk(" world"),
+              _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    texts = [c["choices"][0]["delta"].get("content") for c in outs
+             if c["choices"][0]["delta"].get("content")]
+    assert "".join(texts) == "\nHello world"
+    # streaming preserved: content arrived in >1 chunk, not one flush blob
+    assert len(texts) >= 2
+
+
+async def test_reasoning_holdback_flushed_at_stream_end():
+    # review: output ending in a marker prefix ('<') was truncated
+    js = JailedStream(reasoning=get_reasoning_parser("basic"))
+    chunks = [_chunk("a < b and b <"), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _texts(outs) == "a < b and b <"
+
+
+def test_granite_alt_end_marker_streamed():
+    # review: "Here's my response:" split across chunks never unjailed
+    p = get_reasoning_parser("granite")
+    p._in_reasoning = True  # already thinking
+    normal = reasoning = ""
+    for c in ["thinking... Here's my resp", "onse:", " the answer"]:
+        r = p.parse_streaming_incremental(c)
+        normal += r.normal_text
+        reasoning += r.reasoning_text
+    assert normal.strip() == "the answer"
+    assert "thinking..." in reasoning
+    assert "resp" not in normal
+
+
+async def test_unary_aggregation_carries_tool_calls():
+    # review: stream=false responses dropped delta.tool_calls entirely
+    from dynamo_tpu.llm.protocols_openai import aggregate_chat_stream
+
+    js = JailedStream(tool_config=get_tool_parser("hermes"),
+                      reasoning=get_reasoning_parser("basic"))
+    chunks = [_chunk("<think>hm</think>"),
+              _chunk('<tool_call>{"name": "f", "arguments": {"x": 1}}'
+                     "</tool_call>"),
+              _chunk(finish="stop", usage={"total_tokens": 3})]
+    full = await aggregate_chat_stream(js.apply(_agen(chunks)))
+    msg = full["choices"][0]["message"]
+    assert msg["tool_calls"][0]["function"]["name"] == "f"
+    assert "index" not in msg["tool_calls"][0]
+    assert msg["reasoning_content"] == "hm"
+    assert full["choices"][0]["finish_reason"] == "tool_calls"
+    assert full["usage"] == {"total_tokens": 3}

@@ -189,8 +189,15 @@ class HttpService:
 
     @staticmethod
     def _has_content(chunk: dict) -> bool:
+        """True for any token-bearing delta. reasoning_content and
+        tool_calls count — the model IS streaming tokens during a think
+        block or a jailed call region, and the planner's TTFT/ITL
+        correction factors would be wildly distorted if those deltas
+        looked like silence."""
         for choice in chunk.get("choices", ()):
-            if choice.get("delta", {}).get("content") or choice.get("text"):
+            delta = choice.get("delta", {})
+            if (delta.get("content") or delta.get("reasoning_content")
+                    or delta.get("tool_calls") or choice.get("text")):
                 return True
         return False
 

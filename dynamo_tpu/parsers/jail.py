@@ -70,24 +70,27 @@ class JailedStream:
         self._jail_buf = ""       # accumulated content while jailed
         self._hold = ""           # partial-marker holdback while unjailed
         self._calls_emitted = False
+        self._content_emitted = False  # any non-whitespace content sent
+        self._call_index = 0      # streaming tool_calls index (per stream)
         if tool_config is not None:
             self._matcher = MarkerMatcher(tool_config.json.start_tokens)
+            self._end_matcher = MarkerMatcher(tool_config.json.end_tokens)
         else:
             self._matcher = MarkerMatcher([])
+            self._end_matcher = MarkerMatcher([])
 
     async def apply(self, stream: AsyncIterator[dict]
                     ) -> AsyncIterator[dict]:
-        template: Optional[dict] = None
         async for chunk in stream:
             choices = chunk.get("choices") or []
             if not choices:
                 yield chunk
                 continue
-            template = template or chunk
             content = _delta_content(chunk)
             finish = choices[0].get("finish_reason")
             if content:
                 for out in self._feed(chunk, content):
+                    self._note_emitted(out)
                     yield out
             elif not finish:
                 yield chunk  # role-only prologue etc.
@@ -95,11 +98,16 @@ class JailedStream:
                 for out in self._flush(chunk, finish):
                     yield out
 
+    def _note_emitted(self, out: dict) -> None:
+        if (out["choices"][0]["delta"].get("content") or "").strip():
+            self._content_emitted = True
+
     # -- internals -----------------------------------------------------------
 
-    def _feed(self, chunk: dict, content: str) -> list[dict]:
+    def _feed(self, chunk: dict, content: str,
+              through_reasoning: bool = True) -> list[dict]:
         outs: list[dict] = []
-        if self.reasoning is not None:
+        if self.reasoning is not None and through_reasoning:
             r = self.reasoning.parse_streaming_incremental(content)
             if r.reasoning_text:
                 outs.append(_rewrite(chunk, reasoning=r.reasoning_text))
@@ -117,7 +125,11 @@ class JailedStream:
         self._hold = ""
         pos, tok = self._matcher.find(text)
         bare = -1
-        if self.tool_config.allow_bare_json and not self._calls_emitted:
+        if (self.tool_config.allow_bare_json and not self._calls_emitted
+                and not self._content_emitted):
+            # bare JSON only opens a jail at the very start of the
+            # response — prose like "here is an example: {...}" later in
+            # the stream must never be re-interpreted as a call
             s = text.lstrip()
             if s and s[0] in "{[":
                 bare = len(text) - len(s)
@@ -146,28 +158,50 @@ class JailedStream:
             outs.append(_rewrite(chunk, content=text))
         return outs
 
+    def _emit_calls(self, chunk: dict, calls) -> dict:
+        """tool_calls delta with stream-wide indices (OpenAI clients merge
+        streamed call fragments BY index, so each call needs a fresh one)."""
+        self._calls_emitted = True
+        out = _rewrite(chunk, tool_calls=[
+            c.to_openai(self._call_index + i) for i, c in enumerate(calls)])
+        self._call_index += len(calls)
+        return out
+
     def _try_unjail(self, chunk: dict) -> list[dict]:
-        """While jailed: if the call region has closed, parse and release."""
+        """While jailed: if the call region has closed, parse and release.
+
+        A region closed by an EXPLICIT end marker (or opened bare) that
+        fails to parse is released as plain content — jail.rs does the
+        same; holding it would silently stop streaming for the rest of
+        the response. A marker-opened region that merely balanced keeps
+        buffering (the real payload may still be arriving)."""
         assert self.tool_config is not None
+        end_pos, end_tok = self._end_matcher.find(self._jail_buf)
+        marker_close = end_pos >= 0
         end = find_tool_call_end(self._jail_buf, self.tool_config,
                                  bare=self._jail_bare)
         if end < 0:
             return []
         region, trailing = self._jail_buf[:end], self._jail_buf[end:]
         normal, calls = parse_tool_calls(region, self.tool_config)
-        if not calls:
-            return []  # keep buffering; decide at flush
+        if not calls and not (marker_close or self._jail_bare):
+            return []  # balanced but marker-opened: decide at flush
         self._jailed = False
         self._jail_bare = False
         self._jail_buf = ""
-        self._calls_emitted = True
         outs = []
-        if normal:
-            outs.append(_rewrite(chunk, content=normal))
-        outs.append(_rewrite(chunk, tool_calls=[
-            c.to_openai(i) for i, c in enumerate(calls)]))
-        if trailing.strip():
-            outs.append(_rewrite(chunk, content=trailing))
+        if not calls:
+            # closed but not a call: release the raw region and resume
+            outs.append(_rewrite(chunk, content=region))
+        else:
+            if normal:
+                outs.append(_rewrite(chunk, content=normal))
+            outs.append(self._emit_calls(chunk, calls))
+        if trailing:
+            # trailing text may itself open a new jail — re-scan it
+            # (already reasoning-filtered on the way in, so skip that pass)
+            outs.extend(self._feed(chunk, trailing,
+                                   through_reasoning=False))
         return outs
 
     def _flush(self, finish_chunk: dict, finish: str) -> list[dict]:
@@ -195,13 +229,12 @@ class JailedStream:
             normal, calls = parse_tool_calls(self._jail_buf,
                                              self.tool_config)
             if calls:
-                self._calls_emitted = True
                 if normal:
                     outs.append(_rewrite(finish_chunk, content=normal,
                                          finish_reason=None))
-                outs.append(_rewrite(finish_chunk, tool_calls=[
-                    c.to_openai(i) for i, c in enumerate(calls)],
-                    finish_reason=None))
+                out = self._emit_calls(finish_chunk, calls)
+                out["choices"][0]["finish_reason"] = None
+                outs.append(out)
             elif self._jail_buf:
                 outs.append(_rewrite(finish_chunk, content=self._jail_buf,
                                      finish_reason=None))

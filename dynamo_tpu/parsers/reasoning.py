@@ -37,9 +37,14 @@ class ReasoningParser:
                  think_end: str = "</think>",
                  force_reasoning: bool = False,
                  extra_starts: Optional[list[str]] = None,
-                 extra_ends: Optional[list[str]] = None) -> None:
+                 extra_ends: Optional[list[str]] = None,
+                 strip_tokens: Optional[list[str]] = None) -> None:
         self.starts = [think_start] + list(extra_starts or [])
         self.ends = [think_end] + list(extra_ends or [])
+        # control tokens removed from normal output without changing state
+        # (gpt_oss channel framing: "<|channel|>final<|message|>" etc.);
+        # list longer tokens first so overlapping spellings match greedily
+        self.strips = list(strip_tokens or [])
         self.force_reasoning = force_reasoning
         self.reset()
 
@@ -51,26 +56,16 @@ class ReasoningParser:
     # -- complete text -------------------------------------------------------
 
     def detect_and_parse_reasoning(self, text: str) -> ParserResult:
-        """Standalone parse of a complete output; resets streaming state."""
+        """Standalone parse of a complete output; resets streaming state.
+        One pass of the streaming machinery + flush keeps complete and
+        incremental semantics identical by construction."""
         self.reset()
-        normal = []
-        reasoning = []
-        rest = text
-        if not self._in_reasoning:
-            start, tok = self._find_first(rest, self.starts)
-            if start < 0:
-                return ParserResult(normal_text=text)
-            normal.append(rest[:start])
-            rest = rest[start + len(tok):]
-        end, etok = self._find_first(rest, self.ends)
-        if end < 0:
-            reasoning.append(rest)
-        else:
-            reasoning.append(rest[:end])
-            normal.append(rest[end + len(etok):])
+        r = self.parse_streaming_incremental(text)
+        tail = self.flush()
         self.reset()
-        return ParserResult(normal_text="".join(normal).strip(),
-                            reasoning_text="".join(reasoning).strip())
+        return ParserResult(
+            normal_text=(r.normal_text + tail.normal_text).strip(),
+            reasoning_text=(r.reasoning_text + tail.reasoning_text).strip())
 
     @staticmethod
     def _find_first(text: str, markers: list[str]) -> tuple[int, str]:
@@ -88,9 +83,6 @@ class ReasoningParser:
         self._buffer = ""
         out = ParserResult()
         while text:
-            if self._ended:
-                out.normal_text += text
-                return out
             if self._in_reasoning:
                 pos, tok = self._find_first(text, self.ends)
                 if pos >= 0:
@@ -105,13 +97,21 @@ class ReasoningParser:
                     text = text[:-hold]
                 out.reasoning_text += text
                 return out
-            start, tok = self._find_first(text, self.starts)
-            if start >= 0:
-                out.normal_text += text[:start]
-                text = text[start + len(tok):]
+            # normal mode: look for a reasoning start (only before the
+            # one block ends) and for strip tokens (always)
+            starts = [] if self._ended else self.starts
+            spos, stok = self._find_first(text, starts)
+            ppos, ptok = self._find_first(text, self.strips)
+            if ppos >= 0 and (spos < 0 or ppos <= spos):
+                out.normal_text += text[:ppos]
+                text = text[ppos + len(ptok):]
+                continue
+            if spos >= 0:
+                out.normal_text += text[:spos]
+                text = text[spos + len(stok):]
                 self._in_reasoning = True
                 continue
-            hold = partial_suffix_len(text, self.starts)
+            hold = partial_suffix_len(text, starts + self.strips)
             if hold:
                 self._buffer = text[-hold:]
                 text = text[:-hold]
@@ -143,7 +143,11 @@ _REASONING = {
     "gpt_oss": lambda: ReasoningParser(
         think_start="<|channel|>analysis<|message|>",
         think_end="<|end|>",
-        extra_starts=["<|channel|>final<|message|>"]),
+        strip_tokens=[  # final-channel framing is normal text, not think
+            "<|start|>assistant<|channel|>final<|message|>",
+            "<|channel|>final<|message|>",
+            "<|start|>assistant",
+            "<|return|>"]),
     "granite": lambda: ReasoningParser(
         think_start="Here is my thought process:",
         think_end="Here is my response:",

@@ -178,11 +178,20 @@ def find_tool_call_end(text: str, config: ToolCallConfig,
         return best
     if not markerless_ok:
         return -1
-    # marker-less close: balanced-structure scan from the first brace
-    start = _first_json_start(text)
+    # marker-less close: balanced-structure scan over the PAYLOAD. Skip
+    # past the start marker first — "[TOOL_CALLS][{...", scanned from the
+    # marker's own '[', would "balance" at "[TOOL_CALLS]" and close the
+    # region before any payload arrived.
+    scan_from = 0
+    stripped = text.lstrip()
+    for tok in config.json.start_tokens:
+        if tok and stripped.startswith(tok):
+            scan_from = (len(text) - len(stripped)) + len(tok)
+            break
+    start = _first_json_start(text[scan_from:])
     if start < 0:
         return -1
-    end = _balanced_end(text, start)
+    end = _balanced_end(text, scan_from + start)
     return end if end >= 0 else -1
 
 
@@ -204,37 +213,60 @@ def parse_tool_calls(text: str, config: Optional[ToolCallConfig] = None
 def _parse_json(text: str, config: ToolCallConfig
                 ) -> tuple[str, list[ToolCall]]:
     jc = config.json
-    normal = text
-    payload = None
+    payloads: list[str] = []
+    normal_parts: list[str] = []
 
-    # 1) marker-delimited region wins
-    for tok in jc.start_tokens:
-        if tok and tok in text:
-            before, _, rest = text.partition(tok)
-            after = ""
-            for end in jc.end_tokens:
-                if end and end in rest:
-                    rest, _, after = rest.partition(end)
-                    break
-            payload, normal = rest.strip(), before + after
+    def first_start(s: str) -> tuple[int, str]:
+        best, best_tok = -1, ""
+        for tok in jc.start_tokens:
+            if not tok:
+                continue
+            p = s.find(tok)
+            if p >= 0 and (best < 0 or p < best):
+                best, best_tok = p, tok
+        return best, best_tok
+
+    # 1) ALL marker-delimited regions ("parallel tool calls" arrive as
+    #    several <tool_call>...</tool_call> blocks in one buffer)
+    rest = text
+    while True:
+        pos, tok = first_start(rest)
+        if pos < 0:
             break
+        normal_parts.append(rest[:pos])
+        rest = rest[pos + len(tok):]
+        end_pos, end_tok = -1, ""
+        for end in jc.end_tokens:
+            if not end:
+                continue
+            p = rest.find(end)
+            if p >= 0 and (end_pos < 0 or p < end_pos):
+                end_pos, end_tok = p, end
+        if end_pos >= 0:
+            payloads.append(rest[:end_pos].strip())
+            rest = rest[end_pos + len(end_tok):]
+        else:
+            payloads.append(rest.strip())
+            rest = ""
+    normal = "".join(normal_parts) + rest
 
     # 2) bare JSON: the text itself starts with a {...} / [...] structure
-    if payload is None and config.allow_bare_json:
+    if not payloads and config.allow_bare_json:
         start = _first_json_start(text)
         if start >= 0 and not text[:start].strip():
             end = _balanced_end(text, start)
             if end > start:
-                payload = text[start:end]
+                payloads = [text[start:end]]
                 normal = text[:start] + text[end:]
-    if payload is None:
+    if not payloads:
         return text, []
 
     calls = []
-    for obj in _iter_json_objects(payload):
-        call = _call_from_obj(obj, jc)
-        if call is not None:
-            calls.append(call)
+    for payload in payloads:
+        for obj in _iter_json_objects(payload):
+            call = _call_from_obj(obj, jc)
+            if call is not None:
+                calls.append(call)
     if not calls:
         return text, []  # looked like a call but wasn't: leave text alone
     return normal.strip(), calls
@@ -356,6 +388,11 @@ def _parse_pythonic(text: str, config: ToolCallConfig
         name = func.id if isinstance(func, ast.Name) else (
             func.attr if isinstance(func, ast.Attribute) else None)
         if not name:
+            return text, []
+        if node.args:
+            # positional args have no parameter names to map onto the
+            # OpenAI arguments object; dropping them would corrupt the
+            # call, so treat the whole region as plain text
             return text, []
         try:
             kwargs = {kw.arg: ast.literal_eval(kw.value)

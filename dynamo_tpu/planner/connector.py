@@ -33,10 +33,15 @@ class VirtualConnector:
     def __init__(self, runtime, namespace: str = "dynamo") -> None:
         self.runtime = runtime
         self.namespace = namespace
-        self.revision = 0
+        self.revision: int | None = None  # seeded from the store lazily
 
     async def set_component_replicas(
             self, targets: list[TargetReplica]) -> None:
+        if self.revision is None:
+            # resume monotonically after a planner restart: a supervisor
+            # that de-dupes on "revision increased" must never see it reset
+            self.revision = int((await self.read_targets()).get(
+                "revision", 0))
         self.revision += 1
         payload = {
             "revision": self.revision,

@@ -24,7 +24,11 @@ class BasePredictor(ABC):
 
     def add_data_point(self, value: float) -> None:
         if value is None or (isinstance(value, float) and math.isnan(value)):
-            value = 0.0
+            # undefined sample (idle interval: no requests → no ISL/OSL).
+            # Skipping — not coercing to 0 — keeps trend/EWMA forecasts
+            # from collapsing toward zero across traffic gaps; a true
+            # zero load is reported as num_req=0, never NaN.
+            return
         if not self.data_buffer and value == 0:
             return  # skip the initial idle period
         self.data_buffer.append(float(value))
@@ -43,7 +47,8 @@ class ConstantPredictor(BasePredictor):
     """Next load = last load."""
 
     def __init__(self, **kw) -> None:
-        super().__init__(minimum_data_points=1)
+        kw.setdefault("minimum_data_points", 1)
+        super().__init__(**kw)
 
     def predict_next(self) -> float:
         return self.get_last_value()

@@ -27,10 +27,17 @@ def _req(n_tokens: int, max_tokens: int, offset: int = 0) -> dict:
 
 
 async def profile_prefill(engine, isls: list[int],
-                          reps: int = 3) -> dict:
+                          reps: int = 3, num_chips: int = 1) -> dict:
     """TTFT(isl) + prefill tokens/sec/chip(isl): one request at a time,
-    max_tokens=1, distinct prompts (no prefix-cache hits)."""
-    out = {"isl": [], "ttft_ms": [], "thpt_per_chip": []}
+    max_tokens=1, distinct prompts (no prefix-cache hits).
+
+    ``num_chips``: chips the profiled engine spans (tp*pp). Engine
+    throughput is divided by it so ``thpt_per_chip`` is genuinely
+    per-chip — the planner multiplies back by chips-per-engine when
+    sizing pools, so recording engine-level numbers here would
+    double-count."""
+    out = {"isl": [], "ttft_ms": [], "thpt_per_chip": [],
+           "num_chips": num_chips}
     salt = 0
     for isl in isls:
         ttfts = []
@@ -43,17 +50,19 @@ async def profile_prefill(engine, isls: list[int],
         ttft = sorted(ttfts)[len(ttfts) // 2]
         out["isl"].append(isl)
         out["ttft_ms"].append(ttft * 1000)
-        out["thpt_per_chip"].append(isl / ttft)
+        out["thpt_per_chip"].append(isl / ttft / num_chips)
     return out
 
 
 async def profile_decode(engine, context_lengths: list[int],
                          concurrencies: list[int],
                          max_kv_tokens: int,
-                         osl: int = 32) -> dict:
-    """ITL + decode tokens/sec/chip over (kv_usage, context_length)."""
+                         osl: int = 32, num_chips: int = 1) -> dict:
+    """ITL + decode tokens/sec/chip over (kv_usage, context_length);
+    ``num_chips`` as in profile_prefill."""
     out = {"x_kv_usage": [], "y_context_length": [], "z_itl_ms": [],
-           "z_thpt_per_chip": [], "max_kv_tokens": max_kv_tokens}
+           "z_thpt_per_chip": [], "max_kv_tokens": max_kv_tokens,
+           "num_chips": num_chips}
     salt = 0
     for ctx_len in context_lengths:
         for conc in concurrencies:
@@ -81,7 +90,7 @@ async def profile_decode(engine, context_lengths: list[int],
                 min(1.0, conc * (ctx_len + osl / 2) / max_kv_tokens))
             out["y_context_length"].append(ctx_len + osl / 2)
             out["z_itl_ms"].append(itl * 1000)
-            out["z_thpt_per_chip"].append(total_tokens / wall)
+            out["z_thpt_per_chip"].append(total_tokens / wall / num_chips)
     return out
 
 
@@ -89,15 +98,18 @@ async def profile_engine(engine, *, isls: Optional[list[int]] = None,
                          context_lengths: Optional[list[int]] = None,
                          concurrencies: Optional[list[int]] = None,
                          max_kv_tokens: int = 16384,
+                         num_chips: int = 1,
                          output_path: Optional[str] = None) -> dict:
     """Full sweep → {"prefill": ..., "decode": ...} (JSON-serializable)."""
     isls = isls or [64, 256, 1024, 4096]
     context_lengths = context_lengths or [128, 512, 2048]
     concurrencies = concurrencies or [1, 4, 16]
     profile = {
-        "prefill": await profile_prefill(engine, isls),
+        "prefill": await profile_prefill(engine, isls,
+                                         num_chips=num_chips),
         "decode": await profile_decode(engine, context_lengths,
-                                       concurrencies, max_kv_tokens),
+                                       concurrencies, max_kv_tokens,
+                                       num_chips=num_chips),
     }
     if output_path:
         with open(output_path, "w") as f:

@@ -379,3 +379,83 @@ async def test_unary_aggregation_carries_tool_calls():
     assert msg["reasoning_content"] == "hm"
     assert full["choices"][0]["finish_reason"] == "tool_calls"
     assert full["usage"] == {"total_tokens": 3}
+
+
+# ---------------------------------------------------------------------------
+# review round 2 regressions
+
+def test_parallel_tool_calls_all_parsed():
+    # both <tool_call> blocks must parse; none leaks into content
+    text = ('<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {"x": 2}}</tool_call>')
+    normal, calls = parse_tool_calls(text, get_tool_parser("hermes"))
+    assert [c.name for c in calls] == ["a", "b"]
+    assert "<tool_call>" not in normal and normal == ""
+
+
+def test_pythonic_positional_args_fall_back_to_text():
+    text = '[get_weather("SF", units="c")]'
+    normal, calls = parse_tool_calls(text, get_tool_parser("pythonic"))
+    assert calls == []
+    assert normal == text
+
+
+def test_mistral_balanced_scan_skips_start_marker():
+    from dynamo_tpu.parsers.tool_calls import find_tool_call_end
+
+    cfg = get_tool_parser("mistral")
+    # region must not "close" at the marker's own brackets
+    assert find_tool_call_end("[TOOL_CALLS][{\"name\":", cfg) == -1
+    closed = '[TOOL_CALLS][{"name": "f", "arguments": {}}]'
+    assert find_tool_call_end(closed, cfg) == len(closed)
+
+
+def test_gpt_oss_final_channel_is_normal_text():
+    p = get_reasoning_parser("gpt_oss")
+    r = p.detect_and_parse_reasoning(
+        "<|channel|>analysis<|message|>let me think<|end|>"
+        "<|start|>assistant<|channel|>final<|message|>the answer<|return|>")
+    assert r.reasoning_text == "let me think"
+    assert r.normal_text == "the answer"
+    p2 = get_reasoning_parser("gpt_oss")
+    r2 = p2.detect_and_parse_reasoning(
+        "<|channel|>final<|message|>just the answer")
+    assert r2.normal_text == "just the answer"
+    assert r2.reasoning_text == ""
+
+
+async def test_midstream_prose_json_not_a_call():
+    js = JailedStream(tool_config=get_tool_parser("default"))
+    chunks = [_chunk("Sure, here is an example: "),
+              _chunk('{"name": "Bob", "arguments": {"x": 1}}'),
+              _chunk(" Hope that helps."), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _tool_calls(outs) == []
+    assert outs[-1]["choices"][0]["finish_reason"] == "stop"
+    assert '{"name": "Bob"' in _texts(outs)
+
+
+async def test_sequential_calls_get_distinct_indices():
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    chunks = [_chunk('<tool_call>{"name": "a", "arguments": {}}</tool_call>'),
+              _chunk('<tool_call>{"name": "b", "arguments": {}}</tool_call>'),
+              _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    calls = _tool_calls(outs)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+    assert [c["index"] for c in calls] == [0, 1]
+
+
+async def test_bare_list_released_when_not_a_call():
+    # "[1, 2, 3] is the list" balances immediately but is not a call;
+    # the jail must release it and keep streaming, not buffer to flush
+    js = JailedStream(tool_config=get_tool_parser("default"))
+    chunks = [_chunk("[1, 2, 3]"), _chunk(" is the list you wanted"),
+              _chunk(" and more text"), _chunk(finish="stop")]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _tool_calls(outs) == []
+    texts = [c["choices"][0]["delta"].get("content") for c in outs
+             if c["choices"][0]["delta"].get("content")]
+    assert "".join(texts) == "[1, 2, 3] is the list you wanted and more text"
+    # streaming resumed immediately after release (not one flush blob)
+    assert len(texts) >= 3

@@ -248,6 +248,11 @@ class TpuEngine:
             if out.get("finish_reason"):
                 return
 
+    def clear_kv_blocks(self) -> int:
+        """Drop the reusable prefix cache (admin route analog of
+        `service/clear_kv_blocks.rs`). Returns pages freed."""
+        return self.pool.clear_inactive()
+
     async def close(self) -> None:
         self._stopped = True
         self._wake.set()

@@ -179,6 +179,14 @@ class PagePool:
             else:
                 self._discard(page)
 
+    def clear_inactive(self) -> int:
+        """Admin clear (ref `http/service/clear_kv_blocks.rs`): drop every
+        reusable cached page, publishing removed events so routers forget
+        them too. In-flight (refcounted) pages are untouched. The KVBM
+        offload hook deliberately does NOT fire — clearing means
+        forgetting, not demoting to a slower tier."""
+        return self._evict_many(len(self._inactive), fire_hook=False)
+
     def _discard(self, page: _Page) -> None:
         self._pages.pop(page.page_id, None)
         self._free.append(page.page_id)
@@ -186,15 +194,16 @@ class PagePool:
     def _evict_one(self) -> bool:
         return self._evict_many(1) == 1
 
-    def _evict_many(self, n: int) -> int:
+    def _evict_many(self, n: int, fire_hook: bool = True) -> int:
         """Evict up to n LRU inactive pages; ONE offload-hook call for the
-        whole batch (device data still intact when it fires)."""
+        whole batch (device data still intact when it fires).
+        ``fire_hook=False`` for admin clears: drop, don't offload."""
         victims: list[_Page] = []
         while len(victims) < n and self._inactive:
             pid, _ = self._inactive.popitem(last=False)   # LRU
             victims.append(self._pages[pid])
         registered = [p for p in victims if p.seq_hash is not None]
-        if registered and self.evict_hook is not None:
+        if registered and fire_hook and self.evict_hook is not None:
             self.evict_hook([(p.page_id, p.seq_hash) for p in registered])
         for page in registered:
             self._registered.pop(page.seq_hash, None)

@@ -36,6 +36,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="use the TTL-based approx indexer instead of "
                         "engine KV events")
     p.add_argument("--router-replica-sync", action="store_true")
+    p.add_argument("--tls-cert-path", default=None,
+                   help="PEM certificate; with --tls-key-path serves HTTPS")
+    p.add_argument("--tls-key-path", default=None)
     return p.parse_args(argv)
 
 
@@ -58,7 +61,9 @@ def main(argv=None) -> None:
         fe = await start_frontend(rt, host=args.host, port=args.port,
                                   router_config=router_cfg,
                                   router_mode_override=args.router_mode,
-                                  namespace=args.namespace)
+                                  namespace=args.namespace,
+                                  tls_cert=args.tls_cert_path,
+                                  tls_key=args.tls_key_path)
         print(f"FRONTEND_READY {fe.url}", flush=True)
         return rt, fe
 

@@ -34,7 +34,7 @@ class Frontend:
 
     @property
     def url(self) -> str:
-        return f"http://{self.http.host}:{self.http.port}"
+        return f"{self.http.scheme}://{self.http.host}:{self.http.port}"
 
     async def stop(self) -> None:
         await self.http.stop()
@@ -46,16 +46,19 @@ async def start_frontend(runtime: DistributedRuntime,
                          host: str = "127.0.0.1", port: int = 0,
                          router_config: Optional[KvRouterConfig] = None,
                          router_mode_override: Optional[str] = None,
-                         namespace: Optional[str] = None) -> Frontend:
+                         namespace: Optional[str] = None,
+                         tls_cert: Optional[str] = None,
+                         tls_key: Optional[str] = None) -> Frontend:
     """HTTP frontend: model discovery + OpenAI server (Input::Http).
 
     `router_mode_override` must be set before the watcher's initial MDC
     scan builds pipelines; `namespace` (if set) restricts discovery to
-    cards in that namespace."""
+    cards in that namespace; `tls_cert`/`tls_key` serve HTTPS."""
     manager = ModelManager(runtime, router_config)
     manager.router_mode_override = router_mode_override
     watcher = await ModelWatcher(manager, namespace=namespace).start()
-    http = HttpService(manager, host, port)
+    http = HttpService(manager, host, port, tls_cert=tls_cert,
+                       tls_key=tls_key)
     await http.start()
     return Frontend(runtime, manager, watcher, http)
 
@@ -65,8 +68,11 @@ class WorkerHandle:
     runtime: DistributedRuntime
     card: ModelDeploymentCard
     served: object
+    served_clear: object = None
 
     async def stop(self) -> None:
+        if self.served_clear is not None:
+            await self.served_clear.shutdown()
         await self.served.shutdown()
 
 
@@ -74,14 +80,29 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
                        card: ModelDeploymentCard,
                        instance_id: Optional[int] = None) -> WorkerHandle:
     """Worker side (entrypoint/input/endpoint.rs): serve a core engine on
-    the card's endpoint and publish the card."""
-    ep = (runtime.namespace(card.namespace).component(card.component)
-          .endpoint(card.endpoint))
+    the card's endpoint and publish the card. Also serves the
+    `clear_kv_blocks` admin endpoint (vllm main.py registers the same
+    pair) when the engine supports cache clearing."""
+    import inspect
+
+    comp = runtime.namespace(card.namespace).component(card.component)
+    ep = comp.endpoint(card.endpoint)
     served = await ep.serve(
         engine, instance_id=instance_id,
         metadata={"dp_size": card.runtime_config.data_parallel_size})
+    served_clear = None
+    clear_fn = getattr(engine, "clear_kv_blocks", None)
+    if clear_fn is not None:
+        async def clear_handler(request, context):
+            n = clear_fn()
+            if inspect.isawaitable(n):
+                n = await n
+            yield {"status": "success", "cleared_pages": int(n or 0)}
+
+        served_clear = await comp.endpoint("clear_kv_blocks").serve(
+            clear_handler, instance_id=served.instance.instance_id)
     await register_llm(runtime, card)
-    return WorkerHandle(runtime, card, served)
+    return WorkerHandle(runtime, card, served, served_clear)
 
 
 def wire_engine_events(runtime: DistributedRuntime,

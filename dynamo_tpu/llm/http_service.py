@@ -17,14 +17,21 @@ from typing import Optional
 from aiohttp import web
 
 from dynamo_tpu.llm.model_manager import ModelManager
-from dynamo_tpu.llm.preprocessor import KIND_CHAT, KIND_COMPLETION
+from dynamo_tpu.llm.preprocessor import (
+    KIND_CHAT,
+    KIND_COMPLETION,
+    KIND_EMBEDDING,
+    KIND_RESPONSES,
+)
 from dynamo_tpu.llm.protocols_openai import (
     OpenAIError,
     SSE_DONE,
     aggregate_chat_stream,
     aggregate_completion_stream,
+    aggregate_responses_stream,
     new_request_id,
     sse_encode,
+    sse_encode_event,
 )
 from dynamo_tpu.runtime.context import Context
 
@@ -33,15 +40,24 @@ logger = logging.getLogger(__name__)
 
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None) -> None:
         self.manager = manager
         self.host = host
         self.port = port
+        if bool(tls_cert) != bool(tls_key):
+            # half-configured TLS must not silently serve plaintext
+            raise ValueError("tls_cert and tls_key must be set together")
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self.app = web.Application()
         self.app.add_routes([
             web.post("/v1/chat/completions", self._chat),
             web.post("/v1/completions", self._completions),
+            web.post("/v1/embeddings", self._embeddings),
+            web.post("/v1/responses", self._responses),
             web.get("/v1/models", self._models),
+            web.post("/clear_kv_blocks", self._clear_kv_blocks),
             web.get("/health", self._health),
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
@@ -78,15 +94,27 @@ class HttpService:
         if usage.get("completion_tokens") is not None:
             self._osl.observe(usage["completion_tokens"])
 
+    @property
+    def scheme(self) -> str:
+        return "https" if self.tls_cert else "http"
+
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> tuple[str, int]:
+        ssl_ctx = None
+        if self.tls_cert and self.tls_key:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert, self.tls_key)
         self._runner = web.AppRunner(self.app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(self._runner, self.host, self.port,
+                           ssl_context=ssl_ctx)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]  # type: ignore
-        logger.info("HTTP frontend on http://%s:%d", self.host, self.port)
+        logger.info("HTTP frontend on %s://%s:%d",
+                    "https" if ssl_ctx else "http", self.host, self.port)
         return self.host, self.port
 
     async def stop(self) -> None:
@@ -100,6 +128,152 @@ class HttpService:
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve_openai(request, KIND_COMPLETION)
+
+    async def _embeddings(self, request: web.Request) -> web.StreamResponse:
+        """/v1/embeddings (openai.rs:1125): unary only — the pipeline
+        yields exactly one response object."""
+        try:
+            body = await request.json()
+        except Exception:
+            return self._error("embeddings", OpenAIError("invalid JSON body"))
+        model = body.get("model") if isinstance(body, dict) else None
+        engine = self.manager.engine_for(model) if model else None
+        if engine is None:
+            return self._error("embeddings", OpenAIError(
+                f"model {model!r} not found", status=404,
+                err_type="model_not_found"))
+        ctx = Context(request_id=new_request_id("embd"))
+        start = time.perf_counter()
+        self._inflight.add(1)
+        try:
+            out = None
+            async for item in engine.generate(
+                    {"_kind": KIND_EMBEDDING, "body": body}, ctx):
+                out = item
+            self._req_counter.inc(endpoint="embeddings", status="200")
+            self._duration.observe(time.perf_counter() - start)
+            return web.json_response(out)
+        except OpenAIError as e:
+            return self._error("embeddings", e)
+        except asyncio.CancelledError:
+            ctx.cancel()  # client disconnected: stop downstream work
+            self._req_counter.inc(endpoint="embeddings", status="disconnect")
+            raise
+        finally:
+            self._inflight.add(-1)
+
+    async def _responses(self, request: web.Request) -> web.StreamResponse:
+        """/v1/responses (openai.rs:766): typed-event SSE or unary fold."""
+        try:
+            body = await request.json()
+        except Exception:
+            return self._error("responses", OpenAIError("invalid JSON body"))
+        model = body.get("model") if isinstance(body, dict) else None
+        engine = self.manager.engine_for(model) if model else None
+        if engine is None:
+            return self._error("responses", OpenAIError(
+                f"model {model!r} not found", status=404,
+                err_type="model_not_found"))
+        request_id = new_request_id("resp")
+        ctx = Context(request_id=request_id)
+        events = engine.generate(
+            {"_kind": KIND_RESPONSES, "body": body,
+             "request_id": request_id}, ctx)
+        start = time.perf_counter()
+        self._inflight.add(1)
+        try:
+            if not body.get("stream"):
+                try:
+                    full = await aggregate_responses_stream(events)
+                except OpenAIError as e:
+                    return self._error("responses", e)
+                except asyncio.CancelledError:
+                    ctx.cancel()  # client disconnected mid-aggregation
+                    self._req_counter.inc(endpoint="responses",
+                                          status="disconnect")
+                    raise
+                self._req_counter.inc(endpoint="responses", status="200")
+                self._duration.observe(time.perf_counter() - start)
+                self._observe_usage_responses(full.get("usage"))
+                return web.json_response(full)
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            })
+            first_token_at: Optional[float] = None
+            last_token_at: Optional[float] = None
+            try:
+                async for ev in events:
+                    if ev.get("type") == "response.output_text.delta":
+                        now = time.perf_counter()
+                        if first_token_at is None:
+                            first_token_at = now
+                            self._ttft.observe(now - start)
+                        elif last_token_at is not None:
+                            self._itl.observe(now - last_token_at)
+                        last_token_at = now
+                    elif ev.get("type") == "response.completed":
+                        self._observe_usage_responses(
+                            (ev.get("response") or {}).get("usage"))
+                    if not resp.prepared:
+                        await resp.prepare(request)
+                    await resp.write(sse_encode_event(
+                        ev.get("type", "message"), ev))
+                self._req_counter.inc(endpoint="responses", status="200")
+            except OpenAIError as e:
+                if not resp.prepared:
+                    return self._error("responses", e)
+                await resp.write(sse_encode(e.body()))
+            except (ConnectionResetError, asyncio.CancelledError):
+                ctx.cancel()
+                self._req_counter.inc(endpoint="responses",
+                                      status="disconnect")
+                raise
+            finally:
+                self._duration.observe(time.perf_counter() - start)
+            await resp.write_eof()
+            return resp
+        finally:
+            self._inflight.add(-1)
+
+    def _observe_usage_responses(self, usage: Optional[dict]) -> None:
+        if not usage:
+            return
+        if usage.get("input_tokens") is not None:
+            self._isl.observe(usage["input_tokens"])
+        if usage.get("output_tokens") is not None:
+            self._osl.observe(usage["output_tokens"])
+
+    async def _clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin route (service/clear_kv_blocks.rs): tell every worker
+        instance of every served model to drop its reusable KV cache."""
+        from dynamo_tpu.runtime.push import PushRouter
+
+        results: dict[str, dict] = {}
+        for name in self.manager.model_names():
+            entry = self.manager.get(name)
+            if entry is None:
+                continue
+            card = entry.card
+            client = await (self.manager.runtime.namespace(card.namespace)
+                            .component(card.component)
+                            .endpoint("clear_kv_blocks").client())
+            await client.start()
+            router = PushRouter(client)
+            per_instance: dict[str, object] = {}
+            try:
+                for inst in client.instances():
+                    try:
+                        async for out in router.direct(
+                                {}, inst.instance_id, Context()):
+                            per_instance[f"{inst.instance_id:x}"] = out
+                    except Exception as e:  # instance died mid-call
+                        per_instance[f"{inst.instance_id:x}"] = {
+                            "status": "error", "error": str(e)}
+            finally:
+                await client.stop()
+            results[name] = per_instance
+        return web.json_response({"status": "success", "results": results})
 
     async def _serve_openai(self, request: web.Request,
                             kind: str) -> web.StreamResponse:

@@ -14,19 +14,25 @@ from typing import Any, AsyncIterator, Optional
 from dynamo_tpu.llm.protocols_openai import (
     ChatCompletionRequest,
     CompletionRequest,
+    EmbeddingRequest,
     OpenAIError,
     chat_chunk,
     completion_chunk,
+    embedding_response,
     new_request_id,
+    response_object,
+    responses_input_to_messages,
     usage_dict,
 )
 from dynamo_tpu.llm.tokenizer import Tokenizer
-from dynamo_tpu.protocols import PreprocessedRequest
+from dynamo_tpu.protocols import PreprocessedRequest, StopConditions
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import Operator
 
 KIND_CHAT = "chat"
 KIND_COMPLETION = "completion"
+KIND_EMBEDDING = "embedding"
+KIND_RESPONSES = "responses"
 
 DEFAULT_TEMPLATE_SUFFIX = "assistant:"
 
@@ -110,6 +116,14 @@ class OpenAIPreprocessor(Operator):
         assert self.inner is not None
         kind = request.get("_kind", KIND_CHAT)
         created = int(time.time())
+        if kind == KIND_EMBEDDING:
+            async for out in self._embed(request, context):
+                yield out
+            return
+        if kind == KIND_RESPONSES:
+            async for out in self._responses(request, created, context):
+                yield out
+            return
         if kind == KIND_CHAT:
             oai = ChatCompletionRequest.from_dict(request["body"])
             pre = self.preprocess_chat(oai)
@@ -124,6 +138,86 @@ class OpenAIPreprocessor(Operator):
             async for chunk in self._postprocess_completion(
                     pre, oai_c, request_id, created, context):
                 yield chunk
+
+    # -- embeddings (/v1/embeddings, ref openai.rs:1125) --------------------
+
+    async def _embed(self, request: dict, context: Context
+                     ) -> AsyncIterator[dict]:
+        req = EmbeddingRequest.from_dict(request["body"])
+        embeddings: list[list[float]] = []
+        total_tokens = 0
+        for item in req.inputs:
+            ids = (list(item) if isinstance(item, list)
+                   else self.tokenizer.encode(item))
+            if self.context_length and len(ids) >= self.context_length:
+                raise OpenAIError(
+                    f"input ({len(ids)} tokens) exceeds the model context "
+                    f"length of {self.context_length}", status=400)
+            total_tokens += len(ids)
+            pre = PreprocessedRequest(
+                token_ids=ids, model=self.model_name,
+                stop=StopConditions(max_tokens=1),
+                extra={"embed": True})
+            vec = None
+            async for out in self.inner.generate(pre.to_dict(), context):
+                if out.get("embedding") is not None:
+                    vec = out["embedding"]
+                if out.get("finish_reason"):
+                    break
+            if vec is None:
+                raise OpenAIError(
+                    f"model {self.model_name!r} does not support "
+                    "embeddings", status=400)
+            embeddings.append([float(x) for x in vec])
+        yield embedding_response(req.model, embeddings, total_tokens,
+                                 req.encoding_format)
+
+    # -- responses (/v1/responses, ref openai.rs:766) -----------------------
+
+    async def _responses(self, request: dict, created: int,
+                         context: Context) -> AsyncIterator[dict]:
+        """OpenAI Responses API over the chat pipeline: typed SSE events
+        out (`response.created` / `response.output_text.delta` /
+        `response.completed`); the unary path folds the completed event."""
+        body = dict(request["body"])
+        messages = responses_input_to_messages(body)
+        chat_body = {"model": body.get("model"), "messages": messages}
+        if body.get("max_output_tokens") is not None:
+            chat_body["max_tokens"] = body["max_output_tokens"]
+        for k in ("temperature", "top_p"):
+            if body.get(k) is not None:
+                chat_body[k] = body[k]
+        oai = ChatCompletionRequest.from_dict(chat_body)
+        pre = self.preprocess_chat(oai)
+        resp_id = request.get("request_id") or new_request_id("resp")
+        yield {"type": "response.created",
+               "response": response_object(resp_id, oai.model, created,
+                                           "in_progress")}
+        parts: list[str] = []
+        usage = None
+        stream = self._chat_chunks(pre, oai, resp_id, created, context)
+        jail = self._chat_parsers(oai)
+        if jail is not None:
+            # same parser semantics as /v1/chat/completions: think-block
+            # text must never leak into output_text on this endpoint either
+            stream = jail.apply(stream)
+        async for chunk in stream:
+            if chunk.get("usage"):
+                usage = chunk["usage"]
+            for choice in chunk.get("choices", ()):
+                t = choice.get("delta", {}).get("content")
+                if t:
+                    parts.append(t)
+                    yield {"type": "response.output_text.delta",
+                           "item_id": f"msg-{resp_id}", "output_index": 0,
+                           "content_index": 0, "delta": t}
+        text = "".join(parts)
+        yield {"type": "response.output_text.done",
+               "item_id": f"msg-{resp_id}", "output_index": 0,
+               "content_index": 0, "text": text}
+        yield {"type": "response.completed",
+               "response": response_object(resp_id, oai.model, created,
+                                           "completed", text, usage)}
 
     def _chat_parsers(self, oai: ChatCompletionRequest):
         """Jail + reasoning wrap for this request, or None when neither

@@ -318,3 +318,128 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
     """Fold text_completion chunk stream into one text_completion."""
     return await _aggregate_stream(
         chunks, lambda ch: ch.get("text"), completion_response)
+
+
+# ---------------------------------------------------------------------------
+# /v1/embeddings (ref http/service/openai.rs:1125, protocols/openai/embeddings)
+
+@dataclass
+class EmbeddingRequest:
+    model: str
+    inputs: list[list[int] | str]   # each item: text or pre-tokenized ids
+    encoding_format: str = "float"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EmbeddingRequest":
+        _require(isinstance(d, dict), "request body must be a JSON object")
+        _require(bool(d.get("model")), "'model' is required")
+        raw = d.get("input")
+        _require(raw is not None and raw != [], "'input' is required")
+        if isinstance(raw, str):
+            inputs: list = [raw]
+        elif isinstance(raw, list) and raw and all(
+                isinstance(t, int) for t in raw):
+            inputs = [raw]              # one pre-tokenized prompt
+        elif isinstance(raw, list):
+            for item in raw:
+                _require(isinstance(item, str)
+                         or (isinstance(item, list) and all(
+                             isinstance(t, int) for t in item)),
+                         "'input' items must be strings or token arrays")
+            inputs = list(raw)
+        else:
+            raise OpenAIError("'input' must be a string or array")
+        fmt = d.get("encoding_format", "float")
+        _require(fmt in ("float", "base64"),
+                 "'encoding_format' must be 'float' or 'base64'")
+        return cls(model=d["model"], inputs=inputs, encoding_format=fmt)
+
+
+def embedding_response(model: str, embeddings: list[list[float]],
+                       prompt_tokens: int,
+                       encoding_format: str = "float") -> dict:
+    data = []
+    for i, vec in enumerate(embeddings):
+        if encoding_format == "base64":
+            import base64
+            import struct
+
+            payload: Any = base64.b64encode(
+                struct.pack(f"<{len(vec)}f", *vec)).decode()
+        else:
+            payload = vec
+        data.append({"object": "embedding", "index": i,
+                     "embedding": payload})
+    return {
+        "object": "list", "model": model, "data": data,
+        "usage": {"prompt_tokens": prompt_tokens,
+                  "total_tokens": prompt_tokens},
+    }
+
+
+# ---------------------------------------------------------------------------
+# /v1/responses (ref http/service/openai.rs:766, protocols/openai/responses)
+
+def responses_input_to_messages(body: dict) -> list[dict]:
+    """OpenAI Responses `input` (string or item array) → chat messages."""
+    raw = body.get("input")
+    _require(raw is not None, "'input' is required")
+    msgs: list[dict] = []
+    if instructions := body.get("instructions"):
+        msgs.append({"role": "system", "content": instructions})
+    if isinstance(raw, str):
+        msgs.append({"role": "user", "content": raw})
+        return msgs
+    _require(isinstance(raw, list), "'input' must be a string or array")
+    for item in raw:
+        _require(isinstance(item, dict) and "role" in item,
+                 "input items must have a 'role'")
+        content = item.get("content", "")
+        if isinstance(content, list):  # typed parts → text only
+            content = "".join(p.get("text", "") for p in content
+                              if isinstance(p, dict))
+        msgs.append({"role": item["role"], "content": content})
+    return msgs
+
+
+def response_object(response_id: str, model: str, created: int,
+                    status: str, text: str = "",
+                    usage: Optional[dict] = None) -> dict:
+    out: dict[str, Any] = {
+        "id": response_id, "object": "response", "created_at": created,
+        "model": model, "status": status,
+        "output": [], "output_text": text,
+    }
+    if text or status == "completed":
+        out["output"] = [{
+            "type": "message", "id": f"msg-{response_id}", "status": status,
+            "role": "assistant",
+            "content": [{"type": "output_text", "text": text,
+                         "annotations": []}],
+        }]
+    if usage is not None:
+        out["usage"] = {
+            "input_tokens": usage.get("prompt_tokens", 0),
+            "output_tokens": usage.get("completion_tokens", 0),
+            "total_tokens": usage.get("total_tokens", 0),
+        }
+    return out
+
+
+def sse_encode_event(event: str, payload: dict) -> bytes:
+    """Responses-API SSE frame: typed `event:` line + data."""
+    return (b"event: " + event.encode() + b"\ndata: "
+            + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n")
+
+
+async def aggregate_responses_stream(events: AsyncIterator[dict]) -> dict:
+    """Unary /v1/responses: the final `response.completed` event carries
+    the whole response object."""
+    last: Optional[dict] = None
+    async for ev in events:
+        if ev.get("type") in ("response.completed", "response.failed"):
+            last = ev.get("response")
+    if last is None:
+        raise OpenAIError("stream ended without response.completed",
+                          status=500, err_type="internal_error")
+    return last

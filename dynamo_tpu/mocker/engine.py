@@ -89,6 +89,25 @@ class MockEngine:
     async def generate(self, request: dict, context: Context
                        ) -> AsyncIterator[dict]:
         req = PreprocessedRequest.from_dict(request)
+        if req.extra.get("embed"):
+            # deterministic unit-norm vector from the token ids, so tests
+            # can assert same-input ⇒ same-embedding across workers
+            import hashlib
+            import math as _math
+
+            dim = 64
+            seed = hashlib.blake2b(
+                ",".join(map(str, req.token_ids)).encode(),
+                digest_size=16).digest()
+            vals = []
+            for i in range(dim):
+                h = hashlib.blake2b(seed + i.to_bytes(2, "big"),
+                                    digest_size=4).digest()
+                vals.append(int.from_bytes(h, "big") / 2**31 - 1.0)
+            norm = _math.sqrt(sum(v * v for v in vals)) or 1.0
+            yield {"embedding": [v / norm for v in vals],
+                   "token_ids": [], "finish_reason": "stop"}
+            return
         if req.stop.max_tokens is None:
             req.stop.max_tokens = self.config.default_max_tokens
         prompt_blocks = len(req.token_ids) // self.config.block_size
@@ -265,6 +284,13 @@ class MockEngine:
             ),
         )
         self.metrics_sink(m)
+
+    def clear_kv_blocks(self) -> int:
+        """Admin cache clear (clear_kv_blocks.rs analog): forget every
+        inactive cached block; in-flight requests keep theirs."""
+        n = len(self.kv._inactive)
+        self.kv.clear()
+        return n
 
     async def close(self) -> None:
         self._stopped = True

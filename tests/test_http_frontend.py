@@ -197,3 +197,184 @@ async def test_kv_routed_two_workers():
         assert es[0].kv.used_blocks + es[1].kv.used_blocks > 0
     finally:
         await teardown_stack(rt, fe, hs, es)
+
+
+async def test_embeddings_endpoint():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model",
+                    "input": ["hello world", "other text"]}
+            async with s.post(f"{fe.url}/v1/embeddings", json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+            assert out["object"] == "list"
+            assert len(out["data"]) == 2
+            v0 = out["data"][0]["embedding"]
+            assert len(v0) == 64 and out["data"][0]["index"] == 0
+            assert out["usage"]["prompt_tokens"] > 0
+            # determinism: same input → same embedding
+            async with s.post(f"{fe.url}/v1/embeddings",
+                              json={"model": "mock-model",
+                                    "input": "hello world"}) as r:
+                again = (await r.json())["data"][0]["embedding"]
+            assert again == v0
+            # base64 encoding format round-trips
+            async with s.post(f"{fe.url}/v1/embeddings",
+                              json={"model": "mock-model",
+                                    "input": "hello world",
+                                    "encoding_format": "base64"}) as r:
+                b64 = (await r.json())["data"][0]["embedding"]
+            import base64
+            import struct
+            decoded = struct.unpack(f"<{len(v0)}f", base64.b64decode(b64))
+            assert all(abs(a - b) < 1e-6 for a, b in zip(decoded, v0))
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_responses_endpoint_unary_and_stream():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "input": "say something",
+                    "max_output_tokens": 8}
+            async with s.post(f"{fe.url}/v1/responses", json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+            assert out["object"] == "response"
+            assert out["status"] == "completed"
+            assert out["output"][0]["role"] == "assistant"
+            assert out["output"][0]["content"][0]["type"] == "output_text"
+            assert out["output"][0]["content"][0]["text"]
+            assert out["usage"]["output_tokens"] > 0
+            # streaming: typed SSE events
+            body["stream"] = True
+            kinds = []
+            async with s.post(f"{fe.url}/v1/responses", json=body) as r:
+                assert r.status == 200
+                assert "text/event-stream" in r.headers["Content-Type"]
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if line.startswith("event: "):
+                        kinds.append(line[7:])
+            assert kinds[0] == "response.created"
+            assert "response.output_text.delta" in kinds
+            assert kinds[-1] == "response.completed"
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_clear_kv_blocks_route():
+    rt, fe, hs, es = await setup_stack(workers=2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            # populate some cache
+            body = {"model": "mock-model", "max_tokens": 4,
+                    "messages": [{"role": "user",
+                                  "content": " ".join(
+                                      f"w{j}" for j in range(64))}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+            assert any(len(e.kv._inactive) > 0 for e in es)
+            async with s.post(f"{fe.url}/clear_kv_blocks") as r:
+                assert r.status == 200
+                out = await r.json()
+            assert out["status"] == "success"
+            per = out["results"]["mock-model"]
+            assert len(per) == 2          # both workers answered
+            assert all(v.get("status") == "success" for v in per.values())
+            assert all(len(e.kv._inactive) == 0 for e in es)
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_tls_frontend(tmp_path):
+    import shutil
+    import ssl
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        import pytest
+        pytest.skip("openssl unavailable")
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"], check=True, capture_output=True)
+
+    from dynamo_tpu.llm.entrypoint import start_frontend
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    fe = await start_frontend(rt, tls_cert=str(cert), tls_key=str(key))
+    try:
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        async with aiohttp.ClientSession() as s:
+            url = f"https://127.0.0.1:{fe.http.port}/live"
+            async with s.get(url, ssl=ctx) as r:
+                assert r.status == 200
+    finally:
+        await fe.stop()
+        await rt.close()
+
+
+async def test_tls_url_scheme_and_pairing_validation():
+    import pytest
+
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_manager import ModelManager
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        mgr = ModelManager(rt)
+        with pytest.raises(ValueError):
+            HttpService(mgr, tls_cert="only-cert.pem")  # half-configured
+        assert HttpService(mgr).scheme == "http"
+    finally:
+        await rt.close()
+
+
+async def test_responses_strips_reasoning_like_chat(monkeypatch):
+    # /v1/responses must run the same parser wrap as chat: think-block
+    # text never appears in output_text
+    import dynamo_tpu.llm.entrypoint as ep
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import FnEngine
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name="rm", namespace="ns", component="w", tokenizer_kind="byte",
+        tokenizer_path="rm", reasoning_parser="basic")
+    text = "<think>hidden plan</think>visible answer"
+    ids = list(text.encode("utf-8"))
+
+    async def gen(req, ctx):
+        yield {"token_ids": ids, "finish_reason": "stop"}
+
+    h = await ep.serve_engine(rt, FnEngine(gen), card, instance_id=1)
+    fe = await ep.start_frontend(rt)
+    try:
+        for _ in range(100):
+            if "rm" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/responses",
+                              json={"model": "rm", "input": "q"}) as r:
+                assert r.status == 200
+                out = await r.json()
+        assert out["output_text"] == "visible answer"
+        assert "hidden plan" not in json.dumps(out["output"])
+    finally:
+        await fe.stop()
+        await h.stop()
+        await rt.close()

@@ -25,6 +25,11 @@ def add_runtime_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--system-port", type=int, default=None,
                    help="system status server port (health/metrics)")
     p.add_argument("--lease-ttl", type=float, default=None)
+    p.add_argument("--health-check", action="store_true",
+                   help="enable canary health probes on served endpoints")
+    p.add_argument("--health-check-interval", type=float, default=None,
+                   help="idle seconds before a canary probe fires")
+    p.add_argument("--health-check-timeout", type=float, default=None)
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
 
@@ -37,6 +42,12 @@ def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
         cfg.system_port = args.system_port
     if getattr(args, "lease_ttl", None) is not None:
         cfg.lease_ttl = args.lease_ttl
+    if getattr(args, "health_check", False):
+        cfg.health_check_enabled = True
+    if getattr(args, "health_check_interval", None) is not None:
+        cfg.health_check_interval = args.health_check_interval
+    if getattr(args, "health_check_timeout", None) is not None:
+        cfg.health_check_timeout = args.health_check_timeout
     return cfg
 
 

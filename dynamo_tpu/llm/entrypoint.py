@@ -87,9 +87,15 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
 
     comp = runtime.namespace(card.namespace).component(card.component)
     ep = comp.endpoint(card.endpoint)
+    # one-token greedy canary (vllm health_check.py builds the same shape);
+    # only probed when the runtime's health manager is enabled + idle
+    canary = {"token_ids": [1], "model": card.name,
+              "sampling": {"temperature": 0.0},
+              "stop": {"max_tokens": 1, "ignore_eos": True}}
     served = await ep.serve(
         engine, instance_id=instance_id,
-        metadata={"dp_size": card.runtime_config.data_parallel_size})
+        metadata={"dp_size": card.runtime_config.data_parallel_size},
+        health_payload=canary)
     served_clear = None
     clear_fn = getattr(engine, "clear_kv_blocks", None)
     if clear_fn is not None:

@@ -143,9 +143,10 @@ class OpenAIPreprocessor(Operator):
 
     async def _embed(self, request: dict, context: Context
                      ) -> AsyncIterator[dict]:
+        import asyncio
+
         req = EmbeddingRequest.from_dict(request["body"])
-        embeddings: list[list[float]] = []
-        total_tokens = 0
+        token_lists: list[list[int]] = []
         for item in req.inputs:
             ids = (list(item) if isinstance(item, list)
                    else self.tokenizer.encode(item))
@@ -153,23 +154,27 @@ class OpenAIPreprocessor(Operator):
                 raise OpenAIError(
                     f"input ({len(ids)} tokens) exceeds the model context "
                     f"length of {self.context_length}", status=400)
-            total_tokens += len(ids)
+            token_lists.append(ids)
+
+        async def one(ids: list[int]) -> list[float]:
             pre = PreprocessedRequest(
                 token_ids=ids, model=self.model_name,
                 stop=StopConditions(max_tokens=1),
                 extra={"embed": True})
-            vec = None
             async for out in self.inner.generate(pre.to_dict(), context):
                 if out.get("embedding") is not None:
-                    vec = out["embedding"]
+                    return [float(x) for x in out["embedding"]]
                 if out.get("finish_reason"):
                     break
-            if vec is None:
-                raise OpenAIError(
-                    f"model {self.model_name!r} does not support "
-                    "embeddings", status=400)
-            embeddings.append([float(x) for x in vec])
-        yield embedding_response(req.model, embeddings, total_tokens,
+            raise OpenAIError(
+                f"model {self.model_name!r} does not support embeddings",
+                status=400)
+
+        # items are independent: fan out, keep input order by position
+        embeddings = list(await asyncio.gather(
+            *(one(ids) for ids in token_lists)))
+        yield embedding_response(req.model, embeddings,
+                                 sum(len(t) for t in token_lists),
                                  req.encoding_format)
 
     # -- responses (/v1/responses, ref openai.rs:766) -----------------------

@@ -97,12 +97,17 @@ class Endpoint:
 
     async def serve(self, handler: AsyncEngine | Callable,
                     instance_id: Optional[int] = None,
-                    metadata: Optional[dict] = None) -> "ServedEndpoint":
+                    metadata: Optional[dict] = None,
+                    health_payload: Optional[dict] = None
+                    ) -> "ServedEndpoint":
         """Register + serve this endpoint from the local process.
 
         Reference: `component/endpoint.rs:61` EndpointConfigBuilder::start —
         spawns a PushEndpoint and registers the instance under the lease.
-        """
+        ``health_payload`` opts this endpoint into canary probing (when the
+        runtime's health manager is enabled): real traffic resets the
+        canary timer via the activity wrapper; only endpoints that declare
+        a known-safe payload are probed (health_check.rs:44)."""
         rt = self.runtime
         engine = handler if isinstance(handler, AsyncEngine) else FnEngine(handler)
         if instance_id is None:
@@ -117,8 +122,14 @@ class Endpoint:
             address=rt.transport_address,
             metadata=metadata or {},
         )
-        rt.transport_server.register(inst.subject, engine)
-        rt.register_local(inst.subject, engine)
+        serve_engine: AsyncEngine = engine
+        if rt.health is not None and health_payload is not None:
+            from dynamo_tpu.runtime.health_check import ActivityEngine
+
+            serve_engine = ActivityEngine(engine, rt.health, inst.subject)
+            rt.health.register(inst.subject, engine, health_payload)
+        rt.transport_server.register(inst.subject, serve_engine)
+        rt.register_local(inst.subject, serve_engine)
         await rt.store.put(inst.etcd_key, inst.to_json(), rt.lease_id)
         return ServedEndpoint(self, inst, engine)
 
@@ -136,6 +147,8 @@ class ServedEndpoint:
 
     async def shutdown(self) -> None:
         rt = self.endpoint.runtime
+        if rt.health is not None:
+            rt.health.unregister(self.instance.subject)
         rt.transport_server.unregister(self.instance.subject)
         rt.unregister_local(self.instance.subject)
         await rt.store.delete(self.instance.etcd_key)

@@ -43,6 +43,7 @@ class DistributedRuntime:
         self._local_engines: dict[str, AsyncEngine] = {}
         self._shutdown = asyncio.Event()
         self._status_server = None
+        self.health = None  # HealthCheckManager when enabled
 
     # -- construction ------------------------------------------------------
 
@@ -63,6 +64,15 @@ class DistributedRuntime:
             rt._status_server = SystemStatusServer(rt, config.system_host,
                                                    config.system_port)
             await rt._status_server.start()
+        if config.health_check_enabled:
+            from dynamo_tpu.runtime.health_check import (
+                HealthCheckConfig,
+                HealthCheckManager,
+            )
+
+            rt.health = HealthCheckManager(rt, HealthCheckConfig(
+                canary_wait=config.health_check_interval,
+                request_timeout=config.health_check_timeout))
         logger.info("runtime up: transport=%s store=%s",
                     server.address, config.store_url)
         return rt
@@ -97,6 +107,8 @@ class DistributedRuntime:
 
     async def close(self) -> None:
         self.shutdown()
+        if self.health is not None:
+            await self.health.close()
         if self._status_server is not None:
             await self._status_server.stop()
         try:

@@ -1,0 +1,188 @@
+"""Canary health checks: probe idle endpoints with a known-good payload.
+
+Reference: `lib/runtime/src/health_check.rs:44-120` + `system_health.rs` —
+one task per locally-served endpoint waits ``canary_wait`` seconds; real
+traffic on the endpoint resets the timer (a busy endpoint is evidently
+alive, so no probe is wasted on it); on timer expiry the canary payload is
+sent through the SAME engine path a real request takes, under a timeout.
+Success marks the endpoint Ready, failure/timeout NotReady. Endpoint
+states aggregate into the system status server's /health.
+
+A persistent failure (``fail_limit`` consecutive) fires ``on_unhealthy`` —
+workers wire this to deregister the instance / exit so the lease drops and
+routers stop sending traffic to a wedged-but-alive process (the canary
+analog of the engine-death monitor, `worker/monitor.py`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.engine import AsyncEngine
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CANARY_PAYLOAD = {
+    "token_ids": [1], "model": "",
+    "sampling": {"temperature": 0.0},
+    "stop": {"max_tokens": 1, "ignore_eos": True},
+    "extra": {"canary": True},
+}
+
+
+@dataclass
+class HealthCheckConfig:
+    canary_wait: float = 5.0      # idle time before a probe fires
+    request_timeout: float = 3.0  # probe must answer within this
+    fail_limit: int = 3           # consecutive failures → on_unhealthy
+
+
+@dataclass
+class _Target:
+    subject: str
+    engine: AsyncEngine
+    payload: dict
+    notifier: asyncio.Event = field(default_factory=asyncio.Event)
+    task: Optional[asyncio.Task] = None
+    healthy: bool = True
+    consecutive_failures: int = 0
+
+
+class ActivityEngine(AsyncEngine):
+    """Wraps a served engine so real traffic resets the canary timer for
+    its endpoint (health_check.rs `notifier.notified()` arm).
+
+    Activity means OUTPUT, not arrival: a wedged engine still receives
+    requests (routers keep trying while the lease is alive), so signaling
+    on entry would suppress probes forever and report a stuck engine
+    healthy. Only yielded items count as evidence of liveness."""
+
+    def __init__(self, inner: AsyncEngine, manager: "HealthCheckManager",
+                 subject: str) -> None:
+        self.inner = inner
+        self.manager = manager
+        self.subject = subject
+
+    async def generate(self, request: Any, context: Optional[Context] = None
+                       ) -> AsyncIterator[Any]:
+        async for item in self.inner.generate(request, context):
+            self.manager.notify_activity(self.subject)
+            yield item
+
+
+class HealthCheckManager:
+    """Owns per-endpoint canary tasks for one process."""
+
+    def __init__(self, runtime, config: Optional[HealthCheckConfig] = None,
+                 on_unhealthy: Optional[Callable[[str], None]] = None
+                 ) -> None:
+        self.runtime = runtime
+        self.config = config or HealthCheckConfig()
+        self.on_unhealthy = on_unhealthy
+        self._targets: dict[str, _Target] = {}
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, subject: str, engine: AsyncEngine,
+                 payload: Optional[dict] = None) -> None:
+        if subject in self._targets:
+            return
+        t = _Target(subject=subject, engine=engine,
+                    payload=payload or dict(DEFAULT_CANARY_PAYLOAD))
+        t.task = asyncio.get_running_loop().create_task(self._probe_loop(t))
+        self._targets[subject] = t
+        self._publish(t)
+
+    def unregister(self, subject: str) -> Optional[asyncio.Task]:
+        t = self._targets.pop(subject, None)
+        task = None
+        if t is not None and t.task is not None:
+            t.task.cancel()
+            task = t.task
+        server = getattr(self.runtime, "_status_server", None)
+        if server is not None:
+            server.health_checks.pop(subject, None)
+        return task
+
+    async def close(self) -> None:
+        tasks = [task for subject in list(self._targets)
+                 if (task := self.unregister(subject)) is not None]
+        if tasks:
+            # let cancellations unwind before the runtime tears down the
+            # engines/transport the probes may still be blocked inside
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    # -- introspection -------------------------------------------------------
+
+    def notify_activity(self, subject: str) -> None:
+        t = self._targets.get(subject)
+        if t is not None:
+            t.notifier.set()
+
+    def healthy(self, subject: str) -> Optional[bool]:
+        t = self._targets.get(subject)
+        return t.healthy if t is not None else None
+
+    def all_healthy(self) -> bool:
+        return all(t.healthy for t in self._targets.values())
+
+    # -- probing -------------------------------------------------------------
+
+    async def _probe_loop(self, t: _Target) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(t.notifier.wait(),
+                                       self.config.canary_wait)
+                t.notifier.clear()
+                # real traffic: evidently alive, reset failure streak
+                self._mark(t, True)
+                continue
+            except asyncio.TimeoutError:
+                pass  # idle: probe
+            ok = await self._probe_once(t)
+            self._mark(t, ok)
+            # fire exactly once per unhealthy transition — a callback that
+            # deregisters asynchronously must not be scheduled again on
+            # failures 4, 5, ... while the first teardown is in flight
+            if not ok and t.consecutive_failures == self.config.fail_limit \
+                    and self.on_unhealthy is not None:
+                logger.error("endpoint %s failed %d consecutive canaries",
+                             t.subject, t.consecutive_failures)
+                try:
+                    self.on_unhealthy(t.subject)
+                except Exception:
+                    logger.exception("on_unhealthy callback failed")
+
+    async def _probe_once(self, t: _Target) -> bool:
+        try:
+            async def consume():
+                ctx = Context()
+                async for out in t.engine.generate(dict(t.payload), ctx):
+                    if isinstance(out, dict) and out.get("error"):
+                        raise RuntimeError(out["error"])
+                return True
+
+            await asyncio.wait_for(consume(), self.config.request_timeout)
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.warning("canary probe failed for %s: %r", t.subject, e)
+            return False
+
+    def _mark(self, t: _Target, ok: bool) -> None:
+        t.consecutive_failures = 0 if ok else t.consecutive_failures + 1
+        if t.healthy != ok:
+            logger.info("endpoint %s health: %s", t.subject,
+                        "ready" if ok else "NOT READY")
+        t.healthy = ok
+        self._publish(t)
+
+    def _publish(self, t: _Target) -> None:
+        server = getattr(self.runtime, "_status_server", None)
+        if server is not None:
+            server.health_checks[t.subject] = t.healthy

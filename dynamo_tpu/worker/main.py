@@ -162,6 +162,16 @@ def main(argv=None) -> None:
             serving = handler
             extra.append(await serve_kv_pull(
                 rt, card.namespace, card.component, handler, instance_id))
+        if rt.health is not None:
+            # persistent canary failure = wedged-but-alive worker: exit so
+            # the lease drops and routers stop sending traffic (same exit
+            # contract as the engine-death monitor)
+            def _canary_dead(subject: str) -> None:
+                logger.error("canary health checks failing for %s; "
+                             "exiting so the lease drops", subject)
+                os._exit(43)
+
+            rt.health.on_unhealthy = _canary_dead
         handle = await serve_engine(rt, serving, card,
                                     instance_id=instance_id)
         monitor = EngineDeathMonitor(engine)

@@ -1,0 +1,219 @@
+"""Canary health checks (ref lib/runtime/src/health_check.rs:44-120).
+
+Wedged-but-alive workers: lease-based liveness can't see them (the
+process is fine, the engine is stuck). The canary manager probes idle
+endpoints through the same engine path as real traffic and flips health;
+persistent failure fires on_unhealthy, which workers use to drop the
+instance (mirrors tests around health_check.rs + engine_monitor).
+"""
+
+import asyncio
+
+from dynamo_tpu.llm.entrypoint import serve_engine
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import FnEngine
+from dynamo_tpu.runtime.health_check import (
+    HealthCheckConfig,
+    HealthCheckManager,
+)
+
+
+def _cfg(**kw) -> RuntimeConfig:
+    kw.setdefault("store_url", "memory")
+    kw.setdefault("health_check_enabled", True)
+    kw.setdefault("health_check_interval", 0.05)
+    kw.setdefault("health_check_timeout", 0.2)
+    return RuntimeConfig(**kw)
+
+
+async def ok_engine(req, ctx):
+    yield {"token_ids": [1], "finish_reason": "stop"}
+
+
+async def wedged_engine(req, ctx):
+    await asyncio.sleep(60)  # never answers
+    yield {}
+
+
+async def test_canary_probes_healthy_endpoint():
+    rt = await DistributedRuntime.create(_cfg())
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        await ep.serve(ok_engine, instance_id=1,
+                       health_payload={"token_ids": [1]})
+        await asyncio.sleep(0.3)  # several canary periods
+        subject = next(iter(rt.health._targets))
+        assert rt.health.healthy(subject) is True
+        assert rt.health.all_healthy()
+    finally:
+        await rt.close()
+
+
+async def test_canary_flips_health_on_wedged_engine():
+    rt = await DistributedRuntime.create(_cfg())
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        await ep.serve(wedged_engine, instance_id=1,
+                       health_payload={"token_ids": [1]})
+        subject = next(iter(rt.health._targets))
+        for _ in range(100):
+            if rt.health.healthy(subject) is False:
+                break
+            await asyncio.sleep(0.05)
+        assert rt.health.healthy(subject) is False
+        assert not rt.health.all_healthy()
+    finally:
+        await rt.close()
+
+
+async def test_activity_resets_canary_timer():
+    """Real traffic on the endpoint suppresses probes entirely."""
+    rt = await DistributedRuntime.create(_cfg(health_check_interval=0.5))
+    try:
+        probes = 0
+
+        async def counting_engine(req, ctx):
+            nonlocal probes
+            if (req.get("extra") or {}).get("canary"):
+                probes += 1
+            yield {"token_ids": [1], "finish_reason": "stop"}
+
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        served = await ep.serve(
+            counting_engine, instance_id=1,
+            health_payload={"token_ids": [1], "extra": {"canary": True}})
+        # hammer the endpoint through the served (activity-wrapped) path
+        wrapped = rt.local_engine(served.instance.subject)
+        for _ in range(10):
+            async for _ in wrapped.generate({"token_ids": [2]}, Context()):
+                pass
+            await asyncio.sleep(0.05)
+        assert probes == 0  # busy endpoint: no canaries fired
+        await asyncio.sleep(1.2)  # now idle: probes resume
+        assert probes >= 1
+    finally:
+        await rt.close()
+
+
+async def test_persistent_failure_removes_instance():
+    """fail_limit consecutive canary failures → on_unhealthy drops the
+    instance from the store, so watchers see it leave."""
+    rt = await DistributedRuntime.create(_cfg(
+        health_check_interval=0.05, health_check_timeout=0.1))
+    try:
+        card = ModelDeploymentCard(
+            name="wm", namespace="ns", component="c",
+            tokenizer_kind="word", tokenizer_path="wm")
+        handle = await serve_engine(rt, FnEngine(wedged_engine), card,
+                                    instance_id=7)
+
+        dropped = asyncio.Event()
+
+        def on_unhealthy(subject: str) -> None:
+            asyncio.get_running_loop().create_task(handle.stop())
+            dropped.set()
+
+        rt.health.on_unhealthy = on_unhealthy
+        client = await (rt.namespace("ns").component("c")
+                        .endpoint("generate").client())
+        await client.start()
+        try:
+            assert len(client.instances()) == 1
+            await asyncio.wait_for(dropped.wait(), 10)
+            for _ in range(100):
+                if not client.instances():
+                    break
+                await asyncio.sleep(0.02)
+            assert client.instances() == []   # watcher saw the removal
+        finally:
+            await client.stop()
+    finally:
+        await rt.close()
+
+
+async def test_status_server_aggregates_canary_health():
+    import aiohttp
+
+    rt = await DistributedRuntime.create(_cfg(system_port=0))
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        await ep.serve(wedged_engine, instance_id=1,
+                       health_payload={"token_ids": [1]})
+        subject = next(iter(rt.health._targets))
+        for _ in range(100):
+            if rt.health.healthy(subject) is False:
+                break
+            await asyncio.sleep(0.05)
+        port = rt._status_server.port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/health") as r:
+                assert r.status == 503
+                body = await r.json()
+        assert body["status"] == "unhealthy"
+        assert subject in body["failing"]
+    finally:
+        await rt.close()
+
+
+async def test_manager_close_cancels_probes():
+    rt = await DistributedRuntime.create(_cfg())
+    try:
+        m = HealthCheckManager(rt, HealthCheckConfig(canary_wait=0.05))
+        m.register("s1", FnEngine(ok_engine))
+        m.register("s2", FnEngine(ok_engine))
+        await asyncio.sleep(0.1)
+        await m.close()
+        assert m._targets == {}
+    finally:
+        await rt.close()
+
+
+async def test_wedged_engine_with_arriving_traffic_still_probed():
+    """Review regression: request ARRIVAL must not count as activity —
+    a wedged engine keeps receiving traffic, and only OUTPUT proves
+    liveness, so the canary must still fire and flip health."""
+    rt = await DistributedRuntime.create(_cfg(health_check_interval=0.1))
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        served = await ep.serve(wedged_engine, instance_id=1,
+                                health_payload={"token_ids": [1]})
+        subject = served.instance.subject
+        wrapped = rt.local_engine(subject)
+
+        async def hammer():
+            # steady arrivals faster than canary_wait, none ever answered
+            while True:
+                task = asyncio.get_running_loop().create_task(
+                    wrapped.generate({"token_ids": [2]}, Context()).__anext__())
+                await asyncio.sleep(0.03)
+                task.cancel()
+
+        h = asyncio.get_running_loop().create_task(hammer())
+        try:
+            for _ in range(100):
+                if rt.health.healthy(subject) is False:
+                    break
+                await asyncio.sleep(0.05)
+            assert rt.health.healthy(subject) is False
+        finally:
+            h.cancel()
+    finally:
+        await rt.close()
+
+
+async def test_on_unhealthy_fires_once():
+    rt = await DistributedRuntime.create(_cfg(
+        health_check_interval=0.03, health_check_timeout=0.05))
+    try:
+        calls = []
+        rt.health.on_unhealthy = calls.append
+        ep = rt.namespace("ns").component("c").endpoint("generate")
+        await ep.serve(wedged_engine, instance_id=1,
+                       health_payload={"token_ids": [1]})
+        await asyncio.sleep(1.0)  # many failures past fail_limit
+        assert len(calls) == 1    # latched: one transition, one callback
+    finally:
+        await rt.close()

@@ -163,6 +163,7 @@ class TpuEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._stopped = False
+        self._progress = 0  # scheduler forward-progress token (canary)
         self._rng = np.random.RandomState(cfg.rng_seed)
         # Serializes device access: step functions donate the cache buffers
         # (the pre-step arrays die mid-call), so concurrent readers
@@ -253,6 +254,12 @@ class TpuEngine:
         `service/clear_kv_blocks.rs`). Returns pages freed."""
         return self.pool.clear_inactive()
 
+    def progress_token(self) -> int:
+        """Monotonic scheduler forward-progress marker. The canary uses it
+        to distinguish saturated (token advances while the probe waits —
+        don't kill the worker) from wedged (frozen)."""
+        return self._progress
+
     async def close(self) -> None:
         self._stopped = True
         self._wake.set()
@@ -295,7 +302,9 @@ class TpuEngine:
                 progressed = await self._prefill_pending()
                 progressed |= await self._decode_iter()
                 self._publish_metrics()
-                if not progressed:
+                if progressed:
+                    self._progress += 1
+                else:
                     await asyncio.sleep(0.001)
             except Exception:
                 logger.exception("engine scheduler iteration failed")

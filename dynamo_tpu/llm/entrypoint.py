@@ -88,10 +88,11 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
     comp = runtime.namespace(card.namespace).component(card.component)
     ep = comp.endpoint(card.endpoint)
     # one-token greedy canary (vllm health_check.py builds the same shape);
-    # only probed when the runtime's health manager is enabled + idle
-    canary = {"token_ids": [1], "model": card.name,
-              "sampling": {"temperature": 0.0},
-              "stop": {"max_tokens": 1, "ignore_eos": True}}
+    # only probed when the runtime's health manager is enabled + idle.
+    # The extra.canary marker lets sinks/metrics tell probes from traffic.
+    from dynamo_tpu.runtime.health_check import DEFAULT_CANARY_PAYLOAD
+
+    canary = {**DEFAULT_CANARY_PAYLOAD, "model": card.name}
     served = await ep.serve(
         engine, instance_id=instance_id,
         metadata={"dp_size": card.runtime_config.data_parallel_size},

@@ -156,24 +156,36 @@ class OpenAIPreprocessor(Operator):
                     f"length of {self.context_length}", status=400)
             token_lists.append(ids)
 
+        sem = asyncio.Semaphore(32)  # batch can be 2048 items: cap fan-out
+
         async def one(ids: list[int]) -> list[float]:
             pre = PreprocessedRequest(
                 token_ids=ids, model=self.model_name,
                 stop=StopConditions(max_tokens=1),
                 extra={"embed": True})
-            async for out in self.inner.generate(pre.to_dict(), context):
-                if out.get("embedding") is not None:
-                    return [float(x) for x in out["embedding"]]
-                if out.get("finish_reason"):
-                    break
+            async with sem:
+                async for out in self.inner.generate(pre.to_dict(),
+                                                     context):
+                    if out.get("embedding") is not None:
+                        return [float(x) for x in out["embedding"]]
+                    if out.get("finish_reason"):
+                        break
             raise OpenAIError(
                 f"model {self.model_name!r} does not support embeddings",
                 status=400)
 
-        # items are independent: fan out, keep input order by position
-        embeddings = list(await asyncio.gather(
-            *(one(ids) for ids in token_lists)))
-        yield embedding_response(req.model, embeddings,
+        # items are independent: bounded fan-out, order kept by position;
+        # TaskGroup cancels the siblings the moment one item fails
+        results: list = [None] * len(token_lists)
+        try:
+            async with asyncio.TaskGroup() as tg:
+                for i, ids in enumerate(token_lists):
+                    async def slot(i=i, ids=ids):
+                        results[i] = await one(ids)
+                    tg.create_task(slot())
+        except* OpenAIError as eg:
+            raise eg.exceptions[0]
+        yield embedding_response(req.model, results,
                                  sum(len(t) for t in token_lists),
                                  req.encoding_format)
 

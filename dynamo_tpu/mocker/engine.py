@@ -83,6 +83,7 @@ class MockEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._stopped = False
+        self._progress = 0  # scheduler forward-progress token (canary)
 
     # -- engine contract ---------------------------------------------------
 
@@ -154,6 +155,8 @@ class MockEngine:
             progressed = await self._prefill_new()
             progressed |= await self._decode_iter()
             self._publish_metrics()
+            if progressed:
+                self._progress += 1
             if not progressed:
                 # Nothing runnable (e.g. head-of-line request waiting for KV
                 # space): yield the event loop instead of spinning.
@@ -284,6 +287,10 @@ class MockEngine:
             ),
         )
         self.metrics_sink(m)
+
+    def progress_token(self) -> int:
+        """Scheduler forward-progress marker (see TpuEngine.progress_token)."""
+        return self._progress
 
     def clear_kv_blocks(self) -> int:
         """Admin cache clear (clear_kv_blocks.rs analog): forget every

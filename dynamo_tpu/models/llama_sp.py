@@ -1,0 +1,101 @@
+"""Sequence-parallel (ring-attention) long-context prefill for Llama.
+
+The reference has no SP/CP at all (SURVEY §2.10: "absent — relies on
+engine TP and KVBM offload"); on TPU we own the engine, so long prompts
+shard over a mesh "sp" axis: every device embeds and projects ITS chunk
+of the prompt (activations never materialize globally), attention runs as
+a K/V ring (`engine/ring_attention.py`), and the MLP is pointwise over
+sequence so it needs no communication at all. Peak activation memory per
+chip drops by ~sp×, which is what bounds single-chip prefill length.
+
+Composes with tensor parallelism: run this under a 2-D ("sp", "tp") mesh
+and the per-chunk projections shard heads over "tp" exactly as the
+standard path does (XLA inserts the same psum after wo/w_down).
+
+Outputs: last-token logits (what serving needs to start decode) plus each
+layer's K/V for the sequence — still sequence-sharded, ready to be paged
+into the engine cache chunk-by-chunk without ever gathering the full
+sequence on one chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.ring_attention import ring_attention_local
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    _layer_params,
+    _swiglu,
+    rms_norm,
+    rope,
+)
+
+
+def _sp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                      axis: str):
+    """Per-shard body (inside shard_map): tokens (B, Tc) local chunk.
+
+    Returns (logits (1, B, V) — this shard's LAST-token logits, k_all,
+    v_all (L, B, Tc, KVH, D) — this chunk's KV for cache writeback)."""
+    idx = lax.axis_index(axis)
+    B, Tc = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    positions = (idx * Tc + jnp.arange(Tc))[None, :]       # global positions
+    x = params["embed"][tokens]                            # (B, Tc, E)
+    ks, vs = [], []
+    for l in range(cfg.num_layers):
+        lp = _layer_params(params, l)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = rope((h @ lp["wq"]).reshape(B, Tc, H, D), positions,
+                 cfg.rope_theta)
+        k = rope((h @ lp["wk"]).reshape(B, Tc, KVH, D), positions,
+                 cfg.rope_theta)
+        v = (h @ lp["wv"]).reshape(B, Tc, KVH, D)
+        ks.append(k)
+        vs.append(v)
+        attn = ring_attention_local(q, k, v, axis, causal=True)
+        x = x + attn.reshape(B, Tc, H * D) @ lp["wo"]
+        x = x + _swiglu(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp)
+    xf = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
+    logits = (xf @ params["lm_head"]).astype(jnp.float32)  # (B, V)
+    return logits[None], jnp.stack(ks), jnp.stack(vs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "axis"))
+def _sp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
+                    axis: str):
+    param_spec = jax.tree.map(lambda _: P(), params)
+    fn = jax.shard_map(
+        functools.partial(_sp_forward_local, cfg=cfg, axis=axis),
+        mesh=mesh,
+        in_specs=(param_spec, P(None, axis)),
+        out_specs=(P(axis, None, None),
+                   P(None, None, axis, None, None),
+                   P(None, None, axis, None, None)))
+    return fn(params, tokens)
+
+
+def sp_prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+               mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel prefill of a long prompt.
+
+    tokens: (B, T) with T divisible by the "sp" axis size. Returns
+    (last_logits (B, V) float32, k_all, v_all (L, B, T, KVH, D) — KV
+    sequence-sharded over the mesh).
+
+    Params are replicated over "sp" (P() spec): each chip streams the
+    weights once per its chunk — the standard megatron-style memory/compute
+    trade; combine with "tp" on a 2-D mesh to shard weights too."""
+    sp = mesh.shape[axis]
+    assert tokens.shape[1] % sp == 0, (
+        f"prompt length {tokens.shape[1]} not divisible by sp={sp}")
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P(None, axis)))
+    logits_all, k_all, v_all = _sp_prefill_jit(params, tokens, cfg, mesh,
+                                               axis)
+    return logits_all[-1], k_all, v_all

@@ -158,9 +158,11 @@ class HealthCheckManager:
                     logger.exception("on_unhealthy callback failed")
 
     async def _probe_once(self, t: _Target) -> bool:
+        ctx = Context()
+        progress_fn = getattr(t.engine, "progress_token", None)
+        progress_before = progress_fn() if progress_fn is not None else None
         try:
             async def consume():
-                ctx = Context()
                 async for out in t.engine.generate(dict(t.payload), ctx):
                     if isinstance(out, dict) and out.get("error"):
                         raise RuntimeError(out["error"])
@@ -171,8 +173,23 @@ class HealthCheckManager:
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            # Saturated ≠ wedged: a full batch of long prefills can queue
+            # the canary past its timeout while the scheduler is making
+            # steady forward progress. Only count the failure when the
+            # engine's progress token ALSO stalled (a hung loop can't
+            # advance it); killing a merely-busy worker drops every
+            # in-flight request for nothing.
+            if progress_fn is not None and progress_fn() != progress_before:
+                logger.info("canary timeout for %s but engine is making "
+                            "progress (busy, not wedged)", t.subject)
+                return True
             logger.warning("canary probe failed for %s: %r", t.subject, e)
             return False
+        finally:
+            # reap the canary sequence: a timed-out probe left it queued
+            # in the engine, and only a cancelled context lets the
+            # scheduler drop it
+            ctx.cancel()
 
     def _mark(self, t: _Target, ok: bool) -> None:
         t.consecutive_failures = 0 if ok else t.consecutive_failures + 1

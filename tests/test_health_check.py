@@ -217,3 +217,59 @@ async def test_on_unhealthy_fires_once():
         assert len(calls) == 1    # latched: one transition, one callback
     finally:
         await rt.close()
+
+
+async def test_saturated_engine_not_killed():
+    """Review regression: probe timeout while the scheduler is making
+    forward progress must NOT count as a failure (busy ≠ wedged)."""
+    rt = await DistributedRuntime.create(_cfg(
+        health_check_interval=0.05, health_check_timeout=0.05))
+    try:
+        class BusyEngine:
+            """Progress token advances; requests answer far too slowly
+            for the probe timeout (queue-full long-prefill shape)."""
+
+            def __init__(self):
+                self._progress = 0
+
+            def progress_token(self):
+                self._progress += 1  # scheduler is iterating
+                return self._progress
+
+            async def generate(self, req, ctx):
+                await asyncio.sleep(10)
+                yield {"token_ids": [1], "finish_reason": "stop"}
+
+        fired = []
+        rt.health.on_unhealthy = fired.append
+        rt.health.register("busy", BusyEngine())
+        await asyncio.sleep(0.6)  # many probe rounds, all timing out
+        assert rt.health.healthy("busy") is True
+        assert fired == []
+    finally:
+        await rt.close()
+
+
+async def test_probe_timeout_cancels_canary_context():
+    """Timed-out probes must cancel their Context so the engine scheduler
+    can reap the queued canary sequence (no orphan growth)."""
+    rt = await DistributedRuntime.create(_cfg(
+        health_check_interval=0.03, health_check_timeout=0.05))
+    try:
+        contexts = []
+
+        async def slow_engine(req, ctx):
+            contexts.append(ctx)
+            await asyncio.sleep(10)
+            yield {}
+
+        rt.health.register("slow", FnEngine(slow_engine))
+        for _ in range(100):
+            if len(contexts) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(0.1)  # let timeouts land
+        assert len(contexts) >= 2
+        assert all(c.is_cancelled() for c in contexts[:-1])
+    finally:
+        await rt.close()

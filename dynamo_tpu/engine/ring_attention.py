@@ -33,23 +33,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_attend(q, k, v, q_pos, kv_pos, causal: bool):
+def _block_attend(q5, k, v, q_pos, kv_pos, causal: bool):
     """Partial attention of one Q chunk against one K/V chunk.
 
-    q: (B, Tq, H, D); k/v: (B, Tk, H, D) (kv heads already repeated).
-    Returns (o_part (B, Tq, H, D) f32, m_part (B, H, Tq) f32,
-    l_part (B, H, Tq) f32) — unnormalized output + softmax stats."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    q5: (B, Tq, KVH, G, D) — query heads grouped by kv head, so GQA K/V
+    are NEVER materialized to full head count (`jnp.repeat` inside the
+    ring body would copy the K/V chunk groups× on every ring step).
+    k/v: (B, Tk, KVH, D). Returns (o_part (B, Tq, KVH, G, D) f32,
+    m_part, l_part (B, KVH, G, Tq) f32) — unnormalized output + stats."""
+    d = q5.shape[-1]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k,
                         preferred_element_type=jnp.float32)
     scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
     if causal:
         mask = kv_pos[None, :] <= q_pos[:, None]          # (Tq, Tk)
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
-    m_part = jnp.max(scores, axis=-1)                      # (B, H, Tq)
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    m_part = jnp.max(scores, axis=-1)                      # (B, KVH, G, Tq)
     p = jnp.exp(scores - m_part[..., None])
     l_part = jnp.sum(p, axis=-1)
-    o_part = jnp.einsum("bhqk,bkhd->bqhd", p,
+    o_part = jnp.einsum("bhgqk,bkhd->bqhgd", p,
                         v.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
     return o_part, m_part, l_part
@@ -67,7 +69,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
     tk = k.shape[1]
     kvh = k.shape[2]
     groups = h // kvh
-    q32 = q.astype(jnp.float32)
+    q5 = q.astype(jnp.float32).reshape(b, tq, kvh, groups, d)
     q_pos = idx * tq + jnp.arange(tq)
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]  # receive neighbor's kv
@@ -76,15 +78,14 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
         k_cur, v_cur, m, l, acc = carry
         src = (idx - s) % sp                       # whose chunk we hold
         kv_pos = src * tk + jnp.arange(tk)
-        k_rep = jnp.repeat(k_cur, groups, axis=2) if groups > 1 else k_cur
-        v_rep = jnp.repeat(v_cur, groups, axis=2) if groups > 1 else v_cur
-        o_p, m_p, l_p = _block_attend(q32, k_rep.astype(jnp.float32),
-                                      v_rep, q_pos, kv_pos, causal)
+        o_p, m_p, l_p = _block_attend(q5, k_cur.astype(jnp.float32),
+                                      v_cur, q_pos, kv_pos, causal)
         m_new = jnp.maximum(m, m_p)
         scale_old = jnp.exp(m - m_new)
         scale_new = jnp.exp(m_p - m_new)
-        acc = (acc * scale_old.transpose(0, 2, 1)[..., None]
-               + o_p * scale_new.transpose(0, 2, 1)[..., None])
+        # stats are (B, KVH, G, Tq); acc is (B, Tq, KVH, G, D)
+        acc = (acc * scale_old.transpose(0, 3, 1, 2)[..., None]
+               + o_p * scale_new.transpose(0, 3, 1, 2)[..., None])
         l = l * scale_old + l_p * scale_new
         # rotate K/V one hop around the ring (ICI neighbor exchange);
         # XLA overlaps the permute with the next block's compute
@@ -92,16 +93,16 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m_new, l, acc
 
-    m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tq), jnp.float32)
-    acc0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    m0 = jnp.full((b, kvh, groups, tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, groups, tq), jnp.float32)
+    acc0 = jnp.zeros((b, tq, kvh, groups, d), jnp.float32)
     # the loop output varies over the ring axis (it depends on axis_index),
     # so the constant init carry must be marked varying too or shard_map's
     # carry-type check rejects the fori_loop
-    m0, l0, acc0 = lax.pvary((m0, l0, acc0), (axis_name,))
+    m0, l0, acc0 = lax.pcast((m0, l0, acc0), (axis_name,), to='varying')
     _, _, _, l, acc = lax.fori_loop(0, sp, body, (k, v, m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, tq, h, d).astype(q.dtype)
 
 
 def sp_mesh(sp: int, devices=None) -> Mesh:

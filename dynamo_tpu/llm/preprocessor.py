@@ -183,8 +183,22 @@ class OpenAIPreprocessor(Operator):
                     async def slot(i=i, ids=ids):
                         results[i] = await one(ids)
                     tg.create_task(slot())
-        except* OpenAIError as eg:
-            raise eg.exceptions[0]
+        except BaseExceptionGroup as eg:
+            # unwrap to a bare exception (gather semantics): the HTTP
+            # layer catches OpenAIError, so surface one if any item
+            # raised it; otherwise re-raise the first failure as-is
+            flat: list[BaseException] = []
+            stack: list[BaseException] = [eg]
+            while stack:
+                e = stack.pop()
+                if isinstance(e, BaseExceptionGroup):
+                    stack.extend(e.exceptions)
+                else:
+                    flat.append(e)
+            for e in flat:
+                if isinstance(e, OpenAIError):
+                    raise e
+            raise flat[0]
         yield embedding_response(req.model, results,
                                  sum(len(t) for t in token_lists),
                                  req.encoding_format)

@@ -388,3 +388,29 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
     _, k_cache, v_cache, out = lax.fori_loop(
         0, num_steps, body, (tokens, k_cache, v_cache, out0))
     return out, k_cache, v_cache
+
+
+def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
+                    mask: jax.Array, cfg: "LlamaConfig") -> jax.Array:
+    """One layer's attention sub-block over a dense (unpaged) sequence:
+    pre-norm, RoPE'd GQA attention under ``mask``, wo projection,
+    residual add. Shared by the cache-free forwards (MoE parity forward,
+    pipeline-parallel stages) so the attention math exists exactly once
+    outside the paged path. x: (B, T, E); mask: (T, T) bool."""
+    B, T, _ = x.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+    q = rope((h @ lp["wq"]).reshape(B, T, H, D), positions, cfg.rope_theta)
+    k = rope((h @ lp["wk"]).reshape(B, T, KVH, D), positions,
+             cfg.rope_theta)
+    v = (h @ lp["wv"]).reshape(B, T, KVH, D)
+    if KVH != H:
+        k = jnp.repeat(k, H // KVH, axis=2)
+        v = jnp.repeat(v, H // KVH, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
+                      v.astype(jnp.float32)).astype(x.dtype)
+    return x + attn.reshape(B, T, H * D) @ lp["wo"]

@@ -175,11 +175,15 @@ class HealthCheckManager:
         except Exception as e:
             # Saturated ≠ wedged: a full batch of long prefills can queue
             # the canary past its timeout while the scheduler is making
-            # steady forward progress. Only count the failure when the
-            # engine's progress token ALSO stalled (a hung loop can't
+            # steady forward progress. Only count a TIMEOUT as busy when
+            # the engine's progress token advanced (a hung loop can't
             # advance it); killing a merely-busy worker drops every
-            # in-flight request for nothing.
-            if progress_fn is not None and progress_fn() != progress_before:
+            # in-flight request for nothing. Real errors always count —
+            # processing the canary itself advances the token, so an
+            # engine erroring on every request must not pass this guard.
+            if (isinstance(e, asyncio.TimeoutError)
+                    and progress_fn is not None
+                    and progress_fn() != progress_before):
                 logger.info("canary timeout for %s but engine is making "
                             "progress (busy, not wedged)", t.subject)
                 return True

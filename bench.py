@@ -115,9 +115,42 @@ def hbm_bytes_per_step(cfg, params):
     return param_bytes + kv_bytes
 
 
+async def bench_kv_transfer(cfg, n_pages=256):
+    """Disagg KV transfer GB/s: host-bounce gather vs device-resident
+    gather (the ICI-path source op). VERDICT r2 #7 asks for both."""
+    import time as _t
+
+    import numpy as np
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+
+    eng = TpuEngine(TpuEngineConfig(model=cfg, num_pages=n_pages + 8,
+                                    max_batch_size=1))
+    pages = list(range(1, n_pages + 1))
+    # warm both paths (compile the gathers)
+    host = await eng.read_kv_pages(pages)
+    dev = await eng.read_kv_pages_device(pages)
+    nbytes = host.nbytes
+    reps = 3
+    t0 = _t.perf_counter()
+    for _ in range(reps):
+        await eng.read_kv_pages(pages)
+    host_s = (_t.perf_counter() - t0) / reps
+    t0 = _t.perf_counter()
+    for _ in range(reps):
+        (await eng.read_kv_pages_device(pages)).block_until_ready()
+    dev_s = (_t.perf_counter() - t0) / reps
+    del dev
+    await eng.close()
+    return {"kv_transfer_mb": round(nbytes / 1e6, 1),
+            "kv_host_gbps": round(nbytes / host_s / 1e9, 2),
+            "kv_device_gbps": round(nbytes / dev_s / 1e9, 2)}
+
+
 def main():
     cfg = bench_cfg()
     tok_s, wall, params = asyncio.run(run_engine_bench(cfg))
+    kv_stats = asyncio.run(bench_kv_transfer(cfg))
     loop_tok_s, loop_step_s = run_device_loop(cfg, params)
     ms_per_step = 1000.0 * BATCH / tok_s  # engine wall per fused step
     hbm = hbm_bytes_per_step(cfg, params)
@@ -133,6 +166,7 @@ def main():
         "hbm_util_pct": round(
             100.0 * hbm / loop_step_s / 1e9 / V5E_HBM_GBPS, 1),
         "isl": ISL, "osl": OSL, "n_requests": N_REQS, "batch": BATCH,
+        **kv_stats,
     }))
 
 

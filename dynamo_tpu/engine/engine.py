@@ -603,16 +603,45 @@ class TpuEngine:
         async with self._device_lock:
             return await asyncio.to_thread(self._read_kv_pages_sync, page_ids)
 
-    def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
-        """Host copy (2, L, KVH, n, P, D) — the wire/tier format. Caches
-        are per-layer tuples on device; one stacked device gather + one
-        transfer."""
+    def _gather_kv_pages(self, page_ids: list[int]):
+        """The one gather: device-resident (2, L, KVH, n, P, D). Both the
+        host and device transfer paths go through here so a cache-layout
+        change can't skew them apart."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        k_sel = np.asarray(jax.numpy.stack(
-            [kc[:, ids] for kc in self.k_cache]))
-        v_sel = np.asarray(jax.numpy.stack(
-            [vc[:, ids] for vc in self.v_cache]))
-        return np.stack([k_sel, v_sel])
+        k_sel = jax.numpy.stack([kc[:, ids] for kc in self.k_cache])
+        v_sel = jax.numpy.stack([vc[:, ids] for vc in self.v_cache])
+        out = jax.numpy.stack([k_sel, v_sel])
+        out.block_until_ready()
+        return out
+
+    def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
+        """Host copy — the wire/tier format."""
+        return np.asarray(self._gather_kv_pages(page_ids))
+
+    async def read_kv_pages_device(self, page_ids: list[int]):
+        """Device-resident gather (2, L, KVH, n, P, D) — NO host copy.
+
+        The ICI/device-to-device transfer path: the caller `device_put`s
+        the result onto the destination engine's devices (same-process
+        TPU→TPU rides DMA; the CPU mesh stands in for ICI in tests) and
+        hands it to the decode request as ``kv_transfer_params.kv_data``
+        — `write_kv_pages` accepts device arrays as-is, so the page bytes
+        never touch host memory. Ref: SURVEY §7 step 7 (the NIXL analog,
+        `block_manager/block/transfer/`)."""
+        async with self._device_lock:
+            return await asyncio.to_thread(self._gather_kv_pages, page_ids)
+
+    def kv_import_sharding(self):
+        """Sharding for a transfer array (2, L, KVH, n, P, D) matching
+        this engine's cache layout — the device_put target for the ICI
+        path (kv heads over "tp" when the engine runs on a mesh)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = getattr(self.config, "mesh", None)
+        if mesh is not None and "tp" in mesh.axis_names:
+            return NamedSharding(
+                mesh, PartitionSpec(None, None, "tp", None, None, None))
+        return list(self.k_cache[0].devices())[0]
 
     def write_kv_pages(self, page_ids: list[int], data: np.ndarray) -> None:
         """Only call from within the scheduler's device-locked step (the
@@ -628,8 +657,13 @@ class TpuEngine:
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown
-        or expired."""
+        or expired. Refreshes the TTL deadline: a chunked/device pull has
+        many await points, and the reaper releasing (then a new prefill
+        reusing) the pages mid-pull would stream the WRONG sequence's KV
+        with no error. An abandoned pull still expires one ttl later."""
         pages, plen, _ = self._transfers[transfer_id]
+        self._transfers[transfer_id] = (
+            pages, plen, time.monotonic() + self.transfer_ttl)
         return pages, plen
 
     def complete_transfer(self, transfer_id: str) -> None:

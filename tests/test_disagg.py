@@ -198,3 +198,154 @@ async def test_disagg_fallback_when_no_prefill_pool():
         assert len(toks) == 3
     finally:
         await de.close()
+
+
+async def test_read_kv_pages_device_matches_host():
+    """Device-resident gather == host-copy gather, value for value."""
+    import numpy as np
+
+    eng = make_engine()
+    try:
+        p_req = req(list(range(1, 12)), max_tokens=1)
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in eng.generate(p_req, Context())]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        pages, _ = eng.take_transfer(ktp["transfer_id"])
+        host = await eng.read_kv_pages(pages)
+        dev = await eng.read_kv_pages_device(pages)
+        assert hasattr(dev, "devices")          # a jax array, not numpy
+        np.testing.assert_array_equal(np.asarray(dev), host)
+        eng.complete_transfer(ktp["transfer_id"])
+    finally:
+        await eng.close()
+
+
+async def test_disagg_device_path_e2e():
+    """Same-process prefill engine registered via serve_kv_pull → the
+    decode handler pulls KV device-side (no wire frames) and the output
+    still matches aggregated serving."""
+    from dynamo_tpu.disagg import handlers as H
+
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    pe = make_engine(rng_seed=0)
+    de = make_engine(rng_seed=0)
+    p_handler = PrefillWorkerHandler(pe, instance_id=77)
+    ep_gen = rt.namespace("ns").component("pf").endpoint("generate")
+    await ep_gen.serve(p_handler, instance_id=77)
+    served_pull = await H.serve_kv_pull(rt, "ns", "pf", p_handler, 77)
+    gen_client = await ep_gen.client()
+    await gen_client.start()
+    await gen_client.wait_ready()
+    pull_ep = rt.namespace("ns").component("pf").endpoint(KV_PULL_ENDPOINT)
+    pull_client = await pull_ep.client()
+    await pull_client.start()
+    await pull_client.wait_ready()
+
+    try:
+        assert 77 in H._LOCAL_PREFILL
+        handler = DecodeWorkerHandler(
+            de, prefill_router=PushRouter(gen_client),
+            kv_pull_router=PushRouter(pull_client),
+            disagg_router=DisaggRouter(max_local_prefill_length=0))
+        outs = [o async for o in handler.generate(
+            req(prompt, max_tokens=6), Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref
+        assert handler.last_pull_path == "device"   # not the wire
+        assert pe.pool.active_pages == 0    # transfer released
+        await served_pull.shutdown()
+        assert 77 not in H._LOCAL_PREFILL   # registry cleaned up
+    finally:
+        H._LOCAL_PREFILL.pop(77, None)
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_chunked_wire_path():
+    """Wire path with 1-page chunks: many frames, assembled in order,
+    output still matches aggregated."""
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    handler.pull_chunk_pages = 1   # force max fragmentation
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref
+        assert handler.last_pull_path == "wire"
+        assert pe.pool.active_pages == 0
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_kv_pull_single_frame_when_unchunked():
+    """A requester that sends no chunk_pages (older client reading one
+    frame) gets the WHOLE transfer in one frame."""
+    eng = make_engine()
+    try:
+        p_req = req(list(range(1, 14)), max_tokens=1)
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in eng.generate(p_req, Context())]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        h = PrefillWorkerHandler(eng, instance_id=1)
+        frames = [f async for f in h.kv_pull(
+            {"transfer_id": ktp["transfer_id"]}, Context())]
+        assert len(frames) == 1
+        assert frames[0]["total_pages"] == frames[0]["shape"][3]
+        assert eng.pool.active_pages == 0
+    finally:
+        await eng.close()
+
+
+async def test_kv_pull_releases_on_consumer_abandon():
+    """Consumer closes the stream mid-transfer: the finally still
+    releases the pinned pages (no TTL leak)."""
+    eng = make_engine()
+    try:
+        p_req = req(list(range(1, 14)), max_tokens=1)
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in eng.generate(p_req, Context())]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        h = PrefillWorkerHandler(eng, instance_id=1)
+        gen = h.kv_pull({"transfer_id": ktp["transfer_id"],
+                         "chunk_pages": 1}, Context())
+        await gen.__anext__()      # read one frame of four
+        await gen.aclose()         # abandon
+        assert eng.pool.active_pages == 0
+    finally:
+        await eng.close()
+
+
+async def test_kv_pull_emits_chunked_frames():
+    eng = make_engine()
+    try:
+        p_req = req(list(range(1, 14)), max_tokens=1)  # 13 toks → 4 pages
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in eng.generate(p_req, Context())]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        h = PrefillWorkerHandler(eng, instance_id=1)
+        frames = [f async for f in h.kv_pull(
+            {"transfer_id": ktp["transfer_id"], "chunk_pages": 2},
+            Context())]
+        assert len(frames) == 2              # ceil(4 pages / 2)
+        assert [f["page_offset"] for f in frames] == [0, 2]
+        assert all(f["total_pages"] == 4 for f in frames)
+        assert eng.pool.active_pages == 0    # released on final frame
+    finally:
+        await eng.close()

@@ -95,6 +95,17 @@ class PrefillWorkerHandler:
         chunk = max(1, int(request.get("chunk_pages") or total or 1))
         try:
             for i in range(0, total, chunk):
+                if i > 0:
+                    # the consumer controls inter-frame pacing, so a slow
+                    # pull can outlive the TTL: re-take to refresh the
+                    # deadline AND confirm the reaper hasn't released the
+                    # pages (streaming freed/re-pinned pages would ship
+                    # another sequence's KV with no error)
+                    try:
+                        self.engine.take_transfer(tid)
+                    except KeyError:
+                        yield {"error": f"transfer {tid} expired mid-pull"}
+                        return
                 data = await self.engine.read_kv_pages(pages[i:i + chunk])
                 raw, shape, dtype = _bf16_bytes(data)
                 yield {"kv": raw, "shape": shape, "dtype": dtype,
@@ -178,10 +189,18 @@ class DecodeWorkerHandler:
             import jax
 
             try:
+                import asyncio as _aio
+
                 pages, _plen = src.engine.take_transfer(ktp["transfer_id"])
                 dev = await src.engine.read_kv_pages_device(pages)
-                out = jax.device_put(dev, self.engine.kv_import_sharding())
-                out.block_until_ready()
+                target = self.engine.kv_import_sharding()
+
+                def copy():
+                    out = jax.device_put(dev, target)
+                    out.block_until_ready()  # a 70B-scale copy: not on
+                    return out               # the event loop
+
+                out = await _aio.to_thread(copy)
                 src.engine.complete_transfer(ktp["transfer_id"])
                 self.last_pull_path = "device"
                 return out

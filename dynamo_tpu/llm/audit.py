@@ -1,0 +1,140 @@
+"""Audit subsystem: request/response capture off the hot path.
+
+Reference: `lib/llm/src/audit/` — a process-wide bus (`bus.rs`: publish
+never blocks, subscribers drain on their own tasks), pluggable sinks
+(`sink.rs`: stderr/log JSON line; env-selected via ``DYN_AUDIT_SINKS``),
+and a per-request handle that accumulates the record and emits it once
+at stream end (`handle.rs`/`stream.rs`). Enabled by ``DYN_AUDIT=1``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime.recorder import Recorder
+
+logger = logging.getLogger("dynamo_tpu.audit")
+
+
+@dataclass
+class AuditRecord:
+    """One served request, emitted at stream end."""
+
+    request_id: str
+    endpoint: str                   # chat_completions | completions | ...
+    model: str = ""
+    created_at: float = field(default_factory=time.time)
+    finished_at: float = 0.0
+    request: Optional[dict] = None  # client body (may be large)
+    response_text: str = ""
+    finish_reason: str = ""
+    usage: Optional[dict] = None
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class AuditSink:
+    name = "base"
+
+    def emit(self, rec: AuditRecord) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class LogSink(AuditSink):
+    """JSON line via the logging subsystem (StderrSink analog)."""
+
+    name = "log"
+
+    def emit(self, rec: AuditRecord) -> None:
+        logger.info("%s", json.dumps(rec.to_dict(),
+                                     separators=(",", ":")))
+
+
+class JsonlSink(AuditSink):
+    """Durable JSONL file via the generic recorder."""
+
+    name = "jsonl"
+
+    def __init__(self, path: str) -> None:
+        self.recorder = Recorder(path)
+
+    def emit(self, rec: AuditRecord) -> None:
+        self.recorder.record(rec.to_dict())
+
+    async def close(self) -> None:
+        await self.recorder.close()
+
+
+class AuditBus:
+    """Publish → queue → sink worker. ``publish`` never blocks and never
+    raises; a full queue drops (and counts) rather than stalls."""
+
+    def __init__(self, sinks: Optional[list[AuditSink]] = None,
+                 capacity: int = 1024) -> None:
+        self.sinks = sinks if sinks is not None else [LogSink()]
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.dropped = 0
+        self.published = 0
+
+    def publish(self, rec: AuditRecord) -> None:
+        if self._closed:
+            self.dropped += 1  # late publish after close: count, no leak
+            return
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._worker())
+        try:
+            self._queue.put_nowait(rec)
+            self.published += 1
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    async def _worker(self) -> None:
+        while True:
+            rec = await self._queue.get()
+            if rec is None:
+                return
+            for sink in self.sinks:
+                try:
+                    sink.emit(rec)
+                except Exception:
+                    logger.exception("audit sink %s failed", sink.name)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None and not self._task.done():
+            await self._queue.put(None)
+            await self._task
+        for sink in self.sinks:
+            close = getattr(sink, "close", None)
+            if close is not None:
+                await close()
+
+
+def audit_bus_from_env() -> Optional[AuditBus]:
+    """None unless ``DYN_AUDIT`` is truthy. Sinks from ``DYN_AUDIT_SINKS``
+    (comma list: log, jsonl); jsonl path from ``DYN_AUDIT_PATH``."""
+    if os.environ.get("DYN_AUDIT", "").lower() not in ("1", "true", "yes"):
+        return None
+    sinks: list[AuditSink] = []
+    for name in os.environ.get("DYN_AUDIT_SINKS", "log").split(","):
+        name = name.strip().lower()
+        if name in ("log", "stderr", ""):
+            sinks.append(LogSink())
+        elif name == "jsonl":
+            sinks.append(JsonlSink(
+                os.environ.get("DYN_AUDIT_PATH", "audit.jsonl")))
+        else:
+            logger.warning("audit: unknown sink %r ignored", name)
+    return AuditBus(sinks or [LogSink()])

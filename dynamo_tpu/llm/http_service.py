@@ -35,16 +35,56 @@ from dynamo_tpu.llm.protocols_openai import (
 )
 from dynamo_tpu.runtime.context import Context
 
+
+class _AuditTap:
+    """Engine wrapper that accumulates the response into an AuditRecord
+    and publishes it at stream end (audit/stream.rs analog). Items pass
+    through untouched; publish() is non-blocking."""
+
+    def __init__(self, inner, rec, bus) -> None:
+        self.inner = inner
+        self.rec = rec
+        self.bus = bus
+
+    async def generate(self, request, context):
+        import time as _t
+
+        try:
+            async for item in self.inner.generate(request, context):
+                for ch in item.get("choices", ()):
+                    delta = ch.get("delta", {})
+                    if delta.get("content"):
+                        self.rec.response_text += delta["content"]
+                    elif ch.get("text"):
+                        self.rec.response_text += ch["text"]
+                    if ch.get("finish_reason"):
+                        self.rec.finish_reason = ch["finish_reason"]
+                if item.get("usage"):
+                    self.rec.usage = item["usage"]
+                yield item
+        except BaseException as e:
+            self.rec.error = repr(e)
+            raise
+        finally:
+            self.rec.finished_at = _t.time()
+            self.bus.publish(self.rec)
+
 logger = logging.getLogger(__name__)
 
 
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "127.0.0.1",
                  port: int = 0, tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None) -> None:
+                 tls_key: Optional[str] = None, audit=None) -> None:
         self.manager = manager
         self.host = host
         self.port = port
+        self._audit_owned = audit is None
+        if audit is None:
+            from dynamo_tpu.llm.audit import audit_bus_from_env
+
+            audit = audit_bus_from_env()
+        self.audit = audit  # AuditBus or None
         if bool(tls_cert) != bool(tls_key):
             # half-configured TLS must not silently serve plaintext
             raise ValueError("tls_cert and tls_key must be set together")
@@ -98,6 +138,15 @@ class HttpService:
     def scheme(self) -> str:
         return "https" if self.tls_cert else "http"
 
+    def _audit_begin(self, request_id: str, endpoint: str, body):
+        if self.audit is None:
+            return None
+        from dynamo_tpu.llm.audit import AuditRecord
+
+        return AuditRecord(request_id=request_id, endpoint=endpoint,
+                           model=(body or {}).get("model", ""),
+                           request=body)
+
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> tuple[str, int]:
@@ -118,8 +167,12 @@ class HttpService:
         return self.host, self.port
 
     async def stop(self) -> None:
+        # handlers first (their _AuditTap finallys publish), THEN the bus;
+        # a caller-injected bus may be shared — never close it here
         if self._runner is not None:
             await self._runner.cleanup()
+        if self.audit is not None and self._audit_owned:
+            await self.audit.close()
 
     # -- handlers -----------------------------------------------------------
 
@@ -295,6 +348,11 @@ class HttpService:
         ctx = Context(request_id=request_id)
         pipeline_request = {"_kind": kind, "body": body,
                             "request_id": request_id}
+        audit_rec = self._audit_begin(request_id, endpoint, body)
+        if audit_rec is not None:
+            # capture deltas without perturbing the stream; the record is
+            # published (off hot path) when the stream finishes
+            engine = _AuditTap(engine, audit_rec, self.audit)
         start = time.perf_counter()
         self._inflight.add(1)
         try:

@@ -1,0 +1,85 @@
+"""Stream perf capture: timestamped response recording + latency stats.
+
+Reference: `lib/llm/src/perf.rs:4-8` — wrap a response stream, record an
+arrival timestamp per item without perturbing it, then analyze (TTFT,
+ITL distribution, tokens/sec) after the fact.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+
+@dataclass
+class RecordedItem:
+    at: float                       # perf_counter arrival
+    n_tokens: int
+    data: Any = None                # optionally retained item
+
+
+@dataclass
+class StreamPerf:
+    started_at: float = field(default_factory=time.perf_counter)
+    items: list[RecordedItem] = field(default_factory=list)
+    keep_items: bool = False
+
+    def observe(self, item: Any) -> None:
+        n = 0
+        if isinstance(item, dict):
+            n = len(item.get("token_ids", ()) or ())
+            if not n:
+                for ch in item.get("choices", ()):
+                    if ch.get("delta", {}).get("content") or ch.get("text"):
+                        n = 1
+                        break
+        self.items.append(RecordedItem(
+            at=time.perf_counter(), n_tokens=n,
+            data=item if self.keep_items else None))
+
+    # -- analysis ------------------------------------------------------------
+
+    @property
+    def token_items(self) -> list[RecordedItem]:
+        return [i for i in self.items if i.n_tokens > 0]
+
+    def ttft(self) -> float:
+        toks = self.token_items
+        return toks[0].at - self.started_at if toks else float("nan")
+
+    def itls(self) -> list[float]:
+        toks = self.token_items
+        return [b.at - a.at for a, b in zip(toks, toks[1:])]
+
+    def total_tokens(self) -> int:
+        return sum(i.n_tokens for i in self.items)
+
+    def duration(self) -> float:
+        return (self.items[-1].at - self.started_at) if self.items else 0.0
+
+    def summary(self) -> dict:
+        itls = sorted(self.itls())
+
+        def pct(p: float) -> float:
+            if not itls:
+                return float("nan")
+            return itls[min(len(itls) - 1, int(p * len(itls)))]
+
+        dur = self.duration()
+        return {
+            "ttft_s": self.ttft(),
+            "itl_mean_s": sum(itls) / len(itls) if itls else float("nan"),
+            "itl_p50_s": pct(0.50), "itl_p99_s": pct(0.99),
+            "total_tokens": self.total_tokens(),
+            "duration_s": dur,
+            "tokens_per_sec": (self.total_tokens() / dur) if dur else 0.0,
+        }
+
+
+async def record_stream(stream: AsyncIterator[Any],
+                        perf: StreamPerf) -> AsyncIterator[Any]:
+    """Pass-through wrapper: items flow unchanged; timings accumulate."""
+    async for item in stream:
+        perf.observe(item)
+        yield item

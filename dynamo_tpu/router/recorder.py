@@ -1,0 +1,40 @@
+"""KvRecorder: persist router KV events to JSONL and replay them.
+
+Reference: `lib/llm/src/kv_router/recorder.rs:8` — records the
+KvCacheEvent stream a router consumes so an index can be rebuilt (or a
+routing decision debugged) entirely offline. Replay drives any
+``apply_event`` consumer (RadixTree, KvIndexer) — same math, no engines.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from dynamo_tpu.protocols import KvCacheEvent
+from dynamo_tpu.runtime.recorder import Recorder
+
+
+class KvRecorder:
+    def __init__(self, path: str | Path) -> None:
+        self.recorder = Recorder(path)
+        self.path = Path(path)
+
+    def record(self, ev: KvCacheEvent) -> None:
+        self.recorder.record(ev.to_dict())
+
+    @property
+    def event_count(self) -> int:
+        return self.recorder.event_count
+
+    async def close(self) -> None:
+        await self.recorder.close()
+
+    @staticmethod
+    async def replay_into(path: str | Path, indexer,
+                          timed: bool = False,
+                          speedup: float = 1.0) -> int:
+        """Feed recorded events into anything with ``apply_event``."""
+        return await Recorder.replay(
+            path, lambda d: indexer.apply_event(KvCacheEvent.from_dict(d)),
+            timed=timed, speedup=speedup)

@@ -1,0 +1,106 @@
+"""Generic JSONL event recorder + replay.
+
+Reference: `lib/llm/src/recorder.rs:25-40` — an mpsc-fed background task
+appends ``{"timestamp": ..., "event": ...}`` lines to a JSONL file;
+producers never block on disk. Replay iterates the file, optionally
+re-spacing events by their recorded timestamps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Recorder:
+    """Append-only JSONL recorder with an off-hot-path writer task."""
+
+    def __init__(self, path: str | Path, flush_interval: float = 0.5,
+                 max_queue: int = 4096) -> None:
+        self.path = Path(path)
+        self.flush_interval = flush_interval
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        self.event_count = 0
+        self.dropped = 0
+        self.first_event_at: Optional[float] = None
+
+    def record(self, event: Any) -> None:
+        """Non-blocking enqueue; drops (and counts) when the writer can't
+        keep up — recording must never stall the serving path."""
+        if self._closed:
+            return
+        if self.first_event_at is None:
+            self.first_event_at = time.time()
+        self._ensure_task()
+        try:
+            self._queue.put_nowait({"timestamp": time.time(),
+                                    "event": event})
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._writer())
+
+    async def _writer(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self.path.open("a", encoding="utf-8") as f:
+            while True:
+                try:
+                    item = await asyncio.wait_for(self._queue.get(),
+                                                  self.flush_interval)
+                except asyncio.TimeoutError:
+                    f.flush()
+                    if self._closed:
+                        return
+                    continue
+                if item is None:
+                    f.flush()
+                    return
+                f.write(json.dumps(item, separators=(",", ":")) + "\n")
+                self.event_count += 1
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None and not self._task.done():
+            await self._queue.put(None)
+            await self._task
+
+    # -- replay --------------------------------------------------------------
+
+    @staticmethod
+    def iter_events(path: str | Path) -> Iterator[tuple[float, Any]]:
+        with Path(path).open(encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                    yield float(d["timestamp"]), d["event"]
+                except (ValueError, KeyError):
+                    logger.warning("recorder: skipping bad line")
+
+    @staticmethod
+    async def replay(path: str | Path, sink: Callable[[Any], None],
+                     timed: bool = False, speedup: float = 1.0) -> int:
+        """Feed recorded events into ``sink``; ``timed`` re-spaces them by
+        their original inter-event gaps (divided by ``speedup``)."""
+        n = 0
+        prev_ts: Optional[float] = None
+        for ts, event in Recorder.iter_events(path):
+            if timed and prev_ts is not None and ts > prev_ts:
+                await asyncio.sleep((ts - prev_ts) / speedup)
+            prev_ts = ts
+            sink(event)
+            n += 1
+        return n

@@ -1,0 +1,192 @@
+"""Audit bus + JSONL recorder + KvRecorder replay + stream perf capture.
+
+Reference strategy: `lib/llm/src/recorder.rs` inline tests (write/replay
+roundtrip), `audit/` (publish never blocks; sinks emit at stream end),
+`kv_router/recorder.rs` (offline index rebuild).
+"""
+
+import asyncio
+import json
+
+import aiohttp
+
+from dynamo_tpu.llm.audit import (
+    AuditBus,
+    AuditRecord,
+    JsonlSink,
+    audit_bus_from_env,
+)
+from dynamo_tpu.llm.perf import StreamPerf, record_stream
+from dynamo_tpu.protocols import KV_STORED, KvCacheEvent, StoredBlock
+from dynamo_tpu.router.indexer import RadixTree
+from dynamo_tpu.router.recorder import KvRecorder
+from dynamo_tpu.runtime.recorder import Recorder
+
+
+async def test_recorder_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    r = Recorder(path)
+    for i in range(20):
+        r.record({"i": i})
+    await r.close()
+    events = [e for _, e in Recorder.iter_events(path)]
+    assert events == [{"i": i} for i in range(20)]
+    assert r.event_count == 20
+    assert r.dropped == 0
+
+
+async def test_recorder_appends_across_instances(tmp_path):
+    path = tmp_path / "a.jsonl"
+    r1 = Recorder(path)
+    r1.record({"n": 1})
+    await r1.close()
+    r2 = Recorder(path)
+    r2.record({"n": 2})
+    await r2.close()
+    assert [e["n"] for _, e in Recorder.iter_events(path)] == [1, 2]
+
+
+async def test_recorder_replay_sink_and_timing(tmp_path):
+    path = tmp_path / "r.jsonl"
+    r = Recorder(path)
+    for i in range(5):
+        r.record(i)
+    await r.close()
+    got = []
+    n = await Recorder.replay(path, got.append)
+    assert n == 5 and got == [0, 1, 2, 3, 4]
+
+
+async def test_kv_recorder_rebuilds_index(tmp_path):
+    path = tmp_path / "kv.jsonl"
+    rec = KvRecorder(path)
+    events = [
+        KvCacheEvent(kind=KV_STORED, worker_id=7, event_id=1,
+                     parent_seq_hash=None,
+                     blocks=[StoredBlock(11, 101), StoredBlock(12, 102)]),
+        KvCacheEvent(kind=KV_STORED, worker_id=8, event_id=2,
+                     parent_seq_hash=None, blocks=[StoredBlock(11, 101)]),
+    ]
+    live = RadixTree()
+    for ev in events:
+        rec.record(ev)
+        live.apply_event(ev)
+    await rec.close()
+
+    rebuilt = RadixTree()
+    n = await KvRecorder.replay_into(path, rebuilt)
+    assert n == 2
+    # identical overlap scores from the rebuilt index
+    assert rebuilt.find_matches([101, 102]).scores == \
+        live.find_matches([101, 102]).scores
+    assert set(rebuilt.find_matches([101]).scores) == {(7, 0), (8, 0)} == \
+        set(live.find_matches([101]).scores)
+
+
+async def test_audit_bus_publishes_to_sinks():
+    emitted = []
+
+    class ListSink:
+        name = "list"
+
+        def emit(self, rec):
+            emitted.append(rec)
+
+    bus = AuditBus([ListSink()])
+    for i in range(3):
+        bus.publish(AuditRecord(request_id=f"r{i}", endpoint="chat"))
+    await asyncio.sleep(0.05)
+    await bus.close()
+    assert [r.request_id for r in emitted] == ["r0", "r1", "r2"]
+    assert bus.published == 3 and bus.dropped == 0
+
+
+async def test_audit_env_gating(monkeypatch):
+    monkeypatch.delenv("DYN_AUDIT", raising=False)
+    assert audit_bus_from_env() is None
+    monkeypatch.setenv("DYN_AUDIT", "1")
+    monkeypatch.setenv("DYN_AUDIT_SINKS", "log")
+    bus = audit_bus_from_env()
+    assert bus is not None and bus.sinks[0].name == "log"
+    await bus.close()
+
+
+async def test_audit_e2e_through_frontend(tmp_path):
+    """Chat request with auditing on → a JSONL record with the full
+    response text, usage, and finish reason."""
+    from tests.test_http_frontend import setup_stack, teardown_stack
+
+    path = tmp_path / "audit.jsonl"
+    rt, fe, hs, es = await setup_stack()
+    fe.http.audit = AuditBus([JsonlSink(str(path))])
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": "hi there"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+                full = await r.json()
+        await fe.http.audit.close()
+        fe.http.audit = None
+        recs = [e for _, e in Recorder.iter_events(path)]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["endpoint"] == "chat_completions"
+        assert rec["model"] == "mock-model"
+        assert rec["finish_reason"] in ("length", "stop")
+        assert rec["response_text"] == \
+            full["choices"][0]["message"]["content"]
+        assert rec["usage"]["completion_tokens"] >= 1
+        assert rec["request"]["messages"][0]["content"] == "hi there"
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_stream_perf_capture():
+    async def gen():
+        yield {"token_ids": [1], "text": "a"}
+        await asyncio.sleep(0.02)
+        yield {"token_ids": [2, 3], "text": "bc"}
+        await asyncio.sleep(0.01)
+        yield {"token_ids": [4], "finish_reason": "stop"}
+
+    perf = StreamPerf()
+    items = [i async for i in record_stream(gen(), perf)]
+    assert len(items) == 3              # pass-through untouched
+    s = perf.summary()
+    assert s["total_tokens"] == 4
+    assert s["ttft_s"] >= 0
+    assert s["itl_mean_s"] > 0
+    assert s["tokens_per_sec"] > 0
+    assert len(perf.itls()) == 2
+
+
+async def test_audit_bus_publish_after_close_is_dropped():
+    bus = AuditBus([])
+    bus.publish(AuditRecord(request_id="a", endpoint="chat"))
+    await bus.close()
+    bus.publish(AuditRecord(request_id="b", endpoint="chat"))
+    assert bus.dropped == 1          # counted, no leaked worker task
+    assert bus._task.done()
+
+
+async def test_http_service_does_not_close_injected_bus():
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_manager import ModelManager
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        shared = AuditBus([])
+        svc = HttpService(ModelManager(rt), audit=shared)
+        await svc.start()
+        await svc.stop()
+        assert shared._closed is False    # shared bus left alive
+        await shared.close()
+        svc2 = HttpService(ModelManager(rt))  # env-created (None here)
+        await svc2.start()
+        await svc2.stop()
+    finally:
+        await rt.close()

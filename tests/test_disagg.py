@@ -349,3 +349,27 @@ async def test_kv_pull_emits_chunked_frames():
         assert eng.pool.active_pages == 0    # released on final frame
     finally:
         await eng.close()
+
+
+async def test_kv_pull_detects_reaped_transfer_mid_stream():
+    """A transfer reaped between chunk frames must surface an error, not
+    silently stream freed pages (review: TTL vs chunk pacing)."""
+    eng = make_engine()
+    try:
+        p_req = req(list(range(1, 14)), max_tokens=1)
+        p_req["kv_transfer_params"] = {"do_remote_decode": True}
+        outs = [o async for o in eng.generate(p_req, Context())]
+        ktp = next(o["kv_transfer_params"] for o in outs
+                   if o.get("kv_transfer_params"))
+        h = PrefillWorkerHandler(eng, instance_id=1)
+        gen = h.kv_pull({"transfer_id": ktp["transfer_id"],
+                         "chunk_pages": 1}, Context())
+        first = await gen.__anext__()
+        assert "kv" in first
+        # reaper fires between frames
+        eng.complete_transfer(ktp["transfer_id"])
+        second = await gen.__anext__()
+        assert "expired mid-pull" in second.get("error", "")
+        await gen.aclose()
+    finally:
+        await eng.close()

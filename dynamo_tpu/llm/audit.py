@@ -34,6 +34,8 @@ class AuditRecord:
     finished_at: float = 0.0
     request: Optional[dict] = None  # client body (may be large)
     response_text: str = ""
+    reasoning_text: str = ""
+    tool_calls: list = field(default_factory=list)
     finish_reason: str = ""
     usage: Optional[dict] = None
     error: Optional[str] = None
@@ -75,47 +77,42 @@ class JsonlSink(AuditSink):
 
 
 class AuditBus:
-    """Publish → queue → sink worker. ``publish`` never blocks and never
-    raises; a full queue drops (and counts) rather than stalls."""
+    """Publish → shared BackgroundDrain → sinks, off the event loop
+    (sinks may do blocking I/O). ``publish`` never blocks and never
+    raises; a full/failed/closed drain drops (and counts)."""
 
     def __init__(self, sinks: Optional[list[AuditSink]] = None,
                  capacity: int = 1024) -> None:
+        from dynamo_tpu.runtime.recorder import BackgroundDrain
+
         self.sinks = sinks if sinks is not None else [LogSink()]
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=capacity)
-        self._task: Optional[asyncio.Task] = None
-        self._closed = False
-        self.dropped = 0
-        self.published = 0
+        self._drain = BackgroundDrain(self._emit, max_queue=capacity,
+                                      name="audit-bus")
+
+    def _emit(self, rec: AuditRecord) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(rec)
+            except Exception:
+                logger.exception("audit sink %s failed", sink.name)
 
     def publish(self, rec: AuditRecord) -> None:
-        if self._closed:
-            self.dropped += 1  # late publish after close: count, no leak
-            return
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
-                self._worker())
-        try:
-            self._queue.put_nowait(rec)
-            self.published += 1
-        except asyncio.QueueFull:
-            self.dropped += 1
+        self._drain.put(rec)
 
-    async def _worker(self) -> None:
-        while True:
-            rec = await self._queue.get()
-            if rec is None:
-                return
-            for sink in self.sinks:
-                try:
-                    sink.emit(rec)
-                except Exception:
-                    logger.exception("audit sink %s failed", sink.name)
+    @property
+    def published(self) -> int:
+        return self._drain.count
+
+    @property
+    def dropped(self) -> int:
+        return self._drain.dropped
+
+    @property
+    def _closed(self) -> bool:  # introspection (tests)
+        return self._drain._closed
 
     async def close(self) -> None:
-        self._closed = True
-        if self._task is not None and not self._task.done():
-            await self._queue.put(None)
-            await self._task
+        await self._drain.close()
         for sink in self.sinks:
             close = getattr(sink, "close", None)
             if close is not None:

@@ -57,6 +57,13 @@ class _AuditTap:
                         self.rec.response_text += delta["content"]
                     elif ch.get("text"):
                         self.rec.response_text += ch["text"]
+                    # tool calls are the most audit-sensitive output
+                    # (model-initiated actions) — never drop them
+                    if delta.get("tool_calls"):
+                        self.rec.tool_calls.extend(delta["tool_calls"])
+                    if delta.get("reasoning_content"):
+                        self.rec.reasoning_text += \
+                            delta["reasoning_content"]
                     if ch.get("finish_reason"):
                         self.rec.finish_reason = ch["finish_reason"]
                 if item.get("usage"):

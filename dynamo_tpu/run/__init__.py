@@ -1,0 +1,11 @@
+"""`python -m dynamo_tpu.run` — the single-binary launcher.
+
+Reference: `launch/dynamo-run/` — `dynamo-run in=<input> out=<engine>`
+(`main.rs:29`, `opt.rs:7-72`): one command that wires an input surface
+(http server, interactive stdin, one-shot text, batch file, remote
+endpoint) to an output engine (echo, mocker, the owned TPU engine, or a
+remote dyn:// endpoint), assembling the same preprocessor→backend
+pipeline the production frontend uses.
+"""
+
+from dynamo_tpu.run.main import main  # noqa: F401

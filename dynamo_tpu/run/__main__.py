@@ -1,0 +1,3 @@
+from dynamo_tpu.run.main import main
+
+main()

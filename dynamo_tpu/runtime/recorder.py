@@ -1,9 +1,11 @@
-"""Generic JSONL event recorder + replay.
+"""Generic JSONL event recorder + replay, off the event loop entirely.
 
-Reference: `lib/llm/src/recorder.rs:25-40` — an mpsc-fed background task
-appends ``{"timestamp": ..., "event": ...}`` lines to a JSONL file;
-producers never block on disk. Replay iterates the file, optionally
-re-spacing events by their recorded timestamps.
+Reference: `lib/llm/src/recorder.rs:25-40` — a channel-fed background
+worker appends ``{"timestamp": ..., "event": ...}`` lines to a JSONL
+file; producers never block. Here the drain runs on a REAL thread (not
+an event-loop task): file writes/flushes on a slow disk must not stall
+the serving loop. `BackgroundDrain` is the shared core — the audit bus
+reuses it with a sink-emit consumer instead of a file writer.
 """
 
 from __future__ import annotations
@@ -11,69 +13,149 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import queue as _queue
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterator, Optional
 
 logger = logging.getLogger(__name__)
 
+_SENTINEL = object()
+
+
+class BackgroundDrain:
+    """Bounded queue drained by a daemon thread; put never blocks.
+
+    A consumer that raises permanently marks the drain failed: further
+    puts count as dropped (no respawn storm), and ``close()`` reports
+    what was lost instead of silently discarding the queue."""
+
+    def __init__(self, consume: Callable[[Any], None],
+                 max_queue: int = 4096, name: str = "drain",
+                 flush: Optional[Callable[[], None]] = None,
+                 flush_interval: float = 0.5) -> None:
+        self._consume = consume
+        self._flush = flush
+        self._flush_interval = flush_interval
+        self._queue: _queue.Queue = _queue.Queue(maxsize=max_queue)
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.failed: Optional[str] = None
+        self.count = 0
+        self.dropped = 0
+
+    def put(self, item: Any) -> None:
+        if self._closed or self.failed:
+            self.dropped += 1
+            return
+        self._ensure_thread()
+        try:
+            self._queue.put_nowait(item)
+        except _queue.Full:
+            self.dropped += 1
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name=self._name, daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=self._flush_interval)
+            except _queue.Empty:
+                try:
+                    if self._flush is not None:
+                        self._flush()
+                except Exception as e:
+                    self._fail(e)
+                    return
+                if self._closed:
+                    return
+                continue
+            if item is _SENTINEL:
+                try:
+                    if self._flush is not None:
+                        self._flush()
+                except Exception as e:
+                    self._fail(e)
+                return
+            try:
+                self._consume(item)
+                self.count += 1
+            except Exception as e:
+                self._fail(e)
+                return
+
+    def _fail(self, e: Exception) -> None:
+        self.failed = repr(e)
+        # everything still queued is lost: account for it
+        lost = self._queue.qsize()
+        self.dropped += lost
+        logger.error("%s: consumer failed (%s); %d queued item(s) lost, "
+                     "further items dropped", self._name, self.failed, lost)
+
+    async def close(self) -> None:
+        """Drain remaining items, stop the thread. Safe to call twice."""
+        if self._closed:
+            return
+        self._closed = True
+        t = self._thread
+        if t is not None and t.is_alive():
+            try:
+                self._queue.put_nowait(_SENTINEL)
+            except _queue.Full:
+                pass  # consumer failed with a full queue; thread exits
+            await asyncio.to_thread(t.join, 10.0)
+
 
 class Recorder:
-    """Append-only JSONL recorder with an off-hot-path writer task."""
+    """Append-only JSONL recorder on a BackgroundDrain."""
 
     def __init__(self, path: str | Path, flush_interval: float = 0.5,
                  max_queue: int = 4096) -> None:
         self.path = Path(path)
-        self.flush_interval = flush_interval
-        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
-        self._task: Optional[asyncio.Task] = None
-        self._closed = False
-        self.event_count = 0
-        self.dropped = 0
-        self.first_event_at: Optional[float] = None
+        self._file = None
+        self._drain = BackgroundDrain(
+            self._write, max_queue=max_queue,
+            name=f"recorder:{self.path.name}",
+            flush=self._do_flush, flush_interval=flush_interval)
+
+    def _write(self, item: dict) -> None:
+        if self._file is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._file = self.path.open("a", encoding="utf-8")
+        self._file.write(json.dumps(item, separators=(",", ":")) + "\n")
+
+    def _do_flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
 
     def record(self, event: Any) -> None:
-        """Non-blocking enqueue; drops (and counts) when the writer can't
-        keep up — recording must never stall the serving path."""
-        if self._closed:
-            return
-        if self.first_event_at is None:
-            self.first_event_at = time.time()
-        self._ensure_task()
-        try:
-            self._queue.put_nowait({"timestamp": time.time(),
-                                    "event": event})
-        except asyncio.QueueFull:
-            self.dropped += 1
+        """Non-blocking; drops (and counts) when the writer can't keep
+        up or has failed — recording must never stall serving."""
+        self._drain.put({"timestamp": time.time(), "event": event})
 
-    def _ensure_task(self) -> None:
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
-                self._writer())
+    @property
+    def event_count(self) -> int:
+        return self._drain.count
 
-    async def _writer(self) -> None:
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        with self.path.open("a", encoding="utf-8") as f:
-            while True:
-                try:
-                    item = await asyncio.wait_for(self._queue.get(),
-                                                  self.flush_interval)
-                except asyncio.TimeoutError:
-                    f.flush()
-                    if self._closed:
-                        return
-                    continue
-                if item is None:
-                    f.flush()
-                    return
-                f.write(json.dumps(item, separators=(",", ":")) + "\n")
-                self.event_count += 1
+    @property
+    def dropped(self) -> int:
+        return self._drain.dropped
+
+    @property
+    def failed(self) -> Optional[str]:
+        return self._drain.failed
 
     async def close(self) -> None:
-        self._closed = True
-        if self._task is not None and not self._task.done():
-            await self._queue.put(None)
-            await self._task
+        await self._drain.close()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
 
     # -- replay --------------------------------------------------------------
 

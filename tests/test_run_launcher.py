@@ -1,0 +1,138 @@
+"""`python -m dynamo_tpu.run` launcher (dynamo-run analog).
+
+Reference: `launch/dynamo-run/src/{main,opt}.rs` — in=/out= pairs.
+Real CLI subprocesses for text/batch/http; in-proc for dyn:// routing.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ENV = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+
+
+def run_cli(*args, input=None, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.run", *args],
+        env=ENV, input=input, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_parse_io():
+    from dynamo_tpu.run.main import parse_io
+
+    inp, out, rest = parse_io(["in=text:hi", "out=echo", "--port", "1"])
+    assert (inp, out, rest) == ("text:hi", "echo", ["--port", "1"])
+    assert parse_io([])[:2] == ("stdin", "echo")
+
+
+def test_text_in_echo_out():
+    r = run_cli("in=text:hello world", "out=echo", "--max-tokens", "8")
+    assert r.returncode == 0, r.stderr
+    # echo engine: the prompt comes back
+    assert "hello" in r.stdout and "world" in r.stdout
+
+
+def test_stdin_in_echo_out():
+    r = run_cli("in=stdin", "out=echo", input="repeat this\n")
+    assert r.returncode == 0, r.stderr
+    assert "repeat" in r.stdout
+
+
+def test_batch_in_mocker_out(tmp_path):
+    batch = tmp_path / "in.jsonl"
+    outp = tmp_path / "out.jsonl"
+    batch.write_text(
+        json.dumps({"text": "first prompt", "max_tokens": 4}) + "\n"
+        + json.dumps({"messages": [{"role": "user", "content": "second"}],
+                      "max_tokens": 3}) + "\n")
+    r = run_cli(f"in=batch:{batch}", "out=mocker",
+                "--batch-output", str(outp))
+    assert r.returncode == 0, r.stderr
+    assert "BATCH_DONE 2" in r.stderr
+    rows = [json.loads(l) for l in outp.read_text().splitlines()]
+    assert [row["index"] for row in rows] == [0, 1]
+    assert all(row["text"] for row in rows)
+    assert all(row["finish_reason"] in ("length", "stop") for row in rows)
+
+
+def test_http_in_mocker_out():
+    import urllib.request
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.run", "in=http", "out=mocker",
+         "--port", "0", "--model-name", "runm"],
+        env=ENV, stdout=subprocess.PIPE, text=True)
+    try:
+        import time
+        url = None
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            line = proc.stdout.readline()
+            if line.startswith("RUN_READY"):
+                url = line.split()[1]
+                break
+        assert url, "launcher never became ready"
+        t0 = time.time()
+        while time.time() - t0 < 10:
+            models = json.load(urllib.request.urlopen(f"{url}/v1/models"))
+            if any(m["id"] == "runm" for m in models["data"]):
+                break
+            time.sleep(0.2)
+        body = json.dumps({"model": "runm", "max_tokens": 4,
+                           "messages": [{"role": "user",
+                                         "content": "ping"}]}).encode()
+        req = urllib.request.Request(
+            f"{url}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = json.load(urllib.request.urlopen(req))
+        assert resp["choices"][0]["message"]["content"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+async def test_dyn_remote_out():
+    """out=dyn://ns.comp.generate routes through live instances."""
+    from dynamo_tpu.llm.entrypoint import serve_engine
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.run.main import (
+        build_pipeline_for,
+        connect_remote,
+        parse_args,
+        run_one,
+    )
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    eng = MockEngine(MockEngineConfig(speedup=100.0,
+                                      default_max_tokens=8))
+    card = ModelDeploymentCard(name="remm", namespace="ns",
+                               component="w", tokenizer_kind="word",
+                               tokenizer_path="remm")
+    handle = await serve_engine(rt, eng, card)
+    try:
+        args = parse_args([])
+        router, rcard = await connect_remote("dyn://ns.w.generate", args,
+                                             rt)
+        assert rcard.name == "remm"          # resolved from published MDC
+        pipeline = build_pipeline_for(rcard, router, args)
+        text = await run_one(pipeline, rcard.name, "route me", 6)
+        assert text                         # tokens streamed back
+    finally:
+        await handle.stop()
+        await eng.close()
+        await rt.close()
+
+
+def test_bad_in_out_rejected():
+    r = run_cli("in=nope", "out=echo")
+    assert r.returncode != 0
+    r = run_cli("in=text:x", "out=wat")
+    assert r.returncode != 0

@@ -1,0 +1,62 @@
+"""Native (C++) hot-path components, loaded via ctypes.
+
+The reference keeps its router/index/codec hot loops native (Rust); the
+TPU build mirrors that split: JAX/XLA owns the device compute path, and
+the host-side hot loops that bound router QPS live here. Each component
+builds on demand with the system toolchain (g++ -O3 -shared) into
+``_build/`` and falls back to the pure-Python implementation when no
+compiler is available — behavior is identical either way (randomized
+differential tests enforce it).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+_lock = threading.Lock()
+_lib_cache: dict[str, object] = {}
+
+
+def build_and_load(name: str):
+    """Compile ``<name>.cpp`` (cached by source mtime) and dlopen it.
+    Returns the ctypes CDLL, or None when building isn't possible."""
+    import ctypes
+
+    with _lock:
+        if name in _lib_cache:
+            return _lib_cache[name]
+        src = _DIR / f"{name}.cpp"
+        so = _BUILD / f"lib{name}.so"
+        try:
+            if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+                _BUILD.mkdir(exist_ok=True)
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                       str(src), "-o", str(so)]
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=120)
+                if proc.returncode != 0:
+                    logger.warning("native build failed for %s: %s", name,
+                                   proc.stderr[-500:])
+                    _lib_cache[name] = None
+                    return None
+            lib = ctypes.CDLL(str(so))
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native %s unavailable: %r", name, e)
+            _lib_cache[name] = None
+            return None
+        _lib_cache[name] = lib
+        return lib
+
+
+def native_enabled() -> bool:
+    return os.environ.get("DYN_NATIVE", "1").lower() not in (
+        "0", "false", "no")

@@ -195,9 +195,15 @@ class KvIndexer:
     (kv_router.py) — so the hot query path has no task hops.
     """
 
-    def __init__(self, block_size: int) -> None:
+    def __init__(self, block_size: int,
+                 use_native: Optional[bool] = None) -> None:
+        from dynamo_tpu.native.radix import make_radix_tree
+
         self.block_size = block_size
-        self.tree = RadixTree()
+        # native C++ tree when built (DYN_NATIVE=0 disables); identical
+        # semantics enforced by the differential tests
+        self.tree = RadixTree() if use_native is False \
+            else make_radix_tree()
         self.events_applied = 0
 
     def apply_event(self, ev: KvCacheEvent) -> None:

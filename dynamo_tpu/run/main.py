@@ -60,7 +60,9 @@ def parse_args(rest: list[str]) -> argparse.Namespace:
                    help="override the card's tokenizer (checkpoints "
                         "without tokenizer files: use word/byte)")
     p.add_argument("--router-mode", default="round_robin",
-                   choices=["kv", "round_robin", "random"])
+                   choices=["round_robin", "random"],
+                   help="dyn:// routing; KV-aware routing needs the full "
+                        "frontend (python -m dynamo_tpu.frontend)")
     return p.parse_args(rest)
 
 
@@ -220,6 +222,10 @@ async def amain(inp: str, out: str, args) -> None:
     cfg = runtime_config_from_args(args)
     if not remote:
         cfg.store_url = "memory"  # fully local run
+    if inp == "http" and remote:
+        raise SystemExit(
+            "in=http out=dyn:// — run python -m dynamo_tpu.frontend "
+            "against the shared store instead")
     runtime = await DistributedRuntime.create(cfg)
     engine_handle = None
     try:
@@ -240,10 +246,6 @@ async def amain(inp: str, out: str, args) -> None:
         if inp == "http":
             from dynamo_tpu.llm.entrypoint import start_frontend
 
-            if remote:
-                raise SystemExit(
-                    "in=http out=dyn:// — run python -m "
-                    "dynamo_tpu.frontend against the shared store instead")
             fe = await start_frontend(runtime, host=args.host,
                                       port=args.port)
             print(f"RUN_READY {fe.url}", flush=True)
@@ -263,10 +265,22 @@ async def amain(inp: str, out: str, args) -> None:
                                 args.max_tokens, args.batch_output)
             print(f"BATCH_DONE {n}", file=sys.stderr, flush=True)
         elif inp == "stdin":
+            import threading
+
+            # a DAEMON reader thread: run_in_executor's worker would pin
+            # interpreter shutdown on a blocked readline after Ctrl-C
             loop = asyncio.get_running_loop()
+            lines: asyncio.Queue = asyncio.Queue()
+
+            def reader():
+                for line in sys.stdin:
+                    loop.call_soon_threadsafe(lines.put_nowait, line)
+                loop.call_soon_threadsafe(lines.put_nowait, None)
+
+            threading.Thread(target=reader, daemon=True).start()
             while True:
-                line = await loop.run_in_executor(None, sys.stdin.readline)
-                if not line:
+                line = await lines.get()
+                if line is None:
                     break
                 prompt = line.strip()
                 if not prompt:

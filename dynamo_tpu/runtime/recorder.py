@@ -153,6 +153,14 @@ class Recorder:
 
     async def close(self) -> None:
         await self._drain.close()
+        t = self._drain._thread
+        if t is not None and t.is_alive():
+            # drain wedged on a hung disk: closing the shared handle out
+            # from under the writer thread would turn a stall into data
+            # loss; leak the handle instead and say so
+            logger.error("recorder %s: writer still busy after close "
+                         "timeout; leaving file open", self.path)
+            return
         if self._file is not None:
             self._file.close()
             self._file = None

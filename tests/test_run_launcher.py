@@ -12,7 +12,8 @@ import sys
 
 import pytest
 
-ENV = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
 
 
 def run_cli(*args, input=None, timeout=60):

@@ -353,6 +353,8 @@ class HttpService:
         request_id = new_request_id(
             "chatcmpl" if kind == KIND_CHAT else "cmpl")
         ctx = Context(request_id=request_id)
+        from dynamo_tpu.runtime.tracing import tracer
+
         pipeline_request = {"_kind": kind, "body": body,
                             "request_id": request_id}
         audit_rec = self._audit_begin(request_id, endpoint, body)
@@ -362,6 +364,16 @@ class HttpService:
             engine = _AuditTap(engine, audit_rec, self.audit)
         start = time.perf_counter()
         self._inflight.add(1)
+        # request span (make_request_span analog): honors an incoming W3C
+        # traceparent header; the span is current for this handler task,
+        # so downstream transport hops inherit the trace; entered right
+        # at the try so no exception path can leak it as current
+        span = tracer().start_span(
+            f"http {endpoint}",
+            traceparent=request.headers.get("traceparent"),
+            attributes={"http.target": request.path,
+                        "request.id": request_id, "model": model})
+        span.__enter__()
         try:
             chunks = engine.generate(pipeline_request, ctx)
             if stream:
@@ -383,7 +395,11 @@ class HttpService:
             self._duration.observe(time.perf_counter() - start)
             self._observe_usage(full.get("usage"))
             return web.json_response(full)
+        except BaseException as e:
+            span.record_error(e)
+            raise
         finally:
+            span.end(_reset=True)
             self._inflight.add(-1)
 
     async def _stream_sse(self, request: web.Request, endpoint: str,

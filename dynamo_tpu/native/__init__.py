@@ -39,8 +39,12 @@ def build_and_load(name: str):
         try:
             if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
                 _BUILD.mkdir(exist_ok=True)
+                # build to a per-process temp then rename: concurrent
+                # cold-starting processes must never dlopen a half-
+                # written .so (rename is atomic on the same fs)
+                tmp = so.with_suffix(f".{os.getpid()}.tmp")
                 cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                       str(src), "-o", str(so)]
+                       str(src), "-o", str(tmp)]
                 proc = subprocess.run(cmd, capture_output=True, text=True,
                                       timeout=120)
                 if proc.returncode != 0:
@@ -48,6 +52,7 @@ def build_and_load(name: str):
                                    proc.stderr[-500:])
                     _lib_cache[name] = None
                     return None
+                os.replace(tmp, so)
             lib = ctypes.CDLL(str(so))
         except (OSError, subprocess.SubprocessError) as e:
             logger.warning("native %s unavailable: %r", name, e)

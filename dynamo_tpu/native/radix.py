@@ -60,16 +60,46 @@ def _load():
 
 _lib = None
 _lib_tried = False
+_bg_build = None
 
 
 def native_radix_available() -> bool:
-    global _lib, _lib_tried
-    if not _lib_tried:
-        _lib_tried = True
-        from dynamo_tpu.native import native_enabled
+    """True once the native lib is loaded. The first call may COMPILE
+    (g++, seconds): from sync code that happens inline; from inside a
+    running event loop it is pushed to a background thread and this call
+    reports False — callers fall back to the Python tree now and get the
+    native one on the next construction (a cold-start frontend must not
+    stall every in-flight request for a compile)."""
+    global _lib, _lib_tried, _bg_build
+    if _lib_tried:
+        return _lib is not None
+    from dynamo_tpu.native import native_enabled
 
-        _lib = _load() if native_enabled() else None
-    return _lib is not None
+    if not native_enabled():
+        _lib_tried = True
+        return False
+
+    import asyncio
+    import threading
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        _lib = _load()  # no loop: safe to compile inline
+        _lib_tried = True
+        return _lib is not None
+    # inside a loop: compile off-thread, once
+    if _bg_build is None or not _bg_build.is_alive():
+        def build():
+            global _lib, _lib_tried
+            _lib = _load()
+            _lib_tried = True
+
+        _bg_build = threading.Thread(target=build, daemon=True,
+                                     name="radix-build")
+        _bg_build.start()
+        _bg_build.join(timeout=0.05)  # cached .so loads instantly
+    return _lib_tried and _lib is not None
 
 
 class CRadixTree:

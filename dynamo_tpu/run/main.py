@@ -268,14 +268,21 @@ async def amain(inp: str, out: str, args) -> None:
             import threading
 
             # a DAEMON reader thread: run_in_executor's worker would pin
-            # interpreter shutdown on a blocked readline after Ctrl-C
+            # interpreter shutdown on a blocked readline after Ctrl-C.
+            # Bounded queue + blocking put = backpressure (a piped file
+            # must not slurp into memory while generations run 1-by-1).
             loop = asyncio.get_running_loop()
-            lines: asyncio.Queue = asyncio.Queue()
+            lines: asyncio.Queue = asyncio.Queue(maxsize=64)
 
             def reader():
-                for line in sys.stdin:
-                    loop.call_soon_threadsafe(lines.put_nowait, line)
-                loop.call_soon_threadsafe(lines.put_nowait, None)
+                try:
+                    for line in sys.stdin:
+                        asyncio.run_coroutine_threadsafe(
+                            lines.put(line), loop).result()
+                    asyncio.run_coroutine_threadsafe(
+                        lines.put(None), loop).result()
+                except RuntimeError:
+                    pass  # loop closed mid-read: just exit the thread
 
             threading.Thread(target=reader, daemon=True).start()
             while True:

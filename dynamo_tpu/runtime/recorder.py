@@ -98,10 +98,12 @@ class BackgroundDrain:
         logger.error("%s: consumer failed (%s); %d queued item(s) lost, "
                      "further items dropped", self._name, self.failed, lost)
 
-    async def close(self) -> None:
-        """Drain remaining items, stop the thread. Safe to call twice."""
+    async def close(self) -> bool:
+        """Drain remaining items, stop the thread. Safe to call twice.
+        Returns True when the drain thread has actually exited."""
         if self._closed:
-            return
+            t = self._thread
+            return t is None or not t.is_alive()
         self._closed = True
         t = self._thread
         if t is not None and t.is_alive():
@@ -110,6 +112,7 @@ class BackgroundDrain:
             except _queue.Full:
                 pass  # consumer failed with a full queue; thread exits
             await asyncio.to_thread(t.join, 10.0)
+        return t is None or not t.is_alive()
 
 
 class Recorder:
@@ -152,9 +155,7 @@ class Recorder:
         return self._drain.failed
 
     async def close(self) -> None:
-        await self._drain.close()
-        t = self._drain._thread
-        if t is not None and t.is_alive():
+        if not await self._drain.close():
             # drain wedged on a hung disk: closing the shared handle out
             # from under the writer thread would turn a stall into data
             # loss; leak the handle instead and say so

@@ -1,0 +1,184 @@
+"""Distributed tracing: request spans + W3C propagation + OTLP-file export.
+
+Reference: `lib/runtime/src/logging.rs:72-106` — tracing spans with
+OpenTelemetry export and W3C `traceparent` context propagation; HTTP
+requests wrapped in `make_request_span` (`http/service/service_v2.rs:21`);
+span context rides every network hop so a request is one trace across
+frontend → router → worker.
+
+This build has zero egress, so the exporter writes OTLP-shaped span JSON
+to a local JSONL file (the Tempo-compose analog is a file tail) via the
+shared off-loop BackgroundDrain. The current span lives in a contextvar —
+asyncio tasks inherit it, so nesting works without threading span objects
+through every call. Env: ``DYN_TRACE=1`` enables, ``DYN_TRACE_PATH``
+(default trace.jsonl) targets the file.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.recorder import Recorder
+
+TRACEPARENT = "traceparent"
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("dyn_current_span", default=None)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str                   # 32 hex
+    span_id: str                    # 16 hex
+    parent_span_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status: str = "OK"
+    _tracer: Optional["Tracer"] = None
+    _token: Optional[contextvars.Token] = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def record_error(self, err: BaseException) -> None:
+        self.status = "ERROR"
+        self.attributes["error"] = repr(err)
+
+    def traceparent(self) -> str:
+        """W3C: 00-<trace_id>-<span_id>-01."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    # -- context manager -----------------------------------------------------
+
+    def __enter__(self) -> "Span":
+        self.start_ns = self.start_ns or time.time_ns()
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.record_error(exc)
+        self.end(_reset=True)
+
+    def end(self, _reset: bool = False) -> None:
+        if self.end_ns:
+            return
+        self.end_ns = time.time_ns()
+        if _reset and self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
+        if self._tracer is not None:
+            self._tracer._export(self)
+
+    def to_otlp(self) -> dict:
+        """One OTLP-ish span record (resourceSpans flattening omitted —
+        a converter can lift these 1:1 into a real OTLP payload)."""
+        return {
+            "traceId": self.trace_id, "spanId": self.span_id,
+            "parentSpanId": self.parent_span_id or "",
+            "name": self.name,
+            "startTimeUnixNano": self.start_ns,
+            "endTimeUnixNano": self.end_ns,
+            "attributes": [{"key": k, "value": {"stringValue": str(v)}}
+                           for k, v in self.attributes.items()],
+            "status": {"code": self.status},
+        }
+
+
+def parse_traceparent(tp: str) -> Optional[tuple[str, str]]:
+    """(trace_id, parent_span_id) from a W3C traceparent, else None."""
+    try:
+        version, trace_id, span_id, _flags = tp.strip().split("-")
+    except ValueError:
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16 or version == "ff":
+        return None
+    return trace_id, span_id
+
+
+class Tracer:
+    """Span factory + JSONL exporter. Disabled tracers hand out spans
+    that never export (zero file I/O) so call sites stay unconditional."""
+
+    def __init__(self, enabled: bool = True,
+                 path: Optional[str] = None,
+                 service: str = "dynamo_tpu") -> None:
+        self.enabled = enabled
+        self.service = service
+        self._recorder = Recorder(path or "trace.jsonl") if enabled \
+            else None
+        self.exported = 0
+
+    def start_span(self, name: str,
+                   traceparent: Optional[str] = None,
+                   attributes: Optional[dict] = None) -> Span:
+        """Child of (in priority order) the explicit traceparent, the
+        contextvar's current span, or a fresh root."""
+        parent_trace = parent_span = None
+        if traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                parent_trace, parent_span = parsed
+        if parent_trace is None:
+            cur = _current_span.get()
+            if cur is not None:
+                parent_trace, parent_span = cur.trace_id, cur.span_id
+        span = Span(
+            name=name,
+            trace_id=parent_trace or secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            parent_span_id=parent_span,
+            start_ns=time.time_ns(),
+            attributes={"service.name": self.service,
+                        **(attributes or {})},
+            _tracer=self if self.enabled else None)
+        return span
+
+    def _export(self, span: Span) -> None:
+        if self._recorder is not None:
+            self._recorder.record(span.to_otlp())
+            self.exported += 1
+
+    async def close(self) -> None:
+        if self._recorder is not None:
+            await self._recorder.close()
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def inject_headers(headers: dict) -> dict:
+    """Put the current span's traceparent into a headers dict (W3C)."""
+    cur = _current_span.get()
+    if cur is not None:
+        headers[TRACEPARENT] = cur.traceparent()
+    return headers
+
+
+_global: Optional[Tracer] = None
+
+
+def tracer() -> Tracer:
+    """Process tracer, env-configured once (logging.rs init analog)."""
+    global _global
+    if _global is None:
+        enabled = os.environ.get("DYN_TRACE", "").lower() in (
+            "1", "true", "yes")
+        _global = Tracer(enabled=enabled,
+                         path=os.environ.get("DYN_TRACE_PATH",
+                                             "trace.jsonl"))
+    return _global
+
+
+def set_tracer(t: Optional[Tracer]) -> None:
+    """Override the process tracer (tests / embedders)."""
+    global _global
+    _global = t

@@ -88,6 +88,8 @@ class TransportServer:
 
         async def run_request(rid: str, subject: str, payload: Any,
                               headers: dict) -> None:
+            from dynamo_tpu.runtime.tracing import TRACEPARENT, tracer
+
             ctx = inflight[rid][1]
             try:
                 engine = self._handlers.get(subject)
@@ -95,8 +97,19 @@ class TransportServer:
                     await send({"t": "err", "rid": rid,
                                 "error": f"no such endpoint: {subject}"})
                     return
-                async for item in engine.generate(payload, ctx):
-                    await send({"t": "data", "rid": rid, "payload": item})
+                # server span: the request's trace continues across the
+                # wire via the traceparent header (logging.rs W3C prop)
+                with tracer().start_span(
+                        f"serve {subject}",
+                        traceparent=headers.get(TRACEPARENT),
+                        attributes={"rpc.subject": subject,
+                                    "request.id": rid}) as span:
+                    n = 0
+                    async for item in engine.generate(payload, ctx):
+                        await send({"t": "data", "rid": rid,
+                                    "payload": item})
+                        n += 1
+                    span.set_attribute("response.items", n)
                 await send({"t": "end", "rid": rid})
             except asyncio.CancelledError:
                 if not ctx.is_cancelled():  # server shutdown, not user cancel
@@ -230,6 +243,8 @@ class TransportClient:
         Raises ConnectionError(STREAM_ERR_MSG) if the stream dies mid-way —
         the signal the Migration operator retries on.
         """
+        from dynamo_tpu.runtime.tracing import inject_headers
+
         ctx = context or Context()
         conn = await self._conn(address)
         rid = f"{ctx.request_id}.{next(self._rids)}"
@@ -237,7 +252,8 @@ class TransportClient:
         try:
             q = conn.open_stream(rid)
             await conn.send({"t": "req", "rid": rid, "subject": subject,
-                             "payload": payload, "headers": ctx.headers})
+                             "payload": payload,
+                             "headers": inject_headers(dict(ctx.headers))})
 
             async def watch_cancel() -> None:
                 await ctx.wait_cancelled()

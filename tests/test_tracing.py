@@ -1,0 +1,159 @@
+"""Tracing: span export, W3C propagation, cross-hop trace continuity.
+
+Reference: `lib/runtime/src/logging.rs:72-106` (OTLP + W3C propagation),
+`http/service/service_v2.rs:21` (request spans). Asserts one trace id
+spans frontend → transport → worker across a REAL TCP hop.
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.runtime.recorder import Recorder
+from dynamo_tpu.runtime.tracing import (
+    Span,
+    Tracer,
+    current_span,
+    parse_traceparent,
+    set_tracer,
+    tracer,
+)
+
+
+def test_traceparent_roundtrip():
+    t = Tracer(enabled=False)
+    s = t.start_span("x")
+    tp = s.traceparent()
+    assert parse_traceparent(tp) == (s.trace_id, s.span_id)
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-short-abc-01") is None
+
+
+def test_span_nesting_via_contextvar():
+    t = Tracer(enabled=False)
+    with t.start_span("parent") as p:
+        assert current_span() is p
+        with t.start_span("child") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_span_id == p.span_id
+        assert current_span() is p
+    assert current_span() is None
+
+
+def test_explicit_traceparent_wins():
+    t = Tracer(enabled=False)
+    with t.start_span("other"):
+        s = t.start_span("x", traceparent="00-" + "a" * 32 + "-"
+                                          + "b" * 16 + "-01")
+        assert s.trace_id == "a" * 32
+        assert s.parent_span_id == "b" * 16
+
+
+async def test_export_otlp_jsonl(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    with t.start_span("op", attributes={"k": "v"}) as s:
+        s.set_attribute("n", 3)
+    await t.close()
+    rows = [e for _, e in Recorder.iter_events(path)]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["name"] == "op"
+    assert row["traceId"] == s.trace_id and row["spanId"] == s.span_id
+    assert row["endTimeUnixNano"] >= row["startTimeUnixNano"] > 0
+    keys = {a["key"]: a["value"]["stringValue"] for a in row["attributes"]}
+    assert keys["k"] == "v" and keys["n"] == "3"
+    assert row["status"]["code"] == "OK"
+
+
+async def test_error_status_recorded(tmp_path):
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    try:
+        with t.start_span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    await t.close()
+    row = next(e for _, e in Recorder.iter_events(path))
+    assert row["status"]["code"] == "ERROR"
+
+
+async def test_trace_continuity_across_transport_hop(tmp_path):
+    """frontend span → TCP transport → worker server span: ONE trace id,
+    correct parentage, across two runtimes over a real socket."""
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.push import PushRouter
+
+    path = tmp_path / "trace.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    set_tracer(t)
+    # separate runtimes so the request crosses a REAL TCP connection
+    rt_srv = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory"))
+    rt_cli = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory"))
+    try:
+        async def handler(req, ctx):
+            yield {"pong": True}
+
+        ep = rt_srv.namespace("ns").component("c").endpoint("e")
+        served = await ep.serve(handler, instance_id=1)
+        inst = served.instance
+        client = await rt_cli.namespace("ns").component("c").endpoint(
+            "e").client(static_instances=[inst])
+        await client.start()
+        # route around the in-proc fast path: call the transport client
+        # directly at the instance's address
+        with t.start_span("client request") as root:
+            items = [x async for x in rt_cli.transport_client.request(
+                inst.address, inst.subject, {"q": 1}, Context())]
+        assert items == [{"pong": True}]
+        await client.stop()
+    finally:
+        set_tracer(None)
+        await rt_cli.close()
+        await rt_srv.close()
+    await t.close()
+    rows = [e for _, e in Recorder.iter_events(path)]
+    by_name = {r["name"]: r for r in rows}
+    serve = by_name[f"serve {inst.subject}"]
+    client_span = by_name["client request"]
+    assert serve["traceId"] == client_span["traceId"] == root.trace_id
+    assert serve["parentSpanId"] == client_span["spanId"]
+
+
+async def test_http_request_span_with_incoming_traceparent(tmp_path):
+    from tests.test_http_frontend import setup_stack, teardown_stack
+
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    set_tracer(t)
+    rt, fe, hs, es = await setup_stack()
+    try:
+        incoming = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{fe.url}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 3,
+                          "messages": [{"role": "user", "content": "hi"}]},
+                    headers={"traceparent": incoming}) as r:
+                assert r.status == 200
+    finally:
+        set_tracer(None)
+        await teardown_stack(rt, fe, hs, es)
+    await t.close()
+    rows = [e for _, e in Recorder.iter_events(path)]
+    http_span = next(r for r in rows if r["name"].startswith("http "))
+    assert http_span["traceId"] == "c" * 32       # continued, not new
+    assert http_span["parentSpanId"] == "d" * 16
+
+
+def test_disabled_tracer_is_free():
+    t = Tracer(enabled=False)
+    with t.start_span("noop") as s:
+        pass
+    assert s.end_ns > 0
+    assert t.exported == 0 and t._recorder is None

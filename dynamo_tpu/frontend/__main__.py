@@ -39,6 +39,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tls-cert-path", default=None,
                    help="PEM certificate; with --tls-key-path serves HTTPS")
     p.add_argument("--tls-key-path", default=None)
+    p.add_argument("--grpc-port", type=int, default=None,
+                   help="also serve the KServe-v2 gRPC frontend here")
     return p.parse_args(argv)
 
 
@@ -63,7 +65,8 @@ def main(argv=None) -> None:
                                   router_mode_override=args.router_mode,
                                   namespace=args.namespace,
                                   tls_cert=args.tls_cert_path,
-                                  tls_key=args.tls_key_path)
+                                  tls_key=args.tls_key_path,
+                                  grpc_port=args.grpc_port)
         print(f"FRONTEND_READY {fe.url}", flush=True)
         return rt, fe
 

@@ -1,0 +1,65 @@
+"""KServe-v2 gRPC frontend (the reference's `lib/llm/src/grpc/` analog).
+
+Message classes are protoc-generated on demand (same lazy-build pattern
+as `dynamo_tpu/native`): ``protoc --python_out`` into ``_gen/``; the
+service itself is wired with grpc.aio generic handlers, so no grpc
+codegen plugin is needed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_DIR = Path(__file__).parent
+_GEN = _DIR / "_gen"
+_lock = threading.Lock()
+_pb2 = None
+_pb2_failed = False
+
+
+def kserve_pb2():
+    """The generated kserve_v2_pb2 module (compiled + cached), or None
+    when protoc/protobuf are unavailable."""
+    global _pb2, _pb2_failed
+    with _lock:
+        if _pb2 is not None or _pb2_failed:
+            return _pb2
+        src = _DIR / "kserve_v2.proto"
+        out = _GEN / "kserve_v2_pb2.py"
+        try:
+            if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+                _GEN.mkdir(exist_ok=True)
+                (_GEN / "__init__.py").touch()
+                proc = subprocess.run(
+                    ["protoc", f"--proto_path={_DIR}",
+                     f"--python_out={_GEN}", str(src)],
+                    capture_output=True, text=True, timeout=60)
+                if proc.returncode != 0:
+                    logger.warning("protoc failed: %s", proc.stderr[-400:])
+                    _pb2_failed = True
+                    return None
+            if str(_GEN) not in sys.path:
+                sys.path.insert(0, str(_GEN))
+            import kserve_v2_pb2  # noqa: E402
+
+            _pb2 = kserve_v2_pb2
+        except Exception as e:
+            logger.warning("kserve pb2 unavailable: %r", e)
+            _pb2_failed = True
+            return None
+        return _pb2
+
+
+def grpc_available() -> bool:
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        return False
+    return kserve_pb2() is not None

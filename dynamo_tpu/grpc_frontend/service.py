@@ -1,0 +1,225 @@
+"""KServe-v2 gRPC inference service over the discovery-driven pipelines.
+
+Reference: `lib/llm/src/grpc/service/kserve.rs` — ModelInfer treats the
+model as an OpenAI completions model: a "text_input" BYTES tensor is the
+prompt, sampling knobs ride the request `parameters` map, and the folded
+completion comes back as a "text_output" BYTES tensor (:188-260,449).
+ModelStreamInfer streams one response per text delta. Health/metadata
+answer from the ModelManager's live card set.
+
+Wired with `grpc.aio` generic handlers + protoc-generated messages (no
+grpc codegen plugin in this image).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from dynamo_tpu.llm.preprocessor import KIND_COMPLETION
+from dynamo_tpu.llm.protocols_openai import OpenAIError
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "inference.GRPCInferenceService"
+
+
+def _completion_body(pb, req) -> dict:
+    """ModelInferRequest → OpenAI completion body (kserve.rs TryFrom)."""
+    prompt: Optional[str] = None
+    for t in req.inputs:
+        if t.name == "text_input" and t.contents.bytes_contents:
+            prompt = t.contents.bytes_contents[0].decode("utf-8", "replace")
+    if prompt is None and req.raw_input_contents:
+        # raw binding: length-prefixed bytes per KServe raw convention;
+        # accept plain utf-8 too
+        raw = req.raw_input_contents[0]
+        if len(raw) >= 4:
+            n = int.from_bytes(raw[:4], "little")
+            prompt = (raw[4:4 + n] if 4 + n <= len(raw) else raw).decode(
+                "utf-8", "replace")
+        else:
+            prompt = raw.decode("utf-8", "replace")
+    if prompt is None:
+        raise OpenAIError("missing 'text_input' BYTES tensor")
+    body: dict = {"model": req.model_name, "prompt": prompt}
+    for key, p in req.parameters.items():
+        which = p.WhichOneof("parameter_choice")
+        val = getattr(p, which) if which else None
+        if key in ("max_tokens", "min_tokens", "top_k", "seed", "n"):
+            body[key] = int(val)
+        elif key in ("temperature", "top_p", "min_p",
+                     "frequency_penalty", "presence_penalty"):
+            body[key] = float(val)
+        elif key == "stop":
+            body[key] = str(val)
+        elif key == "ignore_eos":
+            body[key] = bool(val)
+    return body
+
+
+def _text_response(pb, model: str, rid: str, text: str,
+                   finish_reason: str = ""):
+    resp = pb.ModelInferResponse(model_name=model, id=rid)
+    out = resp.outputs.add()
+    out.name = "text_output"
+    out.datatype = "BYTES"
+    out.shape.append(1)
+    out.contents.bytes_contents.append(text.encode())
+    if finish_reason:
+        resp.parameters["finish_reason"].string_param = finish_reason
+    return resp
+
+
+class KserveGrpcService:
+    def __init__(self, manager, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self._server = None
+
+    # -- handlers ------------------------------------------------------------
+
+    async def server_live(self, request, context):
+        pb = self._pb
+        return pb.ServerLiveResponse(live=True)
+
+    async def server_ready(self, request, context):
+        pb = self._pb
+        return pb.ServerReadyResponse(
+            ready=bool(self.manager.model_names()))
+
+    async def model_ready(self, request, context):
+        pb = self._pb
+        return pb.ModelReadyResponse(
+            ready=self.manager.engine_for(request.name) is not None)
+
+    async def model_metadata(self, request, context):
+        import grpc
+
+        pb = self._pb
+        entry = self.manager.get(request.name)
+        if entry is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {request.name!r} not found")
+        resp = pb.ModelMetadataResponse(
+            name=request.name, versions=["1"], platform="dynamo_tpu")
+        i = resp.inputs.add()
+        i.name, i.datatype = "text_input", "BYTES"
+        i.shape.append(1)
+        o = resp.outputs.add()
+        o.name, o.datatype = "text_output", "BYTES"
+        o.shape.append(1)
+        return resp
+
+    async def _completion_text(self, body: dict, context) -> tuple[str, str]:
+        """Run the pipeline, fold deltas → (text, finish_reason)."""
+        import grpc
+
+        engine = self.manager.engine_for(body.get("model", ""))
+        if engine is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {body.get('model')!r} not found")
+        parts: list[str] = []
+        finish = ""
+        async for chunk in engine.generate(
+                {"_kind": KIND_COMPLETION, "body": body}, Context()):
+            for ch in chunk.get("choices", ()):
+                if ch.get("text"):
+                    parts.append(ch["text"])
+                if ch.get("finish_reason"):
+                    finish = ch["finish_reason"]
+        return "".join(parts), finish
+
+    async def model_infer(self, request, context):
+        import grpc
+
+        pb = self._pb
+        try:
+            body = _completion_body(pb, request)
+        except OpenAIError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        try:
+            text, finish = await self._completion_text(body, context)
+        except OpenAIError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return _text_response(pb, request.model_name, request.id, text,
+                              finish)
+
+    async def model_stream_infer(self, request_iterator, context):
+        import grpc
+
+        pb = self._pb
+        async for request in request_iterator:
+            try:
+                body = _completion_body(pb, request)
+            except OpenAIError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+                continue
+            engine = self.manager.engine_for(body.get("model", ""))
+            if engine is None:
+                yield pb.ModelStreamInferResponse(
+                    error_message=f"model {body.get('model')!r} not found")
+                continue
+            ctx = Context()
+            try:
+                async for chunk in engine.generate(
+                        {"_kind": KIND_COMPLETION, "body": body}, ctx):
+                    for ch in chunk.get("choices", ()):
+                        text = ch.get("text") or ""
+                        finish = ch.get("finish_reason") or ""
+                        if text or finish:
+                            yield pb.ModelStreamInferResponse(
+                                infer_response=_text_response(
+                                    pb, request.model_name, request.id,
+                                    text, finish))
+            except OpenAIError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+            except grpc.RpcError:
+                ctx.cancel()
+                raise
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        import grpc
+
+        from dynamo_tpu.grpc_frontend import kserve_pb2
+
+        pb = kserve_pb2()
+        if pb is None:
+            raise RuntimeError("kserve gRPC unavailable "
+                               "(protoc/protobuf missing)")
+        self._pb = pb
+
+        def unary(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+
+        handlers = {
+            "ServerLive": unary(self.server_live, pb.ServerLiveRequest),
+            "ServerReady": unary(self.server_ready, pb.ServerReadyRequest),
+            "ModelReady": unary(self.model_ready, pb.ModelReadyRequest),
+            "ModelMetadata": unary(self.model_metadata,
+                                   pb.ModelMetadataRequest),
+            "ModelInfer": unary(self.model_infer, pb.ModelInferRequest),
+            "ModelStreamInfer": grpc.stream_stream_rpc_method_handler(
+                self.model_stream_infer,
+                request_deserializer=pb.ModelInferRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString()),
+        }
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("KServe gRPC frontend on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)

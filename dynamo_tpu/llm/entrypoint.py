@@ -31,12 +31,15 @@ class Frontend:
     manager: ModelManager
     watcher: ModelWatcher
     http: HttpService
+    grpc: object = None          # KserveGrpcService when --grpc-port set
 
     @property
     def url(self) -> str:
         return f"{self.http.scheme}://{self.http.host}:{self.http.port}"
 
     async def stop(self) -> None:
+        if self.grpc is not None:
+            await self.grpc.stop()
         await self.http.stop()
         await self.watcher.stop()
         await self.manager.close()
@@ -48,7 +51,8 @@ async def start_frontend(runtime: DistributedRuntime,
                          router_mode_override: Optional[str] = None,
                          namespace: Optional[str] = None,
                          tls_cert: Optional[str] = None,
-                         tls_key: Optional[str] = None) -> Frontend:
+                         tls_key: Optional[str] = None,
+                         grpc_port: Optional[int] = None) -> Frontend:
     """HTTP frontend: model discovery + OpenAI server (Input::Http).
 
     `router_mode_override` must be set before the watcher's initial MDC
@@ -60,7 +64,21 @@ async def start_frontend(runtime: DistributedRuntime,
     http = HttpService(manager, host, port, tls_cert=tls_cert,
                        tls_key=tls_key)
     await http.start()
-    return Frontend(runtime, manager, watcher, http)
+    grpc_svc = None
+    if grpc_port is not None:
+        from dynamo_tpu.grpc_frontend.service import KserveGrpcService
+
+        grpc_svc = KserveGrpcService(manager, host, grpc_port)
+        try:
+            await grpc_svc.start()
+        except BaseException:
+            # no Frontend handle exists yet: unwind what already started
+            # (bound HTTP port, running watcher) before re-raising
+            await http.stop()
+            await watcher.stop()
+            await manager.close()
+            raise
+    return Frontend(runtime, manager, watcher, http, grpc_svc)
 
 
 @dataclass

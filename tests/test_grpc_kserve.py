@@ -1,0 +1,211 @@
+"""KServe-v2 gRPC frontend against the mock engine stack.
+
+Reference: `lib/llm/tests/kserve_service.rs` style — real gRPC client ↔
+server over a socket; health, metadata, unary infer, streaming infer,
+error statuses.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.grpc_frontend import grpc_available, kserve_pb2
+
+pytestmark = pytest.mark.skipif(not grpc_available(),
+                                reason="grpcio/protoc unavailable")
+
+
+async def stack_with_grpc():
+    from dynamo_tpu.grpc_frontend.service import KserveGrpcService
+    from tests.test_http_frontend import setup_stack
+
+    rt, fe, hs, es = await setup_stack()
+    svc = KserveGrpcService(fe.manager, "127.0.0.1", 0)
+    await svc.start()
+    return rt, fe, hs, es, svc
+
+
+async def teardown(rt, fe, hs, es, svc):
+    from tests.test_http_frontend import teardown_stack
+
+    await svc.stop()
+    await teardown_stack(rt, fe, hs, es)
+
+
+def _infer_req(pb, model="mock-model", prompt="a b c", **params):
+    req = pb.ModelInferRequest(model_name=model, id="req-1")
+    t = req.inputs.add()
+    t.name, t.datatype = "text_input", "BYTES"
+    t.shape.append(1)
+    t.contents.bytes_contents.append(prompt.encode())
+    for k, v in params.items():
+        if isinstance(v, bool):
+            req.parameters[k].bool_param = v
+        elif isinstance(v, int):
+            req.parameters[k].int64_param = v
+        elif isinstance(v, float):
+            req.parameters[k].double_param = v
+        else:
+            req.parameters[k].string_param = str(v)
+    return req
+
+
+def _call(channel, method, pb, resp_cls):
+    return channel.unary_unary(
+        f"/inference.GRPCInferenceService/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+
+
+async def test_health_metadata_infer_stream():
+    import grpc
+
+    pb = kserve_pb2()
+    rt, fe, hs, es, svc = await stack_with_grpc()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{svc.port}") as ch:
+            live = await _call(ch, "ServerLive", pb,
+                               pb.ServerLiveResponse)(
+                pb.ServerLiveRequest())
+            assert live.live
+            ready = await _call(ch, "ServerReady", pb,
+                                pb.ServerReadyResponse)(
+                pb.ServerReadyRequest())
+            assert ready.ready
+            mready = await _call(ch, "ModelReady", pb,
+                                 pb.ModelReadyResponse)(
+                pb.ModelReadyRequest(name="mock-model"))
+            assert mready.ready
+            meta = await _call(ch, "ModelMetadata", pb,
+                               pb.ModelMetadataResponse)(
+                pb.ModelMetadataRequest(name="mock-model"))
+            assert meta.platform == "dynamo_tpu"
+            assert meta.inputs[0].name == "text_input"
+
+            # unary infer: completion folded into text_output
+            resp = await _call(ch, "ModelInfer", pb,
+                               pb.ModelInferResponse)(
+                _infer_req(pb, max_tokens=4, temperature=0.0))
+            assert resp.id == "req-1"
+            out = resp.outputs[0]
+            assert out.name == "text_output" and out.datatype == "BYTES"
+            assert out.contents.bytes_contents[0].decode()
+            assert resp.parameters["finish_reason"].string_param in (
+                "length", "stop")
+
+            # streaming: one response per delta, same total text
+            stream = ch.stream_stream(
+                "/inference.GRPCInferenceService/ModelStreamInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelStreamInferResponse
+                .FromString)
+            call = stream()
+            await call.write(_infer_req(pb, max_tokens=4,
+                                        temperature=0.0))
+            await call.done_writing()
+            parts = []
+            async for r in call:
+                assert not r.error_message
+                for out in r.infer_response.outputs:
+                    parts.append(
+                        out.contents.bytes_contents[0].decode())
+            assert len(parts) >= 2          # streamed, not folded
+    finally:
+        await teardown(rt, fe, hs, es, svc)
+
+
+async def test_unknown_model_not_found():
+    import grpc
+
+    pb = kserve_pb2()
+    rt, fe, hs, es, svc = await stack_with_grpc()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{svc.port}") as ch:
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _call(ch, "ModelInfer", pb, pb.ModelInferResponse)(
+                    _infer_req(pb, model="nope"))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _call(ch, "ModelMetadata", pb,
+                            pb.ModelMetadataResponse)(
+                    pb.ModelMetadataRequest(name="nope"))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await teardown(rt, fe, hs, es, svc)
+
+
+async def test_missing_text_input_invalid_argument():
+    import grpc
+
+    pb = kserve_pb2()
+    rt, fe, hs, es, svc = await stack_with_grpc()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{svc.port}") as ch:
+            req = pb.ModelInferRequest(model_name="mock-model")
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _call(ch, "ModelInfer", pb,
+                            pb.ModelInferResponse)(req)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        await teardown(rt, fe, hs, es, svc)
+
+
+async def test_frontend_cli_grpc_flag():
+    """start_frontend(grpc_port=0) serves both HTTP and gRPC."""
+    import grpc
+
+    from dynamo_tpu.llm.entrypoint import serve_engine, start_frontend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    pb = kserve_pb2()
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    eng = MockEngine(MockEngineConfig(speedup=100.0))
+    card = ModelDeploymentCard(name="gm", namespace="ns", component="w",
+                               tokenizer_kind="word", tokenizer_path="gm")
+    h = await serve_engine(rt, eng, card)
+    fe = await start_frontend(rt, grpc_port=0)
+    try:
+        for _ in range(100):
+            if "gm" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{fe.grpc.port}") as ch:
+            resp = await _call(ch, "ModelInfer", pb,
+                               pb.ModelInferResponse)(
+                _infer_req(pb, model="gm", max_tokens=3))
+            assert resp.outputs[0].contents.bytes_contents[0]
+    finally:
+        await fe.stop()
+        await h.stop()
+        await eng.close()
+        await rt.close()
+
+
+async def test_grpc_start_failure_unwinds_http(monkeypatch):
+    """Review regression: a failing gRPC bind must not leak the already-
+    started HTTP server/watcher."""
+    from dynamo_tpu.grpc_frontend.service import KserveGrpcService
+    from dynamo_tpu.llm.entrypoint import start_frontend
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    async def boom(self):
+        raise RuntimeError("no grpc here")
+
+    monkeypatch.setattr(KserveGrpcService, "start", boom)
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        with pytest.raises(RuntimeError):
+            await start_frontend(rt, grpc_port=0)
+        # the HTTP port was released: a fresh frontend binds cleanly
+        fe = await start_frontend(rt)
+        await fe.stop()
+    finally:
+        await rt.close()

@@ -1,0 +1,1 @@
+"""Deployment doctor (deploy/dynamo_check.py analog)."""

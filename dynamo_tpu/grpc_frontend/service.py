@@ -46,16 +46,22 @@ def _completion_body(pb, req) -> dict:
     body: dict = {"model": req.model_name, "prompt": prompt}
     for key, p in req.parameters.items():
         which = p.WhichOneof("parameter_choice")
-        val = getattr(p, which) if which else None
-        if key in ("max_tokens", "min_tokens", "top_k", "seed", "n"):
-            body[key] = int(val)
-        elif key in ("temperature", "top_p", "min_p",
-                     "frequency_penalty", "presence_penalty"):
-            body[key] = float(val)
-        elif key == "stop":
-            body[key] = str(val)
-        elif key == "ignore_eos":
-            body[key] = bool(val)
+        if which is None:
+            continue  # map entry touched but no oneof set
+        val = getattr(p, which)
+        try:
+            if key in ("max_tokens", "min_tokens", "top_k", "seed", "n"):
+                body[key] = int(val)
+            elif key in ("temperature", "top_p", "min_p",
+                         "frequency_penalty", "presence_penalty"):
+                body[key] = float(val)
+            elif key == "stop":
+                body[key] = str(val)
+            elif key == "ignore_eos":
+                body[key] = bool(val)
+        except (TypeError, ValueError):
+            raise OpenAIError(
+                f"bad value for parameter {key!r}: {val!r}") from None
     return body
 
 
@@ -116,6 +122,8 @@ class KserveGrpcService:
 
     async def _completion_text(self, body: dict, context) -> tuple[str, str]:
         """Run the pipeline, fold deltas → (text, finish_reason)."""
+        import asyncio
+
         import grpc
 
         engine = self.manager.engine_for(body.get("model", ""))
@@ -124,13 +132,18 @@ class KserveGrpcService:
                                 f"model {body.get('model')!r} not found")
         parts: list[str] = []
         finish = ""
-        async for chunk in engine.generate(
-                {"_kind": KIND_COMPLETION, "body": body}, Context()):
-            for ch in chunk.get("choices", ()):
-                if ch.get("text"):
-                    parts.append(ch["text"])
-                if ch.get("finish_reason"):
-                    finish = ch["finish_reason"]
+        ctx = Context()
+        try:
+            async for chunk in engine.generate(
+                    {"_kind": KIND_COMPLETION, "body": body}, ctx):
+                for ch in chunk.get("choices", ()):
+                    if ch.get("text"):
+                        parts.append(ch["text"])
+                    if ch.get("finish_reason"):
+                        finish = ch["finish_reason"]
+        except asyncio.CancelledError:
+            ctx.cancel()  # RPC cancelled: stop downstream generation
+            raise
         return "".join(parts), finish
 
     async def model_infer(self, request, context):
@@ -149,7 +162,7 @@ class KserveGrpcService:
                               finish)
 
     async def model_stream_infer(self, request_iterator, context):
-        import grpc
+        import asyncio as _aio
 
         pb = self._pb
         async for request in request_iterator:
@@ -177,18 +190,28 @@ class KserveGrpcService:
                                     text, finish))
             except OpenAIError as e:
                 yield pb.ModelStreamInferResponse(error_message=str(e))
-            except grpc.RpcError:
+            except _aio.CancelledError:
+                # client cancelled the RPC: stop downstream generation
                 ctx.cancel()
                 raise
+            except Exception as e:
+                # per-request failure: report on the stream, keep serving
+                # queued requests rather than killing the whole bidi call
+                logger.exception("stream infer failed")
+                yield pb.ModelStreamInferResponse(error_message=repr(e))
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> tuple[str, int]:
+        import asyncio
+
         import grpc
 
         from dynamo_tpu.grpc_frontend import kserve_pb2
 
-        pb = kserve_pb2()
+        # cold _gen/ cache runs protoc (seconds): keep it off the event
+        # loop — the HTTP frontend is already serving at this point
+        pb = await asyncio.to_thread(kserve_pb2)
         if pb is None:
             raise RuntimeError("kserve gRPC unavailable "
                                "(protoc/protobuf missing)")
@@ -211,11 +234,23 @@ class KserveGrpcService:
                 request_deserializer=pb.ModelInferRequest.FromString,
                 response_serializer=lambda m: m.SerializeToString()),
         }
-        self._server = grpc.aio.server()
+        # so_reuseport off: two frontends silently sharing a port is a
+        # misconfiguration we want loud, and bind failures must be real
+        self._server = grpc.aio.server(
+            options=(("grpc.so_reuseport", 0),))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE, handlers),))
-        self.port = self._server.add_insecure_port(
-            f"{self.host}:{self.port}")
+        bound = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        if bound == 0:
+            # grpc reports bind failure by returning port 0, not raising
+            server, self._server = self._server, None
+            try:
+                await server.stop(grace=None)
+            except Exception:
+                pass
+            raise RuntimeError(
+                f"gRPC frontend could not bind {self.host}:{self.port}")
+        self.port = bound
         await self._server.start()
         logger.info("KServe gRPC frontend on %s:%d", self.host, self.port)
         return self.host, self.port

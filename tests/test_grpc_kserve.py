@@ -209,3 +209,45 @@ async def test_grpc_start_failure_unwinds_http(monkeypatch):
         await fe.stop()
     finally:
         await rt.close()
+
+
+async def test_bad_parameter_invalid_argument():
+    """Review regression: malformed parameter values → INVALID_ARGUMENT,
+    not UNKNOWN; unset oneofs are skipped."""
+    import grpc
+
+    pb = kserve_pb2()
+    rt, fe, hs, es, svc = await stack_with_grpc()
+    try:
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{svc.port}") as ch:
+            req = _infer_req(pb)
+            req.parameters["max_tokens"].string_param = "not-a-number"
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await _call(ch, "ModelInfer", pb,
+                            pb.ModelInferResponse)(req)
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            # untouched oneof: merely accessing the map entry is ignored
+            req2 = _infer_req(pb, max_tokens=2)
+            _ = req2.parameters["seed"]
+            resp = await _call(ch, "ModelInfer", pb,
+                               pb.ModelInferResponse)(req2)
+            assert resp.outputs[0].contents.bytes_contents[0]
+    finally:
+        await teardown(rt, fe, hs, es, svc)
+
+
+async def test_grpc_bind_failure_raises():
+    from dynamo_tpu.grpc_frontend.service import KserveGrpcService
+    from tests.test_http_frontend import setup_stack, teardown_stack
+
+    rt, fe, hs, es = await setup_stack()
+    svc1 = KserveGrpcService(fe.manager, "127.0.0.1", 0)
+    await svc1.start()
+    try:
+        svc2 = KserveGrpcService(fe.manager, "127.0.0.1", svc1.port)
+        with pytest.raises(RuntimeError):
+            await svc2.start()     # port already taken: loud, not silent
+    finally:
+        await svc1.stop()
+        await teardown_stack(rt, fe, hs, es)

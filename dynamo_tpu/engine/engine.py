@@ -193,6 +193,19 @@ class TpuEngine:
                 token_ids=[], finish_reason=FINISH_ERROR,
                 extra={"error": "empty prompt"}).to_dict()
             return
+        if req.extra.get("embed"):
+            max_ctx = mcfg.page_size * mcfg.max_pages_per_seq
+            if len(req.token_ids) > max_ctx:
+                # must reject BEFORE the dense T^2 forward: an unbounded
+                # prompt would compile/allocate under the device lock
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": f"embed input ({len(req.token_ids)} "
+                                    f"tokens) exceeds context {max_ctx}"}
+                ).to_dict()
+                return
+            yield await self._embed_one(req)
+            return
         # decode bursts may overshoot by up to decode_steps_per_sync tokens
         max_len = (mcfg.page_size * mcfg.max_pages_per_seq
                    - cfg.decode_steps_per_sync)
@@ -248,6 +261,30 @@ class TpuEngine:
             yield out
             if out.get("finish_reason"):
                 return
+
+    async def _embed_one(self, req) -> dict:
+        """Mean-pooled prompt embedding (llama.embed_batch): a dense
+        cache-free forward, bucketed to pow2 lengths so compiles stay
+        bounded; runs under the device lock like every device op."""
+        from dynamo_tpu.models.llama import embed_batch
+
+        ids = req.token_ids
+        t_bucket = _next_pow2(len(ids), self.config.min_prefill_bucket,
+                              1 << 30)
+        toks = np.zeros((1, t_bucket), dtype=np.int32)
+        toks[0, :len(ids)] = ids
+        lengths = np.asarray([len(ids)], dtype=np.int32)
+
+        async with self._device_lock:
+            def run():
+                vec = embed_batch(self.params, jax.numpy.asarray(toks),
+                                  jax.numpy.asarray(lengths),
+                                  self.model_cfg)
+                return np.asarray(vec[0], dtype=np.float32)
+
+            vec = await asyncio.to_thread(run)
+        return {"embedding": vec.tolist(), "token_ids": [],
+                "finish_reason": FINISH_STOP}
 
     def clear_kv_blocks(self) -> int:
         """Drop the reusable prefix cache (admin route analog of

@@ -58,7 +58,13 @@ def _completion_body(pb, req) -> dict:
             elif key == "stop":
                 body[key] = str(val)
             elif key == "ignore_eos":
-                body[key] = bool(val)
+                if isinstance(val, str):
+                    low = val.strip().lower()
+                    if low not in ("true", "false", "0", "1"):
+                        raise ValueError(val)
+                    body[key] = low in ("true", "1")
+                else:
+                    body[key] = bool(val)
         except (TypeError, ValueError):
             raise OpenAIError(
                 f"bad value for parameter {key!r}: {val!r}") from None

@@ -414,3 +414,32 @@ def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
     attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
                       v.astype(jnp.float32)).astype(x.dtype)
     return x + attn.reshape(B, T, H * D) @ lp["wo"]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def embed_batch(params: dict, tokens: jax.Array, lengths: jax.Array,
+                cfg: "LlamaConfig") -> jax.Array:
+    """Mean-pooled sentence embeddings: (B, T) padded prompts + (B,)
+    valid lengths → (B, E) L2-normalized vectors.
+
+    Dense cache-free forward (embeddings never decode, so no paged KV):
+    per-layer attention via the shared `dense_attention` block, final
+    rms_norm, masked mean over valid positions. Serves `/v1/embeddings`
+    for the real engine (openai.rs:1125 parity; the reference delegates
+    to an embedding engine — we own ours)."""
+    B, T = tokens.shape
+    positions = jnp.arange(T)[None, :]
+    valid = positions < lengths[:, None]                    # (B, T)
+    # padding lanes attend only within the valid prefix
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    x = params["embed"][tokens]
+    for l in range(cfg.num_layers):
+        lp = _layer_params(params, l)
+        x = dense_attention(x, lp, positions, mask, cfg)
+        x = x + _swiglu(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp)
+    h = rms_norm(x, params["final_norm"], cfg.rms_eps).astype(jnp.float32)
+    h = jnp.where(valid[..., None], h, 0.0)
+    pooled = h.sum(axis=1) / jnp.maximum(
+        lengths[:, None].astype(jnp.float32), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-12)

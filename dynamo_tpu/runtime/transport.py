@@ -35,6 +35,8 @@ STREAM_ERR_MSG = "stream disconnected"  # matched by Migration retry logic
 class TransportServer:
     """Serves registered engines (by subject) to remote callers."""
 
+    STATS_SUBJECT = "_sys.stats"  # builtin scrape endpoint (nats.rs:107)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self.host = host
         self.port = port
@@ -42,6 +44,14 @@ class TransportServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._conn_writers: set[asyncio.StreamWriter] = set()
+        # per-subject service stats, scrapable via STATS_SUBJECT
+        # (the reference's NATS $SRV.STATS analog)
+        self.stats: dict[str, dict] = {}
+
+    def _stat(self, subject: str) -> dict:
+        return self.stats.setdefault(subject, {
+            "requests": 0, "errors": 0, "items": 0, "inflight": 0,
+            "total_processing_s": 0.0})
 
     def register(self, subject: str, engine: AsyncEngine) -> None:
         self._handlers[subject] = engine
@@ -88,15 +98,39 @@ class TransportServer:
 
         async def run_request(rid: str, subject: str, payload: Any,
                               headers: dict) -> None:
+            import time as _time
+
             from dynamo_tpu.runtime.tracing import TRACEPARENT, tracer
 
             ctx = inflight[rid][1]
-            try:
-                engine = self._handlers.get(subject)
-                if engine is None:
+            if subject == self.STATS_SUBJECT:
+                try:
+                    # builtin scrape: snapshot of every subject's counters
+                    await send({"t": "data", "rid": rid,
+                                "payload": {"stats": self.stats,
+                                            "address": self.address}})
+                    await send({"t": "end", "rid": rid})
+                finally:
+                    inflight.pop(rid, None)
+                return
+            engine = self._handlers.get(subject)
+            if engine is None:
+                # don't create a stats entry for attacker-chosen subject
+                # strings: one shared bucket counts the rejects
+                try:
+                    self._stat("_unknown")["errors"] += 1
                     await send({"t": "err", "rid": rid,
                                 "error": f"no such endpoint: {subject}"})
-                    return
+                except ConnectionError:
+                    pass
+                finally:
+                    inflight.pop(rid, None)
+                return
+            stat = self._stat(subject)
+            stat["requests"] += 1
+            stat["inflight"] += 1
+            t0 = _time.perf_counter()
+            try:
                 # server span: the request's trace continues across the
                 # wire via the traceparent header (logging.rs W3C prop)
                 with tracer().start_span(
@@ -110,6 +144,7 @@ class TransportServer:
                                     "payload": item})
                         n += 1
                     span.set_attribute("response.items", n)
+                    stat["items"] += n
                 await send({"t": "end", "rid": rid})
             except asyncio.CancelledError:
                 if not ctx.is_cancelled():  # server shutdown, not user cancel
@@ -121,12 +156,15 @@ class TransportServer:
             except ConnectionError:
                 pass  # client went away; nothing to report to
             except Exception as e:
+                stat["errors"] += 1
                 logger.exception("handler error subject=%s rid=%s", subject, rid)
                 try:
                     await send({"t": "err", "rid": rid, "error": repr(e)})
                 except Exception:
                     pass
             finally:
+                stat["inflight"] -= 1
+                stat["total_processing_s"] += _time.perf_counter() - t0
                 inflight.pop(rid, None)
 
         try:

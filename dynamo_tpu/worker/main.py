@@ -55,6 +55,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="override model context (max_pages_per_seq)")
     p.add_argument("--random-init", action="store_true",
                    help="skip weight load (synthetic benchmarking)")
+    mn = p.add_argument_group(
+        "multinode", "multi-host engine sharding (MultiNodeConfig analog, "
+                     "ref lib/llm/src/engines.rs:28 + trtllm multinode): "
+                     "every node runs this CLI with the same leader addr; "
+                     "jax.distributed assembles one global device mesh")
+    mn.add_argument("--num-nodes", type=int, default=1)
+    mn.add_argument("--node-rank", type=int, default=0)
+    mn.add_argument("--leader-addr", default=None,
+                    help="host:port of node 0's jax coordinator")
+    mn.add_argument("--tensor-parallel-size", type=int, default=1,
+                    help="tp over the (possibly multi-host) device mesh")
     p.add_argument("--kvbm-host-blocks", type=int, default=0,
                    help="enable the KVBM host tier with this many blocks")
     # mocker knobs
@@ -107,6 +118,9 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
 
     from dynamo_tpu.llm.entrypoint import build_tpu_engine
 
+    mesh = None
+    if args.num_nodes > 1 or args.tensor_parallel_size > 1:
+        mesh = _multinode_mesh(args)
     overrides = {}
     if args.context_length is not None:
         overrides["max_pages_per_seq"] = max(1, args.context_length // 16)
@@ -114,9 +128,11 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         args.model, served_name=args.served_model_name,
         num_pages=args.num_pages, max_batch_size=args.max_batch_size,
         decode_steps_per_sync=args.decode_steps_per_sync,
-        worker_id=instance_id,
+        worker_id=instance_id, mesh=mesh,
         random_init=args.random_init,
         kvbm_host_blocks=args.kvbm_host_blocks, **overrides)
+    if mesh is not None:
+        card.runtime_config.tensor_parallel_size = args.tensor_parallel_size
     engine.config.prefill_chunk = args.prefill_chunk
     card.namespace = args.namespace
     card.component = component
@@ -127,6 +143,45 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         engine.pool.event_sink = event_sink
         engine.metrics_sink = metrics_sink
     return engine, card
+
+
+def _multinode_mesh(args: argparse.Namespace):
+    """Global dp=1 x tp mesh over every chip of every node.
+
+    Multi-host: `jax.distributed.initialize` forms the process group
+    (node 0 is the coordinator; ICI/DCN collectives ride the global
+    mesh exactly as on one host — the scaling-book recipe, not an
+    NCCL/MPI translation). Single-host tp>1 skips the init."""
+    import jax
+
+    if args.num_nodes > 1:
+        if not args.leader_addr:
+            raise SystemExit("--num-nodes > 1 requires --leader-addr")
+        jax.distributed.initialize(
+            coordinator_address=args.leader_addr,
+            num_processes=args.num_nodes,
+            process_id=args.node_rank)
+    from dynamo_tpu.engine.sharding import make_mesh
+
+    tp = args.tensor_parallel_size
+    # honor an explicit jax_default_device override (tests pin CPU while
+    # the process-default backend is the TPU tunnel — attention.py:39)
+    default = jax.config.jax_default_device
+    devices = (jax.devices(default.platform) if default is not None
+               else jax.devices())
+    if len(devices) < tp:
+        raise SystemExit(
+            f"tp={tp} needs {tp} devices; the mesh sees {len(devices)}")
+    if args.num_nodes > 1 and tp != len(devices):
+        # multi-host SPMD: every process must build the SAME global mesh
+        # over ALL chips — a devices[:tp] slice would hand node 1 a mesh
+        # of node 0's (non-addressable) devices and crash at the first
+        # collective. tp here is the TOTAL across nodes.
+        raise SystemExit(
+            f"multi-host tp must cover every chip: tp={tp} but the "
+            f"global mesh has {len(devices)} devices "
+            f"({args.num_nodes} nodes)")
+    return make_mesh(dp=1, tp=tp, devices=devices[:tp])
 
 
 def main(argv=None) -> None:

@@ -234,3 +234,50 @@ async def test_standalone_router_service(procs):
         assert len(toks) == 4, outs
     finally:
         await rt.close()
+
+
+async def test_worker_cli_tensor_parallel_mesh():
+    """--tensor-parallel-size builds the engine over a tp mesh (the
+    single-host slice of the MultiNodeConfig path; multi-host adds
+    jax.distributed.initialize with --num-nodes/--leader-addr)."""
+    import shutil
+
+    import torch
+    from transformers import LlamaConfig as HfCfg, LlamaForCausalLM
+
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.worker.main import build_engine_and_card, parse_args
+
+    path = "/tmp/tp_ckpt_test"
+    if not __import__("os").path.isdir(path):
+        torch.manual_seed(0)
+        LlamaForCausalLM(HfCfg(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+        )).save_pretrained(path, safe_serialization=True)
+
+    args = parse_args(["--model", path, "--tensor-parallel-size", "2",
+                       "--random-init"])
+    eng, card = build_engine_and_card(args, None, None, 1)
+    try:
+        assert card.runtime_config.tensor_parallel_size == 2
+        assert dict(eng.config.mesh.shape) == {"dp": 1, "tp": 2}
+        req = {"token_ids": [1, 2, 3, 4, 5, 6], "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 3}}
+        toks = [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", ())]
+        assert len(toks) == 3
+    finally:
+        await eng.close()
+
+
+def test_worker_cli_multinode_validation():
+    from dynamo_tpu.worker.main import _multinode_mesh, parse_args
+
+    import pytest as _pytest
+
+    args = parse_args(["--mock", "--num-nodes", "2"])
+    with _pytest.raises(SystemExit, match="leader-addr"):
+        _multinode_mesh(args)

@@ -224,3 +224,42 @@ async def test_metrics_published():
         assert metrics[-1].kv_stats.kv_total_blocks == 63  # 64 - scratch
     finally:
         await eng.close()
+
+
+async def test_engine_embeddings():
+    """extra.embed → mean-pooled L2-normalized vector matching the
+    direct embed_batch computation; same input ⇒ same vector."""
+    import numpy as np
+
+    from dynamo_tpu.models.llama import embed_batch
+
+    eng = make_engine()
+    try:
+        ids = [5, 6, 7, 8, 9]
+        req = {"token_ids": ids, "model": "m",
+               "stop": {"max_tokens": 1}, "extra": {"embed": True}}
+        outs = [o async for o in eng.generate(req, Context())]
+        assert len(outs) == 1
+        vec = np.asarray(outs[0]["embedding"], dtype=np.float32)
+        assert vec.shape == (eng.model_cfg.hidden_size,)
+        assert abs(np.linalg.norm(vec) - 1.0) < 1e-5
+
+        # matches the raw model computation (bucket-padded the same way)
+        import jax.numpy as jnp
+        toks = np.zeros((1, 8), np.int32)
+        toks[0, :5] = ids
+        want = np.asarray(embed_batch(
+            eng.params, jnp.asarray(toks), jnp.asarray([5], np.int32),
+            eng.model_cfg)[0])
+        np.testing.assert_allclose(vec, want, rtol=1e-5, atol=1e-5)
+
+        outs2 = [o async for o in eng.generate(dict(req), Context())]
+        assert outs2[0]["embedding"] == outs[0]["embedding"]
+        # generation still works on the same engine afterwards
+        gen = {"token_ids": ids, "model": "m", "stop": {"max_tokens": 3},
+               "sampling": {"temperature": 0.0}}
+        toks_out = [t async for o in eng.generate(gen, Context())
+                    for t in o.get("token_ids", ())]
+        assert len(toks_out) == 3
+    finally:
+        await eng.close()

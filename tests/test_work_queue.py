@@ -1,0 +1,161 @@
+"""Durable work queue (NatsQueue/prefill-queue analog) over the store.
+
+Reference semantics (transports/nats.rs:427): FIFO-ish delivery, no
+double-claims across competing consumers, at-least-once redelivery when
+a consumer dies (its lease drops), ack removes permanently.
+"""
+
+import asyncio
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.queue import WorkQueue
+
+
+async def test_fifo_enqueue_dequeue_ack():
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        q = WorkQueue(rt, "prefill")
+        for i in range(3):
+            await q.enqueue({"job": i})
+        assert await q.depth() == 3
+        got = []
+        while (item := await q.try_dequeue()) is not None:
+            got.append(item.payload["job"])
+            await item.ack()
+        assert got == [0, 1, 2]            # enqueue order
+        assert await q.depth() == 0
+        assert await q.try_dequeue() is None
+    finally:
+        await rt.close()
+
+
+async def test_no_double_claim_across_consumers():
+    rt1 = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    # same in-proc store: second runtime shares it via the first
+    rt2 = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    rt2.store = rt1.store
+    rt2.lease_id = await rt1.store.create_lease(5.0)
+    try:
+        q1, q2 = WorkQueue(rt1, "q"), WorkQueue(rt2, "q")
+        for i in range(20):
+            await q1.enqueue(i)
+        claimed: list[int] = []
+
+        async def consume(q):
+            while (item := await q.try_dequeue()) is not None:
+                claimed.append(item.payload)
+                await asyncio.sleep(0)      # interleave
+                await item.ack()
+
+        await asyncio.gather(consume(q1), consume(q2))
+        assert sorted(claimed) == list(range(20))
+        assert len(claimed) == 20           # exactly once here: no dupes
+    finally:
+        await rt2.close()
+        await rt1.close()
+
+
+async def test_nack_redelivers():
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        q = WorkQueue(rt, "q")
+        await q.enqueue("x")
+        item = await q.try_dequeue()
+        assert await q.try_dequeue() is None   # claimed: invisible
+        await item.nack()
+        again = await q.try_dequeue()
+        assert again is not None and again.payload == "x"
+        await again.ack()
+    finally:
+        await rt.close()
+
+
+async def test_dead_consumer_lease_expiry_redelivers():
+    """A consumer whose lease expires loses its claim; the item goes to
+    the next puller (at-least-once — the prefill-queue fault story)."""
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        q = WorkQueue(rt, "q")
+        await q.enqueue({"prompt": [1, 2, 3]})
+
+        class DeadRt:                       # consumer with its own lease
+            store = rt.store
+            lease_id = 0
+
+        DeadRt.lease_id = await rt.store.create_lease(0.1)
+        dead_q = WorkQueue(DeadRt, "q")
+        item = await dead_q.try_dequeue()
+        assert item is not None
+        assert await q.try_dequeue() is None   # claimed
+        # consumer "dies": no keep-alive → lease reaper drops the claim
+        for _ in range(100):
+            if (again := await q.try_dequeue()) is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert again.payload == {"prompt": [1, 2, 3]}
+        await again.ack()
+        assert await q.depth() == 0
+    finally:
+        await rt.close()
+
+
+async def test_dequeue_with_timeout_waits_for_producer():
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        q = WorkQueue(rt, "q")
+
+        async def later():
+            await asyncio.sleep(0.1)
+            await q.enqueue("late")
+
+        t = asyncio.get_running_loop().create_task(later())
+        item = await q.dequeue(timeout=2.0)
+        assert item is not None and item.payload == "late"
+        await item.ack()
+        await t
+        assert await q.dequeue(timeout=0.1) is None
+    finally:
+        await rt.close()
+
+
+# ---------------------------------------------------------------------------
+# stats-scrape ServiceClient (service.rs:442 analog)
+
+async def test_service_stats_scrape():
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.service_stats import ServiceClient
+
+    rt_srv = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory"))
+    rt_cli = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory"))
+    rt_cli.store = rt_srv.store  # shared control plane
+    try:
+        async def handler(req, ctx):
+            yield {"a": 1}
+            yield {"a": 2}
+
+        ep = rt_srv.namespace("ns").component("c").endpoint("generate")
+        served = await ep.serve(handler, instance_id=3)
+        # drive real traffic over the WIRE (stats live on the transport)
+        for _ in range(4):
+            items = [x async for x in rt_cli.transport_client.request(
+                served.instance.address, served.instance.subject,
+                {}, Context())]
+            assert len(items) == 2
+
+        stats = await ServiceClient(rt_cli).collect_services(
+            "ns", "c", "generate")
+        assert len(stats.endpoints) == 1
+        e = stats.endpoints[0]
+        assert e.instance_id == 3
+        assert e.requests == 4
+        assert e.items == 8
+        assert e.errors == 0 and e.inflight == 0
+        assert e.avg_processing_s >= 0
+        assert stats.total_requests() == 4
+        assert stats.least_loaded() is e
+    finally:
+        await rt_cli.close()
+        await rt_srv.close()

@@ -56,9 +56,14 @@ async def run_engine_bench(cfg):
         assert outs[-1].get("finish_reason") == "length", outs[-1]
         return sum(len(o.get("token_ids", ())) for o in outs)
 
-    # warmup: compile prefill buckets + the decode burst
-    await one(0)
-    await asyncio.gather(*(one(i + 1) for i in range(4)))
+    # warmup: compile EVERY shape the measured phase can hit. Prefill
+    # batches at pow2 widths (engine _next_pow2), so warm each width
+    # with its own synchronized wave — a single missed shape would land
+    # a ~10s remote compile inside the timed window. Decode is a single
+    # fixed-width compile covered by the first request.
+    await one(0)                                          # bp=1 + decode
+    for wave, base in ((2, 30), (4, 40), (8, 50), (BATCH, 60)):
+        await asyncio.gather(*(one(base + i) for i in range(wave)))
 
     t0 = time.perf_counter()
     counts = await asyncio.gather(*(one(i + 100) for i in range(N_REQS)))

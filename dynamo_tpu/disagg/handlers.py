@@ -154,16 +154,23 @@ class DecodeWorkerHandler:
                  prefill_router: Optional[AsyncEngine] = None,
                  kv_pull_router: Optional[PushRouter] = None,
                  disagg_router: Optional[DisaggRouter] = None,
-                 pull_chunk_pages: int = DEFAULT_PULL_CHUNK_PAGES) -> None:
+                 pull_chunk_pages: int = DEFAULT_PULL_CHUNK_PAGES,
+                 prefill_queue_client=None) -> None:
         self.engine = engine
         self.prefill_router = prefill_router
         self.kv_pull_router = kv_pull_router
         self.disagg_router = disagg_router or DisaggRouter()
         self.pull_chunk_pages = pull_chunk_pages
+        # pull-model alternative to prefill_router: jobs ride the durable
+        # queue, any prefill worker takes them (prefill_queue.py)
+        self.prefill_queue_client = prefill_queue_client
         self.last_pull_path: Optional[str] = None  # "device" | "wire"
 
     def _can_prefill_remote(self) -> bool:
-        if self.prefill_router is None or self.kv_pull_router is None:
+        if self.kv_pull_router is None:
+            return False
+        if self.prefill_router is None \
+                and self.prefill_queue_client is None:
             return False
         try:
             return bool(self.kv_pull_router.client.instances())
@@ -265,18 +272,30 @@ class DecodeWorkerHandler:
         prefill_req["kv_transfer_params"] = {"do_remote_decode": True}
         first_token: Optional[int] = None
         ktp: Optional[dict] = None
-        try:
-            async for out in self.prefill_router.generate(
-                    prefill_req, context):
-                if out.get("token_ids"):
-                    first_token = out["token_ids"][0]
-                if out.get("kv_transfer_params"):
-                    ktp = out["kv_transfer_params"]
-                if out.get("finish_reason") == "error":
-                    ktp = None
-                    break
-        except ConnectionError:
-            ktp = None
+        if self.prefill_queue_client is not None:
+            try:
+                result = await self.prefill_queue_client.prefill(
+                    prefill_req, context)
+            except Exception:
+                # store/transport hiccup: same contract as the push path
+                # (ConnectionError) — fall back to fully-local serving
+                logger.exception("prefill queue unavailable")
+                result = None
+            if result is not None:
+                first_token, ktp = result
+        else:
+            try:
+                async for out in self.prefill_router.generate(
+                        prefill_req, context):
+                    if out.get("token_ids"):
+                        first_token = out["token_ids"][0]
+                    if out.get("kv_transfer_params"):
+                        ktp = out["kv_transfer_params"]
+                    if out.get("finish_reason") == "error":
+                        ktp = None
+                        break
+            except ConnectionError:
+                ktp = None
         if ktp is None or first_token is None:
             # remote prefill failed: fall back to fully-local serve
             logger.warning("remote prefill failed; serving locally")

@@ -1,0 +1,215 @@
+"""Prefill queue: pull-model disaggregation over the durable work queue.
+
+Reference: the SGLang pattern (`docs/architecture/dynamo_flow.md:23-52`,
+`sglang/request_handlers/llm/{decode,prefill}_handler.py`) — instead of
+the decode worker PUSH-routing a prefill request at a chosen worker
+(vLLM pattern, `disagg/handlers.py`), it enqueues the job on a shared
+queue and ANY prefill worker pulls it. Load-balancing falls out of the
+queue (idle workers pull), and a prefill worker dying mid-job redelivers
+via the claim lease (`runtime/queue.py`).
+
+Result delivery: the consumer writes `{first_token, kv_transfer_params}`
+to the store under the job's result key; the decode side watches for it.
+KV pages then move exactly as in the push path (device-side or chunked
+wire pull against the owning worker's kv_pull endpoint).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.store import PUT
+from dynamo_tpu.runtime.queue import QUEUE_PREFIX, WorkQueue
+
+logger = logging.getLogger(__name__)
+
+PREFILL_QUEUE = "prefill"
+
+
+def _result_key(namespace: str, queue: str, job_id: str) -> str:
+    return f"v1/queue/{namespace}/{queue}/results/{job_id}"
+
+
+class PrefillQueueConsumer:
+    """Runs on a prefill worker: pull job → prefill → publish result."""
+
+    def __init__(self, runtime, handler, namespace: str = "dynamo",
+                 queue: str = PREFILL_QUEUE,
+                 result_ttl: float = 60.0, max_attempts: int = 3) -> None:
+        self.runtime = runtime
+        self.handler = handler          # PrefillWorkerHandler
+        self.namespace = namespace
+        self.queue_name = queue
+        self.result_ttl = result_ttl
+        self.max_attempts = max_attempts
+        self._queue = WorkQueue(runtime, queue, namespace)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.jobs_done = 0
+        self.jobs_failed = 0
+
+    def start(self) -> "PrefillQueueConsumer":
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while not self._stopped:
+            try:
+                item = await self._queue.dequeue(timeout=3600.0, poll=0.02)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transient store error must not kill the consumer — a
+                # dead consumer with a live kv_pull endpoint makes every
+                # decode request eat the full queue timeout
+                logger.exception("prefill queue dequeue failed; retrying")
+                await asyncio.sleep(0.5)
+                continue
+            if item is None:
+                continue
+            try:
+                await self._run_job(item.payload)
+                await item.ack()
+                self.jobs_done += 1
+            except asyncio.CancelledError:
+                await item.nack()  # shutting down: give the job back
+                raise
+            except Exception:
+                # a failing job must not hot-loop at the queue head
+                # (nack would make it the oldest claimable item again):
+                # ack it and re-enqueue at the TAIL with a retry budget.
+                # This cleanup path must itself survive store hiccups —
+                # an escaping exception here would kill the consumer.
+                try:
+                    job = dict(item.payload)
+                    attempts = int(job.get("attempts", 0)) + 1
+                    logger.exception("prefill job %s failed (attempt %d)",
+                                     item.item_id, attempts)
+                    await item.ack()
+                    if attempts < self.max_attempts:
+                        job["attempts"] = attempts
+                        await self._queue.enqueue(job)
+                    else:
+                        self.jobs_failed += 1
+                        await self._publish_result(
+                            job["job_id"],
+                            {"first_token": None,
+                             "kv_transfer_params": None,
+                             "error": "prefill failed after retries"})
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("prefill job cleanup failed")
+                    await asyncio.sleep(0.5)
+
+    async def _run_job(self, job: dict) -> None:
+        # requester gave up (timeout/cancel tombstone)? don't burn
+        # prefill compute + pin transfer pages for a departed client —
+        # this also covers RETRIED copies the original retraction missed
+        key = _result_key(self.namespace, self.queue_name, job["job_id"])
+        existing = await self.runtime.store.get(key)
+        if existing is not None and \
+                json.loads(existing.value).get("cancelled"):
+            logger.info("prefill job %s cancelled by requester; skipping",
+                        job["job_id"])
+            return
+        request = job["request"]
+        first_token = None
+        ktp = None
+        async for out in self.handler.generate(request, Context()):
+            if out.get("token_ids"):
+                first_token = out["token_ids"][0]
+            if out.get("kv_transfer_params"):
+                ktp = out["kv_transfer_params"]
+            if out.get("finish_reason") == "error":
+                ktp = None
+                break
+        await self._publish_result(
+            job["job_id"],
+            {"first_token": first_token, "kv_transfer_params": ktp})
+
+    async def _publish_result(self, job_id: str, result: dict) -> None:
+        # result under a short-lived lease: an unread result (decode
+        # worker died) must not accumulate forever
+        lease = await self.runtime.store.create_lease(self.result_ttl)
+        await self.runtime.store.put(
+            _result_key(self.namespace, self.queue_name, job_id),
+            json.dumps(result).encode(), lease_id=lease)
+
+
+class QueuePrefillClient:
+    """Runs on the decode worker: enqueue job, await its result key."""
+
+    def __init__(self, runtime, namespace: str = "dynamo",
+                 queue: str = PREFILL_QUEUE,
+                 timeout: float = 30.0) -> None:
+        self.runtime = runtime
+        self.namespace = namespace
+        self.queue_name = queue
+        self.timeout = timeout
+        self._queue = WorkQueue(runtime, queue, namespace)
+
+    async def prefill(self, prefill_req: dict, context=None
+                      ) -> Optional[tuple[int, dict]]:
+        """(first_token, kv_transfer_params), or None on timeout / error /
+        cancel — callers fall back to fully-local serving. A timed-out or
+        cancelled job is DELETED from the queue so no worker burns prefill
+        compute (and pins transfer pages) for a departed client."""
+        import secrets
+
+        job_id = secrets.token_hex(8)
+        item_id = await self._queue.enqueue({"job_id": job_id,
+                                             "request": prefill_req})
+        key = _result_key(self.namespace, self.queue_name, job_id)
+        # event-driven wait (no store-read busy loop): the watch fires on
+        # the result PUT; short wait slices let us notice cancellation
+        watch = await self.runtime.store.watch_prefix(key, replay=True)
+        deadline = asyncio.get_running_loop().time() + self.timeout
+        try:
+            while True:
+                if context is not None and context.is_cancelled():
+                    break
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    logger.warning("prefill queue result %s timed out",
+                                   job_id)
+                    break
+                try:
+                    ev = await asyncio.wait_for(
+                        watch.__anext__(), min(remaining, 0.25))
+                except asyncio.TimeoutError:
+                    continue
+                except StopAsyncIteration:
+                    break
+                if ev.kind != PUT or not ev.value:
+                    continue  # delete/expiry event
+                await self.runtime.store.delete(key)
+                result = json.loads(ev.value)
+                if result.get("kv_transfer_params") is None \
+                        or result.get("first_token") is None:
+                    return None
+                return int(result["first_token"]), \
+                    result["kv_transfer_params"]
+        finally:
+            watch.cancel()
+        # timeout/cancel: retract the job if nobody claimed it yet, and
+        # tombstone the result key so a consumer holding (or retrying)
+        # the job skips it instead of prefilling for a departed client
+        await self._queue.retract(item_id)
+        lease = await self.runtime.store.create_lease(60.0)
+        await self.runtime.store.put(
+            key, json.dumps({"cancelled": True}).encode(), lease_id=lease)
+        return None

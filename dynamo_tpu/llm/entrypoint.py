@@ -196,8 +196,15 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
         from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
 
         KvbmManager(engine, KvbmConfig(host_blocks=kvbm_host_blocks))
+    # a checkpoint without tokenizer files (weight-only export, random-
+    # init benchmarking) must not publish a card the frontend can't build
+    has_tok = any(os.path.exists(os.path.join(path, f)) for f in
+                  ("tokenizer.json", "tokenizer_config.json",
+                   "tokenizer.model"))
     card = ModelDeploymentCard(
         name=served_name or os.path.basename(path.rstrip("/")),
-        tokenizer_kind="hf", tokenizer_path=path, model_path=path,
+        tokenizer_kind="hf" if has_tok else "byte",
+        tokenizer_path=path if has_tok else "",
+        model_path=path,
         context_length=cfg.context_length, kv_block_size=cfg.page_size)
     return engine, card

@@ -108,6 +108,7 @@ class HttpService:
             web.get("/health", self._health),
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
+            web.get("/openapi.json", self._openapi),
         ])
         self._runner: Optional[web.AppRunner] = None
         m = manager.runtime.metrics.child("http")
@@ -477,6 +478,50 @@ class HttpService:
 
     async def _live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    async def _openapi(self, request: web.Request) -> web.Response:
+        """OpenAPI 3.1 description of the served surface (openapi_docs.rs
+        analog). Paths/methods are DERIVED from the live route table so
+        the spec cannot drift from what is actually served; the summary
+        map only decorates."""
+        summaries = {
+            "/v1/chat/completions": ("Chat completion (SSE when "
+                                     "stream=true)", True),
+            "/v1/completions": ("Text completion (SSE when stream=true)",
+                                True),
+            "/v1/embeddings": ("Embeddings", False),
+            "/v1/responses": ("Responses API (typed SSE events when "
+                              "stream=true)", True),
+            "/v1/models": ("Served models", False),
+            "/clear_kv_blocks": ("Drop every worker's reusable KV cache",
+                                 False),
+            "/health": ("Model-serving readiness", False),
+            "/live": ("Process liveness", False),
+            "/metrics": ("Prometheus metrics", False),
+            "/openapi.json": ("This document", False),
+        }
+        paths: dict[str, dict] = {}
+        for route in self.app.router.routes():
+            info = route.resource.canonical if route.resource else None
+            method = route.method.lower()
+            if info is None or method == "head":
+                continue
+            summary, streaming = summaries.get(info, (info, False))
+            op: dict = {"summary": summary,
+                        "responses": {"200": {"description": "OK"}}}
+            if method == "post":
+                op["requestBody"] = {"content": {"application/json": {
+                    "schema": {"type": "object"}}}}
+            if streaming:
+                op["responses"]["200"]["content"] = {
+                    "text/event-stream": {}, "application/json": {}}
+            paths.setdefault(info, {})[method] = op
+        return web.json_response({
+            "openapi": "3.1.0",
+            "info": {"title": "dynamo_tpu OpenAI-compatible API",
+                     "version": "1.0"},
+            "paths": paths,
+        })
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self.manager.runtime.metrics.render(),

@@ -160,8 +160,16 @@ class ModelWatcher:
         card = ModelDeploymentCard.from_json(value)
         if self.namespace is not None and card.namespace != self.namespace:
             return
+        try:
+            await self.manager.add_model(card, key)
+        except Exception:
+            # one broken card (bad tokenizer path, malformed config) must
+            # not take down discovery for every other model — the
+            # reference's watcher logs and skips too (watcher.rs)
+            logger.exception("failed to add model %s from %s; skipping",
+                             card.name, key)
+            return
         self._key_model[key] = card.name
-        await self.manager.add_model(card, key)
 
     async def stop(self) -> None:
         if self._watch is not None:

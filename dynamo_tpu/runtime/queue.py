@@ -64,6 +64,12 @@ class WorkQueue:
             json.dumps(payload, separators=(",", ":")).encode())
         return item_id
 
+    async def retract(self, item_id: str) -> None:
+        """Producer-side withdrawal of an item (e.g. the requester gave
+        up waiting). A claimed in-flight item is still cut short at its
+        consumer's ack (which deletes idempotently)."""
+        await self._store.delete(self._item_key(item_id))
+
     async def depth(self) -> int:
         """Unacked items (claimed + unclaimed)."""
         return len(await self._store.get_prefix(f"{self._prefix}items/"))
@@ -99,10 +105,28 @@ class WorkQueue:
         import asyncio
 
         deadline = (time.monotonic() + timeout) if timeout else None
-        while True:
-            item = await self.try_dequeue()
-            if item is not None or deadline is None:
-                return item
-            if time.monotonic() >= deadline:
-                return None
-            await asyncio.sleep(poll)
+        item = await self.try_dequeue()
+        if item is not None or deadline is None:
+            return item
+        # idle wait is EVENT-DRIVEN: a watch on the items prefix wakes us
+        # on enqueue instead of hammering the store with list scans
+        # (``poll`` bounds the re-check cadence for claim races)
+        watch = await self._store.watch_prefix(f"{self._prefix}items/",
+                                               replay=False)
+        try:
+            while True:
+                item = await self.try_dequeue()
+                if item is not None:
+                    return item
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(watch.__anext__(),
+                                           min(remaining, 60.0))
+                except asyncio.TimeoutError:
+                    continue
+                except StopAsyncIteration:
+                    await asyncio.sleep(poll)  # watch closed: degrade
+        finally:
+            watch.cancel()

@@ -42,6 +42,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--is-prefill-worker", action="store_true",
                    help="register under <component>_prefill and serve the "
                         "kv_pull transfer endpoint")
+    p.add_argument("--enable-disagg", action="store_true",
+                   help="decode side: orchestrate remote prefill against "
+                        "the <component>_prefill pool")
+    p.add_argument("--prefill-queue", action="store_true",
+                   help="disagg jobs ride the durable queue (pull model) "
+                        "instead of push routing; on prefill workers "
+                        "starts the queue consumer")
+    p.add_argument("--max-local-prefill-length", type=int, default=0,
+                   help="prompts at or below this (minus prefix hits) "
+                        "prefill locally even in disagg mode")
     p.add_argument("--migration-limit", type=int, default=0)
     p.add_argument("--router-mode", default="kv",
                    choices=["kv", "round_robin", "random"])
@@ -145,6 +155,49 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
     return engine, card
 
 
+class _Stoppable:
+    """Adapts a stop coroutine to the extra-handles shutdown protocol."""
+
+    def __init__(self, stop) -> None:
+        self._stop = stop
+
+    async def shutdown(self) -> None:
+        await self._stop()
+
+
+async def _build_decode_handler(rt, args, card, engine):
+    """Decode-side disagg wiring (vllm main.py init() analog): prefill
+    pool clients + threshold router + (optionally) the queue client."""
+    from dynamo_tpu.disagg.disagg_router import DisaggRouter
+    from dynamo_tpu.disagg.handlers import (
+        KV_PULL_ENDPOINT,
+        DecodeWorkerHandler,
+    )
+    from dynamo_tpu.runtime.push import PushRouter
+
+    pf_comp = args.component + "_prefill"
+    ns = card.namespace
+    pull_client = await (rt.namespace(ns).component(pf_comp)
+                         .endpoint(KV_PULL_ENDPOINT).client())
+    await pull_client.start()
+    dr = await DisaggRouter(
+        max_local_prefill_length=args.max_local_prefill_length
+    ).start_watch(rt, ns, args.component)
+    if args.prefill_queue:
+        from dynamo_tpu.disagg.prefill_queue import QueuePrefillClient
+
+        return DecodeWorkerHandler(
+            engine, kv_pull_router=PushRouter(pull_client),
+            disagg_router=dr,
+            prefill_queue_client=QueuePrefillClient(rt, ns))
+    gen_client = await (rt.namespace(ns).component(pf_comp)
+                        .endpoint(args.endpoint).client())
+    await gen_client.start()
+    return DecodeWorkerHandler(
+        engine, prefill_router=PushRouter(gen_client),
+        kv_pull_router=PushRouter(pull_client), disagg_router=dr)
+
+
 def _multinode_mesh(args: argparse.Namespace):
     """Global dp=1 x tp mesh over every chip of every node.
 
@@ -217,6 +270,16 @@ def main(argv=None) -> None:
             serving = handler
             extra.append(await serve_kv_pull(
                 rt, card.namespace, card.component, handler, instance_id))
+            if args.prefill_queue:
+                from dynamo_tpu.disagg.prefill_queue import (
+                    PrefillQueueConsumer,
+                )
+
+                consumer = PrefillQueueConsumer(
+                    rt, handler, card.namespace).start()
+                extra.append(_Stoppable(consumer.stop))
+        elif args.enable_disagg:
+            serving = await _build_decode_handler(rt, args, card, engine)
         if rt.health is not None:
             # persistent canary failure = wedged-but-alive worker: exit so
             # the lease drops and routers stop sending traffic (same exit

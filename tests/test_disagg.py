@@ -373,3 +373,136 @@ async def test_kv_pull_detects_reaped_transfer_mid_stream():
         await gen.aclose()
     finally:
         await eng.close()
+
+
+async def test_disagg_prefill_queue_mode():
+    """Pull-model disaggregation: the decode handler enqueues the prefill
+    job; a PrefillQueueConsumer on the prefill worker takes it; KV moves
+    over the usual pull path; output matches aggregated serving."""
+    from dynamo_tpu.disagg.prefill_queue import (
+        PrefillQueueConsumer,
+        QueuePrefillClient,
+    )
+
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    pe = make_engine(rng_seed=0)
+    de = make_engine(rng_seed=0)
+    p_handler = PrefillWorkerHandler(pe, instance_id=21)
+    ep_pull = rt.namespace("ns").component("pfq").endpoint(KV_PULL_ENDPOINT)
+    await ep_pull.serve(p_handler.kv_pull, instance_id=21)
+    pull_client = await ep_pull.client()
+    await pull_client.start()
+    await pull_client.wait_ready()
+
+    consumer = PrefillQueueConsumer(rt, p_handler, "ns").start()
+    handler = DecodeWorkerHandler(
+        de, kv_pull_router=PushRouter(pull_client),
+        disagg_router=DisaggRouter(max_local_prefill_length=0),
+        prefill_queue_client=QueuePrefillClient(rt, "ns", timeout=15.0))
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref
+        assert consumer.jobs_done == 1
+        assert pe.pool.active_pages == 0       # transfer released
+        # a second request exercises queue reuse
+        outs2 = [o async for o in handler.generate(
+            req(prompt, max_tokens=6), Context())]
+        toks2 = [t for o in outs2 for t in o.get("token_ids", ())]
+        assert toks2 == ref
+        assert consumer.jobs_done == 2
+    finally:
+        await consumer.stop()
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_prefill_queue_timeout_falls_back_local():
+    """No consumer running: the decode handler times out on the queue and
+    serves fully locally."""
+    from dynamo_tpu.disagg.prefill_queue import QueuePrefillClient
+
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    de = make_engine(rng_seed=0)
+    pe = make_engine(rng_seed=0)  # pull endpoint exists; queue has no consumer
+    p_handler = PrefillWorkerHandler(pe, instance_id=22)
+    ep_pull = rt.namespace("ns").component("pfq2").endpoint(KV_PULL_ENDPOINT)
+    await ep_pull.serve(p_handler.kv_pull, instance_id=22)
+    pull_client = await ep_pull.client()
+    await pull_client.start()
+    await pull_client.wait_ready()
+    handler = DecodeWorkerHandler(
+        de, kv_pull_router=PushRouter(pull_client),
+        disagg_router=DisaggRouter(max_local_prefill_length=0),
+        prefill_queue_client=QueuePrefillClient(rt, "ns", timeout=0.2))
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref                     # local fallback, same output
+    finally:
+        await rt.close()
+        await de.close()
+        await pe.close()
+
+
+async def test_prefill_queue_poison_job_retries_then_dead_letters():
+    """A job that always fails must not hot-loop at the queue head: it
+    retries at the tail up to max_attempts, then dead-letters an error
+    result so the decode side unblocks immediately."""
+    from dynamo_tpu.disagg.prefill_queue import (
+        PrefillQueueConsumer,
+        QueuePrefillClient,
+    )
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+
+    class BoomHandler:
+        calls = 0
+
+        async def generate(self, request, context):
+            BoomHandler.calls += 1
+            raise RuntimeError("poison")
+            yield {}
+
+    consumer = PrefillQueueConsumer(rt, BoomHandler(), "ns",
+                                    max_attempts=3).start()
+    client = QueuePrefillClient(rt, "ns", timeout=10.0)
+    try:
+        result = await client.prefill({"token_ids": [1, 2]})
+        assert result is None                  # dead-lettered error
+        assert BoomHandler.calls == 3          # bounded retries
+        assert consumer.jobs_failed == 1
+        from dynamo_tpu.runtime.queue import WorkQueue
+
+        assert await WorkQueue(rt, "prefill", "ns").depth() == 0
+    finally:
+        await consumer.stop()
+        await rt.close()
+
+
+async def test_prefill_queue_timeout_retracts_job():
+    """An unclaimed timed-out job is deleted — no consumer later burns
+    prefill compute for a departed client."""
+    from dynamo_tpu.disagg.prefill_queue import QueuePrefillClient
+    from dynamo_tpu.runtime.queue import WorkQueue
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        client = QueuePrefillClient(rt, "ns", timeout=0.2)
+        assert await client.prefill({"token_ids": [1]}) is None
+        assert await WorkQueue(rt, "prefill", "ns").depth() == 0
+    finally:
+        await rt.close()

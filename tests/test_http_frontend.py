@@ -378,3 +378,33 @@ async def test_responses_strips_reasoning_like_chat(monkeypatch):
         await fe.stop()
         await h.stop()
         await rt.close()
+
+
+async def test_openapi_docs_route():
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{fe.url}/openapi.json") as r:
+                assert r.status == 200
+                spec = await r.json()
+        assert spec["openapi"].startswith("3.")
+        for path in ("/v1/chat/completions", "/v1/embeddings",
+                     "/v1/responses", "/v1/models", "/clear_kv_blocks"):
+            assert path in spec["paths"], path
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_openapi_derives_from_route_table():
+    """The spec is built from the live router: every registered non-HEAD
+    route appears (no hand-maintained parallel list to drift)."""
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{fe.url}/openapi.json") as r:
+                spec = await r.json()
+        served = {r.resource.canonical
+                  for r in fe.http.app.router.routes() if r.resource}
+        assert served == set(spec["paths"])
+    finally:
+        await teardown_stack(rt, fe, hs, es)

@@ -121,7 +121,9 @@ async def test_engine_serves_loaded_checkpoint(checkpoint):
         decode_steps_per_sync=2, dtype=jnp.float32, page_size=8,
         max_pages_per_seq=8)
     try:
-        assert card.model_path == path and card.tokenizer_kind == "hf"
+        # fixture has no tokenizer files: the card must fall back to the
+        # byte tokenizer, NOT publish an hf path the frontend can't build
+        assert card.model_path == path and card.tokenizer_kind == "byte"
         prompt = [5, 9, 23, 51, 3, 78, 12, 34]
         n_new = 6
         with torch.no_grad():
@@ -136,3 +138,19 @@ async def test_engine_serves_loaded_checkpoint(checkpoint):
         assert got == ref
     finally:
         await engine.close()
+
+
+def test_card_uses_hf_tokenizer_when_files_exist(checkpoint, tmp_path):
+    import shutil
+
+    from dynamo_tpu.llm.entrypoint import build_tpu_engine
+
+    path, _ = checkpoint
+    ckpt2 = tmp_path / "with-tok"
+    shutil.copytree(path, ckpt2)
+    (ckpt2 / "tokenizer_config.json").write_text("{}")
+    engine, card = build_tpu_engine(
+        str(ckpt2), served_name="t2", num_pages=32, max_batch_size=2,
+        random_init=True, page_size=8, max_pages_per_seq=8)
+    assert card.tokenizer_kind == "hf"
+    assert card.tokenizer_path == str(ckpt2)

@@ -179,6 +179,7 @@ class QueuePrefillClient:
         watch = await self.runtime.store.watch_prefix(key, replay=True)
         deadline = asyncio.get_running_loop().time() + self.timeout
         try:
+          try:
             while True:
                 if context is not None and context.is_cancelled():
                     break
@@ -203,13 +204,26 @@ class QueuePrefillClient:
                     return None
                 return int(result["first_token"]), \
                     result["kv_transfer_params"]
+          except asyncio.CancelledError:
+            # hard cancel (client task torn down): still retract the job
+            # — shielded, or the cleanup awaits would be cancelled too
+            try:
+                await asyncio.shield(self._retract(item_id, key))
+            except Exception:
+                pass
+            raise
         finally:
             watch.cancel()
-        # timeout/cancel: retract the job if nobody claimed it yet, and
-        # tombstone the result key so a consumer holding (or retrying)
-        # the job skips it instead of prefilling for a departed client
+        # timeout / cooperative cancel: retract + tombstone
+        await self._retract(item_id, key)
+        return None
+
+    async def _retract(self, item_id: str, result_key: str) -> None:
+        """Withdraw an abandoned job AND tombstone its result key so a
+        consumer holding (or retrying) it skips instead of prefilling
+        for a departed client."""
         await self._queue.retract(item_id)
         lease = await self.runtime.store.create_lease(60.0)
         await self.runtime.store.put(
-            key, json.dumps({"cancelled": True}).encode(), lease_id=lease)
-        return None
+            result_key, json.dumps({"cancelled": True}).encode(),
+            lease_id=lease)

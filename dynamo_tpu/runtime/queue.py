@@ -108,10 +108,11 @@ class WorkQueue:
         item = await self.try_dequeue()
         if item is not None or deadline is None:
             return item
-        # idle wait is EVENT-DRIVEN: a watch on the items prefix wakes us
-        # on enqueue instead of hammering the store with list scans
-        # (``poll`` bounds the re-check cadence for claim races)
-        watch = await self._store.watch_prefix(f"{self._prefix}items/",
+        # idle wait is EVENT-DRIVEN: a watch on the QUEUE prefix wakes us
+        # on enqueue AND on claim releases (nack / dead-consumer lease
+        # expiry deletes under claims/) — watching only items/ would
+        # stall redelivery until the 60s backstop
+        watch = await self._store.watch_prefix(self._prefix,
                                                replay=False)
         try:
             while True:

@@ -189,7 +189,8 @@ async def _build_decode_handler(rt, args, card, engine):
         return DecodeWorkerHandler(
             engine, kv_pull_router=PushRouter(pull_client),
             disagg_router=dr,
-            prefill_queue_client=QueuePrefillClient(rt, ns))
+            prefill_queue_client=QueuePrefillClient(rt, ns,
+                                                    queue=pf_comp))
     gen_client = await (rt.namespace(ns).component(pf_comp)
                         .endpoint(args.endpoint).client())
     await gen_client.start()
@@ -275,8 +276,12 @@ def main(argv=None) -> None:
                     PrefillQueueConsumer,
                 )
 
+                # queue scoped like the push path's component pool: two
+                # models in one namespace must never steal each other's
+                # prefill jobs (wrong weights + unpullable KV)
                 consumer = PrefillQueueConsumer(
-                    rt, handler, card.namespace).start()
+                    rt, handler, card.namespace,
+                    queue=card.component).start()
                 extra.append(_Stoppable(consumer.stop))
         elif args.enable_disagg:
             serving = await _build_decode_handler(rt, args, card, engine)

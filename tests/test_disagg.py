@@ -506,3 +506,57 @@ async def test_prefill_queue_timeout_retracts_job():
         assert await WorkQueue(rt, "prefill", "ns").depth() == 0
     finally:
         await rt.close()
+
+
+async def test_prefill_queue_hard_cancel_retracts():
+    """Review regression: a hard task cancel (client disconnect) must
+    still retract + tombstone the queued job."""
+    import asyncio as _aio
+
+    from dynamo_tpu.disagg.prefill_queue import QueuePrefillClient
+    from dynamo_tpu.runtime.queue import WorkQueue
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        client = QueuePrefillClient(rt, "ns", timeout=30.0)
+        task = _aio.get_running_loop().create_task(
+            client.prefill({"token_ids": [1]}))
+        await _aio.sleep(0.1)      # job enqueued, waiting on result
+        assert await WorkQueue(rt, "prefill", "ns").depth() == 1
+        task.cancel()
+        try:
+            await task
+        except _aio.CancelledError:
+            pass
+        assert await WorkQueue(rt, "prefill", "ns").depth() == 0
+    finally:
+        await rt.close()
+
+
+async def test_queue_redelivery_wakes_idle_dequeuer():
+    """Review regression: an idle dequeue() must wake on a claim RELEASE
+    (dead-consumer lease expiry), not only on new enqueues."""
+    import asyncio as _aio
+
+    from dynamo_tpu.runtime.queue import WorkQueue
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        q = WorkQueue(rt, "rq")
+        await q.enqueue("job")
+
+        class DeadRt:
+            store = rt.store
+            lease_id = 0
+
+        DeadRt.lease_id = await rt.store.create_lease(0.3)
+        dead = WorkQueue(DeadRt, "rq")
+        assert (await dead.try_dequeue()) is not None   # claimed, dies
+        t0 = _aio.get_running_loop().time()
+        item = await q.dequeue(timeout=10.0)            # idle waiter
+        waited = _aio.get_running_loop().time() - t0
+        assert item is not None and item.payload == "job"
+        assert waited < 5.0       # woke on claim expiry, not 60s backstop
+        await item.ack()
+    finally:
+        await rt.close()

@@ -44,6 +44,7 @@ class ModelDeploymentCard:
     router_mode: str = "kv"         # kv | round_robin | random
     tool_call_parser: str = ""      # see dynamo_tpu.parsers (hermes, ...)
     reasoning_parser: str = ""      # basic | deepseek_r1 | granite | ...
+    encode_component: str = ""      # multimodal encode-worker component
     runtime_config: ModelRuntimeConfig = field(
         default_factory=ModelRuntimeConfig)
 

@@ -29,12 +29,21 @@ logger = logging.getLogger(__name__)
 
 class ModelEntry:
     def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine,
-                 kv_router: Optional[KvPushRouter], client) -> None:
+                 kv_router: Optional[KvPushRouter], client,
+                 encode_client=None) -> None:
         self.card = card
         self.engine = engine
         self.kv_router = kv_router
         self.client = client
+        self.encode_client = encode_client
         self.card_keys: set[str] = set()
+
+    async def stop_clients(self) -> None:
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+        await self.client.stop()
+        if self.encode_client is not None:
+            await self.encode_client.stop()
 
 
 class ModelManager:
@@ -86,15 +95,27 @@ class ModelManager:
         else:
             router_engine = PushRouter(client, mode=router_mode)
         tokenizer = make_tokenizer(card.tokenizer_kind, card.tokenizer_path)
+        encode_router = None
+        if card.encode_component:
+            from dynamo_tpu.multimodal.worker import ENCODE_ENDPOINT
+
+            enc_client = await (rt.namespace(card.namespace)
+                                .component(card.encode_component)
+                                .endpoint(ENCODE_ENDPOINT).client())
+            await enc_client.start()
+            encode_router = PushRouter(enc_client)
         engine = build_pipeline(
             OpenAIPreprocessor(tokenizer, card.name, card.context_length,
                                tool_call_parser=card.tool_call_parser,
-                               reasoning_parser=card.reasoning_parser),
+                               reasoning_parser=card.reasoning_parser,
+                               encode_router=encode_router),
             Backend(tokenizer),
             Migration(card.migration_limit),
             sink=router_engine,
         )
-        entry = ModelEntry(card, engine, kv_router, client)
+        entry = ModelEntry(card, engine, kv_router, client,
+                           encode_client=encode_router.client
+                           if encode_router is not None else None)
         entry.card_keys.add(card_key)
         self._models[card.name] = entry
         logger.info("model added: %s (router=%s)", card.name, card.router_mode)
@@ -108,17 +129,13 @@ class ModelManager:
         if entry.card_keys:
             return  # other workers still serve this model
         del self._models[model]
-        if entry.kv_router is not None:
-            await entry.kv_router.stop()
-        await entry.client.stop()
+        await entry.stop_clients()
         logger.info("model removed: %s", model)
 
     async def close(self) -> None:
         for name in list(self._models):
             entry = self._models.pop(name)
-            if entry.kv_router is not None:
-                await entry.kv_router.stop()
-            await entry.client.stop()
+            await entry.stop_clients()
 
 
 class ModelWatcher:

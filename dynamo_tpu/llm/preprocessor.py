@@ -65,7 +65,8 @@ class OpenAIPreprocessor(Operator):
                  context_length: int = 0,
                  default_max_tokens: int = 1024,
                  tool_call_parser: str = "",
-                 reasoning_parser: str = "") -> None:
+                 reasoning_parser: str = "",
+                 encode_router=None) -> None:
         super().__init__()
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -73,15 +74,99 @@ class OpenAIPreprocessor(Operator):
         self.default_max_tokens = default_max_tokens
         self.tool_call_parser = tool_call_parser
         self.reasoning_parser = reasoning_parser
+        # multimodal: AsyncEngine routing to encode workers; image parts
+        # become discrete tokens spliced into the prompt (multimodal/)
+        self.encode_router = encode_router
 
     # -- request path -------------------------------------------------------
 
-    def preprocess_chat(self, req: ChatCompletionRequest
+    def preprocess_chat(self, req: ChatCompletionRequest,
+                        image_tokens: Optional[dict] = None
                         ) -> PreprocessedRequest:
         prompt = render_chat_template(self.tokenizer, req.messages)
+        if image_tokens:
+            # markers were injected by _resolve_images; text between them
+            # tokenizes normally, image token runs splice in verbatim
+            ids: list[int] = []
+            rest = prompt
+            for marker, toks in image_tokens.items():
+                before, sep, rest = rest.partition(marker)
+                if not sep:
+                    # a chat template that stringifies list content (repr
+                    # escapes the marker) would otherwise dump the image
+                    # tokens after the generation suffix — corrupt prompt
+                    raise OpenAIError(
+                        "the model's chat template dropped the image "
+                        "placeholder; this template does not support "
+                        "multimodal content parts")
+                ids.extend(self.tokenizer.encode(before) if before else [])
+                ids.extend(toks)
+            if rest:
+                ids.extend(self.tokenizer.encode(rest))
+        else:
+            ids = self.tokenizer.encode(prompt)
         return self._finish_preprocess(
-            prompt_ids=self.tokenizer.encode(prompt),
+            prompt_ids=ids,
             sampling=req.sampling_options(), stop=req.stop_conditions())
+
+    async def _resolve_images(self, messages: list[dict], context: Context
+                              ) -> tuple[list[dict], dict]:
+        """Replace image parts with unique markers; encode each image via
+        the encode workers (sglang processor→encode analog). Returns
+        (rewritten messages, {marker: image token ids}) — empty when the
+        request has no images."""
+        import asyncio
+
+        image_tokens: dict[str, list[int]] = {}
+        out_messages: list[dict] = []
+        jobs: list[tuple[str, str]] = []     # (marker, url)
+        idx = 0
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                out_messages.append(m)
+                continue
+            parts = []
+            for part in content:
+                if not (isinstance(part, dict)
+                        and part.get("type") == "image_url"):
+                    parts.append(part)
+                    continue
+                url = (part.get("image_url") or {}).get("url", "")
+                if self.encode_router is None:
+                    raise OpenAIError(
+                        "this deployment has no encode workers: image "
+                        "inputs are not supported for "
+                        f"{self.model_name!r}")
+                if not url.startswith("data:"):
+                    raise OpenAIError(
+                        "only data: image URLs are supported "
+                        "(no egress to fetch remote images)")
+                marker = f"\x00dyn_image_{idx}\x00"
+                idx += 1
+                jobs.append((marker, url))
+                parts.append({"type": "text", "text": marker})
+            out_messages.append({**m, "content": parts})
+
+        async def encode_one(url: str) -> list[int]:
+            toks = None
+            async for resp in self.encode_router.generate(
+                    {"image": url}, context):
+                if resp.get("error"):
+                    raise OpenAIError(
+                        f"image encode failed: {resp['error']}")
+                if resp.get("image_tokens") is not None:
+                    toks = [int(t) for t in resp["image_tokens"]]
+            if toks is None:
+                raise OpenAIError("encode worker returned no tokens")
+            return toks
+
+        # images are independent: fan out across the encode workers
+        results = await asyncio.gather(
+            *(encode_one(url) for _, url in jobs))
+        for (marker, _), toks in zip(jobs, results):
+            image_tokens[marker] = toks
+        return out_messages, image_tokens
 
     def preprocess_completion(self, req: CompletionRequest
                               ) -> PreprocessedRequest:
@@ -126,7 +211,12 @@ class OpenAIPreprocessor(Operator):
             return
         if kind == KIND_CHAT:
             oai = ChatCompletionRequest.from_dict(request["body"])
-            pre = self.preprocess_chat(oai)
+            image_tokens: dict = {}
+            if any(isinstance(m.get("content"), list)
+                   for m in oai.messages):
+                oai.messages, image_tokens = await self._resolve_images(
+                    oai.messages, context)
+            pre = self.preprocess_chat(oai, image_tokens)
             request_id = request.get("request_id") or new_request_id()
             async for chunk in self._postprocess_chat(
                     pre, oai, request_id, created, context):

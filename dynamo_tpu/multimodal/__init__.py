@@ -1,0 +1,33 @@
+"""Multimodal serving: the encode→prefill→decode worker trio.
+
+Reference: `examples/multimodal` + the sglang multimodal handlers
+(`components/src/dynamo/sglang/`: processor → encode worker →
+prefill/decode, embeddings moved via NIXL). TPU-native shape: the encode
+worker runs a jitted patch encoder that VECTOR-QUANTIZES image patches
+into DISCRETE tokens from a reserved vocab range — image content then
+rides the exact same token path as text (router prefix hashing, paged
+KV, disagg, migration all work unchanged), and the only thing crossing
+workers is a short token list instead of a giant embedding tensor.
+"""
+
+from dynamo_tpu.multimodal.encoder import (
+    ImageEncoderConfig,
+    encode_image_tokens,
+    init_encoder_params,
+    load_image,
+)
+from dynamo_tpu.multimodal.worker import (
+    ENCODE_ENDPOINT,
+    EncodeWorkerHandler,
+    serve_encode_worker,
+)
+
+__all__ = [
+    "ImageEncoderConfig",
+    "encode_image_tokens",
+    "init_encoder_params",
+    "load_image",
+    "ENCODE_ENDPOINT",
+    "EncodeWorkerHandler",
+    "serve_encode_worker",
+]

@@ -1,0 +1,82 @@
+"""Jitted image → discrete-token encoder (the encode worker's compute).
+
+A ViT-style patchify + projection followed by vector quantization against
+a fixed codebook: two MXU matmuls and an argmin, one jit, static shapes.
+With random orthogonal-ish weights the codes are content-deterministic
+(same image ⇒ same tokens ⇒ router prefix-cache hits on repeated
+images), which is what the serving plumbing needs; swapping in trained
+encoder weights changes fidelity, not the pipeline.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageEncoderConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    embed_dim: int = 256
+    codebook_size: int = 1024
+    # image tokens are emitted as vocab_offset + code so the LM treats
+    # them as ordinary (reserved-range) token ids
+    vocab_offset: int = 0
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def init_encoder_params(rng: jax.Array,
+                        cfg: ImageEncoderConfig) -> dict:
+    k1, k2 = jax.random.split(rng)
+    scale = 1.0 / np.sqrt(cfg.patch_dim)
+    return {
+        "proj": jax.random.normal(
+            k1, (cfg.patch_dim, cfg.embed_dim), jnp.float32) * scale,
+        "codebook": jax.random.normal(
+            k2, (cfg.codebook_size, cfg.embed_dim), jnp.float32),
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_image_tokens(params: dict, image: jax.Array,
+                        cfg: ImageEncoderConfig) -> jax.Array:
+    """image (S, S, 3) float32 in [0,1] → (num_patches,) int32 tokens."""
+    s, p = cfg.image_size, cfg.patch_size
+    n = s // p
+    patches = image.reshape(n, p, n, p, 3).transpose(0, 2, 1, 3, 4)
+    patches = patches.reshape(cfg.num_patches, cfg.patch_dim)
+    patches = patches - patches.mean(axis=-1, keepdims=True)
+    emb = patches @ params["proj"]                      # (N, E)  MXU
+    # nearest codebook entry by L2: argmin ||e - c||² expands to the
+    # matmul form (no (N, C, E) broadcast materialized)
+    dots = emb @ params["codebook"].T                   # (N, C)  MXU
+    c2 = jnp.sum(params["codebook"] ** 2, axis=-1)      # (C,)
+    codes = jnp.argmin(c2[None, :] - 2.0 * dots, axis=-1)
+    return (codes + cfg.vocab_offset).astype(jnp.int32)
+
+
+def load_image(data: bytes | str, cfg: ImageEncoderConfig) -> np.ndarray:
+    """PNG/JPEG bytes (or a base64/data-URL string) → (S, S, 3) f32."""
+    from PIL import Image
+
+    if isinstance(data, str):
+        if data.startswith("data:"):
+            data = data.split(",", 1)[1]
+        data = base64.b64decode(data)
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    img = img.resize((cfg.image_size, cfg.image_size))
+    return np.asarray(img, dtype=np.float32) / 255.0

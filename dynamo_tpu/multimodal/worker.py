@@ -1,0 +1,68 @@
+"""Encode worker: serves images → discrete image tokens over the runtime.
+
+The sglang encode-worker analog (`components/src/dynamo/sglang/` trio);
+the preprocessor calls it per image part and splices the returned tokens
+into the prompt, so prefill/decode workers stay modality-blind.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional
+
+import jax
+
+from dynamo_tpu.multimodal.encoder import (
+    ImageEncoderConfig,
+    encode_image_tokens,
+    init_encoder_params,
+    load_image,
+)
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+ENCODE_ENDPOINT = "encode"
+
+
+class EncodeWorkerHandler:
+    """{"image": <b64/data-url>} → {"image_tokens": [...]}."""
+
+    def __init__(self, cfg: Optional[ImageEncoderConfig] = None,
+                 rng_seed: int = 0) -> None:
+        self.cfg = cfg or ImageEncoderConfig()
+        self.params = init_encoder_params(
+            jax.random.PRNGKey(rng_seed), self.cfg)
+
+    async def generate(self, request: dict, context: Context
+                       ) -> AsyncIterator[dict]:
+        data = request.get("image")
+        if not data:
+            yield {"error": "missing 'image' (base64 or data URL)"}
+            return
+
+        def run():
+            img = load_image(data, self.cfg)
+            return encode_image_tokens(
+                self.params, jax.numpy.asarray(img), self.cfg)
+
+        try:
+            tokens = await asyncio.to_thread(run)
+        except Exception as e:
+            logger.warning("image decode/encode failed: %r", e)
+            yield {"error": f"bad image: {e!r}"}
+            return
+        yield {"image_tokens": [int(t) for t in tokens],
+               "num_patches": self.cfg.num_patches}
+
+
+async def serve_encode_worker(runtime, namespace: str = "dynamo",
+                              component: str = "encoder",
+                              instance_id: Optional[int] = None,
+                              cfg: Optional[ImageEncoderConfig] = None):
+    """Register the encode endpoint; returns the ServedEndpoint."""
+    handler = EncodeWorkerHandler(cfg)
+    ep = (runtime.namespace(namespace).component(component)
+          .endpoint(ENCODE_ENDPOINT))
+    return await ep.serve(handler.generate, instance_id=instance_id)

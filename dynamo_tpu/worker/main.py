@@ -36,6 +36,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                      help="serve the mocker engine (no chips needed)")
     eng.add_argument("--echo", action="store_true",
                      help="serve the token-echo engine")
+    eng.add_argument("--encode-worker", action="store_true",
+                     help="serve the multimodal image-encode endpoint "
+                          "(no LM; the sglang encode-worker analog)")
+    p.add_argument("--image-vocab-offset", type=int, default=128256,
+                   help="encode worker: image tokens start here")
+    p.add_argument("--encode-component", default="",
+                   help="LM workers: enable image inputs via this "
+                        "encode-worker component")
     p.add_argument("--served-model-name", default=None)
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
@@ -103,7 +111,8 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
             name=name, namespace=args.namespace, component=component,
             endpoint=args.endpoint, tokenizer_kind="word",
             tokenizer_path=name, migration_limit=args.migration_limit,
-            router_mode=args.router_mode)
+            router_mode=args.router_mode,
+            encode_component=args.encode_component)
         engine = MockEngine(
             MockEngineConfig(
                 block_size=card.kv_block_size,
@@ -153,6 +162,14 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         engine.pool.event_sink = event_sink
         engine.metrics_sink = metrics_sink
     return engine, card
+
+
+class _NullMonitor:
+    def start(self):
+        return self
+
+    def stop(self):
+        pass
 
 
 class _Stoppable:
@@ -254,6 +271,27 @@ def main(argv=None) -> None:
 
         cfg = runtime_config_from_args(args)
         rt = await DistributedRuntime.create(cfg)
+        if args.encode_worker:
+            from dynamo_tpu.multimodal import (
+                ImageEncoderConfig,
+                serve_encode_worker,
+            )
+
+            comp = ("encoder" if args.component == "backend"
+                    else args.component)  # default is LM-centric
+            served = await serve_encode_worker(
+                rt, args.namespace, comp,
+                instance_id=args.instance_id,
+                cfg=ImageEncoderConfig(
+                    vocab_offset=args.image_vocab_offset))
+            print(f"WORKER_READY {args.namespace}/{comp}/encode/"
+                  f"{served.instance.instance_id:x}", flush=True)
+
+            class _H:  # adapts ServedEndpoint to the handle protocol
+                async def stop(self):
+                    await served.shutdown()
+
+            return rt, None, _H(), [], _NullMonitor()
         # card needs the final component name before sinks are wired
         probe_component = args.component + (
             "_prefill" if args.is_prefill_worker else "")

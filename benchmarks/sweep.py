@@ -1,0 +1,142 @@
+"""aiperf-style load sweep against any OpenAI-compatible endpoint.
+
+Reference: `benchmarks/` (aiperf wrapper + sweep configs,
+`benchmarks/README.md:17-40`): drive a served deployment across a
+concurrency ladder with synthetic prompts of a given ISL/OSL, and report
+per-level TTFT/ITL percentiles + aggregate throughput — the numbers the
+SLA planner's interpolators and the Pareto plots consume.
+
+Usage:
+    python -m benchmarks.sweep --url http://HOST:8080 --model NAME \
+        --isl 96 --osl 64 --concurrency 1,4,16 --requests 32
+Prints one JSON line per level and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+
+
+def make_prompt(rng: random.Random, isl: int) -> str:
+    # distinct word-ish prompts: no cross-request prefix-cache hits
+    return " ".join(f"w{rng.randrange(1 << 20):x}" for _ in range(isl))
+
+
+async def one_request(session, url: str, model: str, prompt: str,
+                      osl: int) -> dict:
+    """Streamed completion; returns timing + token counts."""
+    t0 = time.perf_counter()
+    first = None
+    deltas: list[float] = []
+    last = None
+    n_chunks = 0
+    body = {"model": model, "prompt": prompt, "stream": True,
+            "max_tokens": osl, "ignore_eos": True}
+    async with session.post(f"{url}/v1/completions", json=body) as resp:
+        if resp.status != 200:
+            return {"error": resp.status}
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            now = time.perf_counter()
+            chunk = json.loads(line[6:])
+            if any(c.get("text") for c in chunk.get("choices", ())):
+                if first is None:
+                    first = now
+                elif last is not None:
+                    deltas.append(now - last)
+                last = now
+                n_chunks += 1
+    return {"ttft": (first - t0) if first else None,
+            "itls": deltas, "duration": time.perf_counter() - t0,
+            "chunks": n_chunks}
+
+
+def pct(xs: list[float], p: float) -> float:
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+async def run_level(url: str, model: str, concurrency: int,
+                    n_requests: int, isl: int, osl: int,
+                    seed: int = 0) -> dict:
+    import aiohttp
+
+    rng = random.Random(seed)
+    prompts = [make_prompt(rng, isl) for _ in range(n_requests)]
+    sem = asyncio.Semaphore(concurrency)
+    results: list[dict] = []
+
+    async with aiohttp.ClientSession() as session:
+        async def bounded(p):
+            async with sem:
+                results.append(await one_request(session, url, model,
+                                                 p, osl))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(bounded(p) for p in prompts))
+        wall = time.perf_counter() - t0
+
+    ok = [r for r in results if "error" not in r and r["ttft"]]
+    errors = len(results) - len(ok)
+    ttfts = [r["ttft"] for r in ok]
+    itls = [d for r in ok for d in r["itls"]]
+    total_tokens = len(ok) * osl
+    return {
+        "concurrency": concurrency, "requests": n_requests,
+        "errors": errors, "isl": isl, "osl": osl,
+        "output_tok_s": round(total_tokens / wall, 1),
+        "req_s": round(len(ok) / wall, 2),
+        "ttft_p50_ms": round(pct(ttfts, 0.5) * 1e3, 1),
+        "ttft_p95_ms": round(pct(ttfts, 0.95) * 1e3, 1),
+        "itl_p50_ms": round(pct(itls, 0.5) * 1e3, 2),
+        "itl_p95_ms": round(pct(itls, 0.95) * 1e3, 2),
+        "duration_s": round(wall, 2),
+    }
+
+
+async def sweep(url: str, model: str, levels: list[int], n_requests: int,
+                isl: int, osl: int) -> list[dict]:
+    out = []
+    for i, conc in enumerate(levels):
+        row = await run_level(url, model, conc, n_requests, isl, osl,
+                              seed=i)
+        print(json.dumps(row), flush=True)
+        out.append(row)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m benchmarks.sweep")
+    p.add_argument("--url", required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--isl", type=int, default=96)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--concurrency", default="1,4,16",
+                   help="comma-separated ladder")
+    p.add_argument("--requests", type=int, default=32,
+                   help="requests per level")
+    p.add_argument("--output", default=None, help="write JSONL here too")
+    args = p.parse_args(argv)
+    levels = [int(x) for x in args.concurrency.split(",") if x]
+    rows = asyncio.run(sweep(args.url, args.model, levels, args.requests,
+                             args.isl, args.osl))
+    best = max(rows, key=lambda r: r["output_tok_s"])
+    print(json.dumps({"summary": "best_throughput", **best}), flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    return 0 if all(r["errors"] == 0 for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

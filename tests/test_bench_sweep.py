@@ -1,0 +1,51 @@
+"""aiperf-style sweep tool against a live mocker deployment."""
+
+import json
+import os
+import subprocess
+import sys
+
+from tests.test_http_frontend import setup_stack, teardown_stack
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+async def test_sweep_levels_against_mocker():
+    from benchmarks.sweep import run_level, sweep
+
+    rt, fe, hs, es = await setup_stack()
+    try:
+        rows = await sweep(fe.url, "mock-model", [1, 4], n_requests=6,
+                           isl=24, osl=8)
+        assert len(rows) == 2
+        for row in rows:
+            assert row["errors"] == 0
+            assert row["output_tok_s"] > 0
+            assert row["ttft_p50_ms"] > 0
+            assert row["itl_p50_ms"] >= 0
+        # more concurrency must not reduce counted requests
+        assert all(r["requests"] == 6 for r in rows)
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_sweep_cli_process():
+    """The real CLI drives a live frontend and exits 0."""
+    rt, fe, hs, es = await setup_stack()
+    try:
+        import asyncio
+
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "benchmarks.sweep",
+            "--url", fe.url, "--model", "mock-model",
+            "--isl", "16", "--osl", "4", "--concurrency", "2",
+            "--requests", "4",
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.PIPE)
+        out, _ = await asyncio.wait_for(proc.communicate(), 120)
+        assert proc.returncode == 0, out.decode()
+        lines = [json.loads(l) for l in out.decode().splitlines()]
+        assert lines[-1]["summary"] == "best_throughput"
+        assert lines[0]["concurrency"] == 2 and lines[0]["errors"] == 0
+    finally:
+        await teardown_stack(rt, fe, hs, es)

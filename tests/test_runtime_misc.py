@@ -60,3 +60,51 @@ async def test_context_cancellation_tree():
     assert child.is_cancelled()
     assert not root.is_cancelled()  # cancel never propagates up
     await asyncio.wait_for(grandchild.wait_cancelled(), 1)
+
+
+async def test_status_server_config_dump(monkeypatch):
+    """/config reports effective runtime config + DYN_* env + versions
+    (common/config_dump analog)."""
+    import aiohttp
+
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    monkeypatch.setenv("DYN_TEST_FLAG", "42")
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory", system_port=0,
+                      health_check_interval=7.5))
+    try:
+        port = rt._status_server.port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/config") as r:
+                assert r.status == 200
+                dump = await r.json()
+        assert dump["runtime_config"]["health_check_interval"] == 7.5
+        assert dump["env"]["DYN_TEST_FLAG"] == "42"
+        assert dump["versions"]["jax"]
+    finally:
+        await rt.close()
+
+
+async def test_config_dump_redacts_secrets(monkeypatch):
+    import aiohttp
+
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    monkeypatch.setenv("DYN_API_TOKEN", "supersecret")
+    monkeypatch.setenv("DYN_STORE_URL", "tcp://user:pw@host:1")
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory", system_port=0))
+    try:
+        port = rt._status_server.port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/config") as r:
+                dump = await r.json()
+                raw = await r.text() if False else ""
+        assert dump["env"]["DYN_API_TOKEN"] == "[redacted]"
+        assert "pw@" not in dump["env"]["DYN_STORE_URL"]
+        assert "supersecret" not in str(dump)
+    finally:
+        await rt.close()

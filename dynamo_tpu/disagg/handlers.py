@@ -271,6 +271,7 @@ class DecodeWorkerHandler:
         prefill_req["stop"] = stop
         prefill_req["kv_transfer_params"] = {"do_remote_decode": True}
         first_token: Optional[int] = None
+        first_lp: Optional[float] = None
         ktp: Optional[dict] = None
         if self.prefill_queue_client is not None:
             try:
@@ -283,12 +284,15 @@ class DecodeWorkerHandler:
                 result = None
             if result is not None:
                 first_token, ktp = result
+                first_lp = (ktp or {}).pop("first_token_logprob", None)
         else:
             try:
                 async for out in self.prefill_router.generate(
                         prefill_req, context):
                     if out.get("token_ids"):
                         first_token = out["token_ids"][0]
+                        if out.get("log_probs"):
+                            first_lp = out["log_probs"][0]
                     if out.get("kv_transfer_params"):
                         ktp = out["kv_transfer_params"]
                     if out.get("finish_reason") == "error":
@@ -313,18 +317,26 @@ class DecodeWorkerHandler:
 
         # --- 3. stream the prefill token, then local decode with the
         #        imported cache ---
+        def first_frame(**kw) -> dict:
+            out = {"token_ids": [first_token], **kw}
+            if first_lp is not None:
+                # the remote prefill computed this token's logprob; a
+                # logprobs client must see N logprobs for N tokens
+                out["log_probs"] = [first_lp]
+            return out
+
         orig_stop = request.get("stop") or {}
         if orig_stop.get("max_tokens") == 1:
             # no decode needed; the pulled KV is simply dropped
-            yield {"token_ids": [first_token], "finish_reason": "length"}
+            yield first_frame(finish_reason="length")
             return
         if first_token in (orig_stop.get("stop_token_ids") or ()) \
                 and (orig_stop.get("min_tokens") or 0) <= 1:
             # min_tokens suppresses the stop exactly like the local path's
             # _emit_token (generated=1 here)
-            yield {"token_ids": [first_token], "finish_reason": "stop"}
+            yield first_frame(finish_reason="stop")
             return
-        yield {"token_ids": [first_token]}
+        yield first_frame()
         decode_req = dict(request)
         decode_req["token_ids"] = token_ids + [first_token]
         stop = dict(decode_req.get("stop") or {})

@@ -128,15 +128,22 @@ class PrefillQueueConsumer:
             return
         request = job["request"]
         first_token = None
+        first_lp = None
         ktp = None
         async for out in self.handler.generate(request, Context()):
             if out.get("token_ids"):
                 first_token = out["token_ids"][0]
+                if out.get("log_probs"):
+                    first_lp = out["log_probs"][0]
             if out.get("kv_transfer_params"):
                 ktp = out["kv_transfer_params"]
             if out.get("finish_reason") == "error":
                 ktp = None
                 break
+        if ktp is not None and first_lp is not None:
+            # ride the transfer params so the decode side can surface N
+            # logprobs for N tokens (the first came from remote prefill)
+            ktp = {**ktp, "first_token_logprob": first_lp}
         await self._publish_result(
             job["job_id"],
             {"first_token": first_token, "kv_transfer_params": ktp})

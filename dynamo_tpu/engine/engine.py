@@ -29,7 +29,7 @@ import jax
 import numpy as np
 
 from dynamo_tpu.engine.pages import PagePool
-from dynamo_tpu.engine.sampling import sample_tokens
+from dynamo_tpu.engine.sampling import sample_tokens_lp
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     decode_multi_step,
@@ -468,7 +468,7 @@ class TpuEngine:
                 vals += [vals[0]] * (width - len(pending))
                 return np.asarray(vals, dtype=dtype)
 
-            sampled = sample_tokens(
+            sampled = sample_tokens_lp(
                 jax.numpy.stack(stack),
                 arr(lambda s: s.seed, np.uint32),
                 arr(lambda s: s.generated, np.uint32),
@@ -478,8 +478,10 @@ class TpuEngine:
             return np.asarray(sampled)                    # ONE host sync
 
         async with self._device_lock:
-            tokens = await asyncio.to_thread(prefill_all)
-        for seq, token in zip(pending, tokens):
+            packed = await asyncio.to_thread(prefill_all)
+        tokens = packed[0].astype(np.int32)
+        logprobs = packed[1]
+        for seq, token, lp in zip(pending, tokens, logprobs):
             # token_seq mirrors what prefill wrote to the device; register
             # every complete block this worker now holds (no-op for blocks
             # matched from already-registered shared pages)
@@ -489,7 +491,7 @@ class TpuEngine:
                     seq.pages[block.block_index], block.seq_hash,
                     block.local_hash, block.parent_seq_hash)
             seq.prefilled = True
-            self._emit_token(seq, int(token))
+            self._emit_token(seq, int(token), float(lp))
         return True
 
     # -- decode -------------------------------------------------------------
@@ -563,8 +565,10 @@ class TpuEngine:
             return np.asarray(sampled), kc, vc            # ONE host sync
 
         async with self._device_lock:
-            sampled, self.k_cache, self.v_cache = \
+            packed, self.k_cache, self.v_cache = \
                 await asyncio.to_thread(run_burst)
+        sampled = packed[0].astype(np.int32)     # (K, B)
+        logprobs = packed[1]                     # (K, B)
         for i, s in enumerate(batch):
             for k in range(k_steps):
                 if s.finished or s not in self._running:
@@ -575,12 +579,14 @@ class TpuEngine:
                     self.pool.register_page(
                         s.pages[block.block_index], block.seq_hash,
                         block.local_hash, block.parent_seq_hash)
-                self._emit_token(s, int(sampled[k, i]))
+                self._emit_token(s, int(sampled[k, i]),
+                                 float(logprobs[k, i]))
         return True
 
     # -- lifecycle helpers --------------------------------------------------
 
-    def _emit_token(self, seq: _Seq, token: int) -> None:
+    def _emit_token(self, seq: _Seq, token: int,
+                    logprob: Optional[float] = None) -> None:
         seq.next_token = token
         seq.generated += 1
         finish = None
@@ -591,6 +597,8 @@ class TpuEngine:
         elif seq.generated >= seq.max_tokens:
             finish = FINISH_LENGTH
         out = EngineOutput(token_ids=[token], finish_reason=finish)
+        if logprob is not None:
+            out.log_probs = [logprob]
         exported = False
         if finish is not None and \
                 (seq.req.kv_transfer_params or {}).get("do_remote_decode"):

@@ -59,3 +59,25 @@ def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
 
 
 sample_tokens = jax.jit(sample_tokens_traced)
+
+
+def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
+    """(B,) log-probability of each row's sampled token (traceable) —
+    the ONE definition both prefill sampling and the fused decode loop
+    use, so their logprob semantics can never diverge."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, sampled[:, None], axis=-1)[:, 0]
+
+
+def _sample_tokens_lp_traced(logits, seeds, steps, temperature, top_p,
+                             top_k):
+    """sample_tokens + chosen-token logprob, PACKED (2, B) f32 (token ids
+    exact in f32; one host transfer instead of two — the tunnel charges
+    per sync, not per byte)."""
+    sampled = sample_tokens_traced(logits, seeds, steps, temperature,
+                                   top_p, top_k)
+    return jnp.stack([sampled.astype(jnp.float32),
+                      chosen_logprob(logits, sampled)])
+
+
+sample_tokens_lp = jax.jit(_sample_tokens_lp_traced)

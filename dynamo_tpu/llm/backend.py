@@ -87,11 +87,13 @@ class Backend(Operator):
         async for out in self.inner.generate(request, inner_ctx):
             token_ids = out.get("token_ids", ())
             finish = out.get("finish_reason")
+            in_lps = out.get("log_probs")
             text_parts = []
             matched_stop = None
             hit_eos = False
             emitted_ids = []
-            for t in token_ids:
+            emitted_lps = [] if in_lps is not None else None
+            for ti, t in enumerate(token_ids):
                 generated += 1
                 if t in eos_ids and not req.stop.ignore_eos:
                     if generated >= req.stop.min_tokens:
@@ -99,6 +101,10 @@ class Backend(Operator):
                         break
                     continue  # pre-min_tokens EOS: suppress, keep generating
                 emitted_ids.append(t)
+                if emitted_lps is not None and ti < len(in_lps):
+                    # logprobs stay aligned with EMITTED tokens, not with
+                    # whatever text happened to detokenize this frame
+                    emitted_lps.append(in_lps[ti])
                 delta = decode.step(t)
                 if delta:
                     emit, matched_stop = jail.feed(delta)
@@ -106,24 +112,31 @@ class Backend(Operator):
                         text_parts.append(emit)
                     if matched_stop:
                         break
+            def with_lps(d: dict) -> dict:
+                if emitted_lps is not None:
+                    d["log_probs"] = emitted_lps
+                return d
             if matched_stop is not None:
-                yield {"text": "".join(text_parts), "token_ids": emitted_ids,
-                       "finish_reason": FINISH_STOP}
+                yield with_lps({"text": "".join(text_parts),
+                                "token_ids": emitted_ids,
+                                "finish_reason": FINISH_STOP})
                 inner_ctx.cancel()  # engine side stops generating
                 return
             if hit_eos:
                 # held-back text is real output (no stop matched): flush it
-                yield {"text": "".join(text_parts) + jail.flush(),
-                       "token_ids": emitted_ids, "finish_reason": FINISH_EOS}
+                yield with_lps({"text": "".join(text_parts) + jail.flush(),
+                                "token_ids": emitted_ids,
+                                "finish_reason": FINISH_EOS})
                 inner_ctx.cancel()
                 return
-            result = {"text": "".join(text_parts), "token_ids": emitted_ids}
+            result = with_lps({"text": "".join(text_parts),
+                               "token_ids": emitted_ids})
             if finish:
                 # engine-side finish (length/cancelled/error): flush any
                 # jailed text — it is real output, not a stop string.
                 result["text"] += jail.flush()
                 result["finish_reason"] = finish
-            for k in ("kv_transfer_params", "cum_log_prob", "log_probs"):
+            for k in ("kv_transfer_params", "cum_log_prob"):
                 if out.get(k) is not None:
                     result[k] = out[k]
             yield result

@@ -1,0 +1,115 @@
+"""Typed OpenAI-compatible HTTP client over a pooled aiohttp session.
+
+Reference: `lib/llm/src/http/client.rs` (730 LoC) — the pooled client the
+reference's migration/e2e tests drive deployments with. Streaming yields
+parsed SSE chunks; unary returns the full object; errors surface as
+OpenAIError with the server's status.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.llm.protocols_openai import OpenAIError
+
+
+class OpenAIClient:
+    """One client per target base URL; reuses a pooled session."""
+
+    def __init__(self, base_url: str, timeout: float = 300.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = None
+
+    async def _ensure(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # -- unary ---------------------------------------------------------------
+
+    async def _post_json(self, path: str, body: dict) -> dict:
+        session = await self._ensure()
+        async with session.post(f"{self.base_url}{path}",
+                                json=body) as resp:
+            payload = await resp.json(content_type=None)
+            if resp.status != 200:
+                err = (payload or {}).get("error", {})
+                raise OpenAIError(err.get("message", str(payload)),
+                                  status=resp.status,
+                                  err_type=err.get("type", "api_error"))
+            return payload
+
+    async def chat(self, model: str, messages: list[dict],
+                   **kw) -> dict:
+        return await self._post_json(
+            "/v1/chat/completions",
+            {"model": model, "messages": messages, **kw})
+
+    async def completions(self, model: str, prompt, **kw) -> dict:
+        return await self._post_json(
+            "/v1/completions", {"model": model, "prompt": prompt, **kw})
+
+    async def embeddings(self, model: str, input, **kw) -> dict:
+        return await self._post_json(
+            "/v1/embeddings", {"model": model, "input": input, **kw})
+
+    async def responses(self, model: str, input, **kw) -> dict:
+        return await self._post_json(
+            "/v1/responses", {"model": model, "input": input, **kw})
+
+    async def models(self) -> list[str]:
+        session = await self._ensure()
+        async with session.get(f"{self.base_url}/v1/models") as resp:
+            data = await resp.json()
+        return [m["id"] for m in data.get("data", ())]
+
+    # -- streaming -----------------------------------------------------------
+
+    async def _stream(self, path: str, body: dict
+                      ) -> AsyncIterator[dict]:
+        session = await self._ensure()
+        async with session.post(f"{self.base_url}{path}",
+                                json={**body, "stream": True}) as resp:
+            if resp.status != 200:
+                payload = await resp.json(content_type=None)
+                err = (payload or {}).get("error", {})
+                raise OpenAIError(err.get("message", str(payload)),
+                                  status=resp.status,
+                                  err_type=err.get("type", "api_error"))
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                if line == "data: [DONE]":
+                    return
+                yield json.loads(line[6:])
+
+    def chat_stream(self, model: str, messages: list[dict],
+                    **kw) -> AsyncIterator[dict]:
+        return self._stream("/v1/chat/completions",
+                            {"model": model, "messages": messages, **kw})
+
+    def completions_stream(self, model: str, prompt,
+                           **kw) -> AsyncIterator[dict]:
+        return self._stream("/v1/completions",
+                            {"model": model, "prompt": prompt, **kw})
+
+    async def chat_text(self, model: str, messages: list[dict],
+                        **kw) -> str:
+        """Streamed chat folded to its text (test-harness convenience)."""
+        parts: list[str] = []
+        async for chunk in self.chat_stream(model, messages, **kw):
+            for ch in chunk.get("choices", ()):
+                t = ch.get("delta", {}).get("content")
+                if t:
+                    parts.append(t)
+        return "".join(parts)

@@ -397,14 +397,27 @@ class OpenAIPreprocessor(Operator):
         finish: Optional[str] = None
         if oai.echo and isinstance(oai.prompt, str):
             yield completion_chunk(request_id, oai.model, created, oai.prompt)
+        # logprobs=0 is a valid OpenAI value ("chosen token, no
+        # alternatives"): gate on presence, not truthiness. Frames whose
+        # text is held back (stop-jail, multibyte holdback) still carry
+        # token logprobs — buffer them until a chunk flows.
+        want_lps = oai.logprobs is not None
+        pending_lps: list[float] = []
         async for out in self.inner.generate(pre.to_dict(), context):
             completion_tokens += len(out.get("token_ids", ()))
             text = out.get("text", "")
             finish = out.get("finish_reason")
+            if want_lps and out.get("log_probs"):
+                pending_lps.extend(out["log_probs"])
             if text:
-                yield completion_chunk(request_id, oai.model, created, text)
+                lps = None
+                if want_lps:
+                    lps, pending_lps = pending_lps, []
+                yield completion_chunk(request_id, oai.model, created,
+                                       text, token_logprobs=lps)
             if finish:
                 break
         yield completion_chunk(
             request_id, oai.model, created, "", finish_reason=finish or "stop",
-            usage=usage_dict(prompt_tokens, completion_tokens))
+            usage=usage_dict(prompt_tokens, completion_tokens),
+            token_logprobs=(pending_lps or None) if want_lps else None)

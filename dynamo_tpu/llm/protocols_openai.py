@@ -132,6 +132,7 @@ class CompletionRequest:
     ignore_eos: bool = False
     min_tokens: Optional[int] = None
     echo: bool = False
+    logprobs: Optional[int] = None       # OpenAI: int top-k (we emit chosen)
     n: int = 1
     raw: dict = field(default_factory=dict)
 
@@ -159,7 +160,9 @@ class CompletionRequest:
             ignore_eos=bool(d.get("ignore_eos",
                                   nvext.get("ignore_eos", False))),
             min_tokens=d.get("min_tokens"),
-            echo=bool(d.get("echo")), n=int(d.get("n", 1)), raw=d,
+            echo=bool(d.get("echo")),
+            logprobs=d.get("logprobs"),
+            n=int(d.get("n", 1)), raw=d,
         )
 
     sampling_options = ChatCompletionRequest.sampling_options
@@ -230,12 +233,18 @@ def chat_completion(request_id: str, model: str, created: int, text: str,
 
 def completion_chunk(request_id: str, model: str, created: int, text: str,
                      finish_reason: Optional[str] = None,
-                     usage: Optional[dict] = None) -> dict:
+                     usage: Optional[dict] = None,
+                     token_logprobs: Optional[list[float]] = None) -> dict:
+    logprobs = None
+    if token_logprobs is not None:
+        logprobs = {"token_logprobs": token_logprobs,
+                    "tokens": None, "top_logprobs": None,
+                    "text_offset": None}
     out = {
         "id": request_id, "object": "text_completion", "created": created,
         "model": model,
         "choices": [{"index": 0, "text": text,
-                     "finish_reason": finish_reason, "logprobs": None}],
+                     "finish_reason": finish_reason, "logprobs": logprobs}],
     }
     if usage is not None:
         out["usage"] = usage

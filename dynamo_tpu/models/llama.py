@@ -371,7 +371,11 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
 
     Pages for positions..positions+num_steps-1 must be pre-allocated in
     `page_tables` (engine guarantees this). Returns
-    (sampled (num_steps, B) i32, k_cache, v_cache).
+    (packed (2, num_steps, B) f32, k_cache, v_cache) where packed[0] is
+    the sampled token ids (exact in f32: vocab « 2^24) and packed[1] the
+    chosen-token logprobs — PACKED so the host still pays exactly ONE
+    transfer per burst (a second np.asarray would cost another full
+    sync round-trip).
     """
     from dynamo_tpu.engine.sampling import sample_tokens_traced
 
@@ -381,10 +385,16 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
             params, kc, vc, toks, positions + i, page_tables, valid, cfg)
         sampled = sample_tokens_traced(
             logits, seeds, steps0 + i, temperature, top_p, top_k)
-        out = lax.dynamic_update_index_in_dim(out, sampled, i, axis=0)
+        # chosen-token logprob: one extra (B, V) reduction pass — noise
+        # next to the lm_head matmul that produced the logits
+        from dynamo_tpu.engine.sampling import chosen_logprob
+
+        chosen = chosen_logprob(logits, sampled)
+        out = out.at[0, i].set(sampled.astype(jnp.float32))
+        out = out.at[1, i].set(chosen)
         return sampled, kc, vc, out
 
-    out0 = jnp.zeros((num_steps, tokens.shape[0]), dtype=jnp.int32)
+    out0 = jnp.zeros((2, num_steps, tokens.shape[0]), dtype=jnp.float32)
     _, k_cache, v_cache, out = lax.fori_loop(
         0, num_steps, body, (tokens, k_cache, v_cache, out0))
     return out, k_cache, v_cache

@@ -560,3 +560,29 @@ async def test_queue_redelivery_wakes_idle_dequeuer():
         await item.ack()
     finally:
         await rt.close()
+
+
+async def test_disagg_preserves_logprobs():
+    """N tokens ⇒ N logprobs even when the first token came from a
+    remote prefill worker (both push and queue modes)."""
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    agg_outs = [o async for o in agg.generate(req(prompt, max_tokens=6),
+                                              Context())]
+    agg_lps = [l for o in agg_outs for l in (o.get("log_probs") or ())]
+    await agg.close()
+    assert len(agg_lps) == 6
+
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        lps = [l for o in outs for l in (o.get("log_probs") or ())]
+        assert len(lps) == len(toks) == 6
+        import numpy as np
+        np.testing.assert_allclose(lps, agg_lps, rtol=1e-5, atol=1e-5)
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()

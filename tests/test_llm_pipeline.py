@@ -167,3 +167,29 @@ async def test_migration_limit_exhausted():
         Migration(migration_limit=1), sink=FnEngine(always_dies))
     with pytest.raises(ConnectionError):
         await collect(pipe, chat_request("aa bb"))
+
+
+async def test_completion_logprobs_surface():
+    """logprobs=1 on /v1/completions exposes chosen-token logprobs."""
+    tok = WordTokenizer()
+
+    async def gen(req, ctx):
+        yield {"token_ids": [1, 2], "log_probs": [-0.5, -1.25]}
+        yield {"token_ids": [3], "log_probs": [-2.0],
+               "finish_reason": "stop"}
+
+    pipe = build_pipeline(
+        OpenAIPreprocessor(tok, "m"), Backend(tok), sink=FnEngine(gen))
+    req = {"_kind": "completion",
+           "body": {"model": "m", "prompt": "x y z", "max_tokens": 3,
+                    "logprobs": 1}}
+    outs = [c async for c in pipe.generate(req, Context())]
+    lps = [l for c in outs for ch in c.get("choices", ())
+           if ch.get("logprobs")
+           for l in ch["logprobs"]["token_logprobs"]]
+    assert lps == [-0.5, -1.25, -2.0]
+    # without the flag: logprobs stays null
+    req["body"].pop("logprobs")
+    outs = [c async for c in pipe.generate(req, Context())]
+    assert all(ch.get("logprobs") is None
+               for c in outs for ch in c.get("choices", ()))

@@ -1,0 +1,35 @@
+"""Pooled OpenAI client (http/client.rs analog) against a live stack."""
+
+import pytest
+
+from dynamo_tpu.llm.client import OpenAIClient
+from dynamo_tpu.llm.protocols_openai import OpenAIError
+from tests.test_http_frontend import setup_stack, teardown_stack
+
+
+async def test_client_surfaces():
+    rt, fe, hs, es = await setup_stack()
+    client = OpenAIClient(fe.url)
+    try:
+        assert await client.models() == ["mock-model"]
+        msgs = [{"role": "user", "content": "say hi"}]
+        full = await client.chat("mock-model", msgs, max_tokens=4)
+        assert full["choices"][0]["message"]["content"]
+        text = await client.chat_text("mock-model", msgs, max_tokens=4)
+        assert text
+        comp = await client.completions("mock-model", "a b c",
+                                        max_tokens=3)
+        assert comp["choices"][0]["text"]
+        chunks = [c async for c in client.completions_stream(
+            "mock-model", "a b c", max_tokens=3)]
+        assert len(chunks) >= 2
+        emb = await client.embeddings("mock-model", "hello")
+        assert len(emb["data"][0]["embedding"]) == 64
+        resp = await client.responses("mock-model", "question")
+        assert resp["status"] == "completed"
+        with pytest.raises(OpenAIError) as ei:
+            await client.chat("nope", msgs)
+        assert ei.value.status == 404
+    finally:
+        await client.close()
+        await teardown_stack(rt, fe, hs, es)

@@ -263,3 +263,26 @@ async def test_engine_embeddings():
         assert len(toks_out) == 3
     finally:
         await eng.close()
+
+
+async def test_engine_emits_logprobs():
+    """Every streamed token carries its chosen-token logprob (device-
+    computed, packed into the existing single sync per burst)."""
+    import math
+
+    eng = make_engine()
+    try:
+        req = {"token_ids": [3, 4, 5, 6], "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 5}}
+        outs = [o async for o in eng.generate(req, Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        lps = [l for o in outs for l in (o.get("log_probs") or ())]
+        assert len(lps) == len(toks) == 5
+        assert all(l <= 0.0 and math.isfinite(l) for l in lps)
+        # greedy sampling: the chosen token is the argmax, so its logprob
+        # must be the row maximum ⇒ strictly greater than log(1/V)
+        assert all(l > math.log(1.0 / eng.model_cfg.vocab_size)
+                   for l in lps)
+    finally:
+        await eng.close()

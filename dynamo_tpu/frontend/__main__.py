@@ -41,6 +41,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tls-key-path", default=None)
     p.add_argument("--grpc-port", type=int, default=None,
                    help="also serve the KServe-v2 gRPC frontend here")
+    p.add_argument("--request-template", default=None,
+                   help="JSON file of request defaults (model, "
+                        "temperature, max_completion_tokens)")
     return p.parse_args(argv)
 
 
@@ -60,7 +63,14 @@ def main(argv=None) -> None:
             use_kv_events=not args.no_kv_events,
             replica_sync=args.router_replica_sync,
         )
+        template = None
+        if args.request_template:
+            import json as _json
+
+            with open(args.request_template) as f:
+                template = _json.load(f)
         fe = await start_frontend(rt, host=args.host, port=args.port,
+                                  request_template=template,
                                   router_config=router_cfg,
                                   router_mode_override=args.router_mode,
                                   namespace=args.namespace,

@@ -36,17 +36,27 @@ class OpenAIClient:
 
     # -- unary ---------------------------------------------------------------
 
+    @staticmethod
+    async def _error_from(resp) -> OpenAIError:
+        """Non-200 → OpenAIError, surviving non-JSON bodies (a proxy's
+        HTML 502 page must not mask the status behind a decode error)."""
+        try:
+            payload = await resp.json(content_type=None)
+            err = (payload or {}).get("error", {})
+            msg = err.get("message", str(payload))
+            etype = err.get("type", "api_error")
+        except Exception:
+            msg = (await resp.text())[:200]
+            etype = "api_error"
+        return OpenAIError(msg, status=resp.status, err_type=etype)
+
     async def _post_json(self, path: str, body: dict) -> dict:
         session = await self._ensure()
         async with session.post(f"{self.base_url}{path}",
                                 json=body) as resp:
-            payload = await resp.json(content_type=None)
             if resp.status != 200:
-                err = (payload or {}).get("error", {})
-                raise OpenAIError(err.get("message", str(payload)),
-                                  status=resp.status,
-                                  err_type=err.get("type", "api_error"))
-            return payload
+                raise await self._error_from(resp)
+            return await resp.json(content_type=None)
 
     async def chat(self, model: str, messages: list[dict],
                    **kw) -> dict:
@@ -69,6 +79,8 @@ class OpenAIClient:
     async def models(self) -> list[str]:
         session = await self._ensure()
         async with session.get(f"{self.base_url}/v1/models") as resp:
+            if resp.status != 200:
+                raise await self._error_from(resp)
             data = await resp.json()
         return [m["id"] for m in data.get("data", ())]
 
@@ -80,11 +92,7 @@ class OpenAIClient:
         async with session.post(f"{self.base_url}{path}",
                                 json={**body, "stream": True}) as resp:
             if resp.status != 200:
-                payload = await resp.json(content_type=None)
-                err = (payload or {}).get("error", {})
-                raise OpenAIError(err.get("message", str(payload)),
-                                  status=resp.status,
-                                  err_type=err.get("type", "api_error"))
+                raise await self._error_from(resp)
             async for raw in resp.content:
                 line = raw.decode().strip()
                 if not line.startswith("data: "):

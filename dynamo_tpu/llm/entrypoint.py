@@ -52,7 +52,9 @@ async def start_frontend(runtime: DistributedRuntime,
                          namespace: Optional[str] = None,
                          tls_cert: Optional[str] = None,
                          tls_key: Optional[str] = None,
-                         grpc_port: Optional[int] = None) -> Frontend:
+                         grpc_port: Optional[int] = None,
+                         request_template: Optional[dict] = None
+                         ) -> Frontend:
     """HTTP frontend: model discovery + OpenAI server (Input::Http).
 
     `router_mode_override` must be set before the watcher's initial MDC
@@ -62,7 +64,7 @@ async def start_frontend(runtime: DistributedRuntime,
     manager.router_mode_override = router_mode_override
     watcher = await ModelWatcher(manager, namespace=namespace).start()
     http = HttpService(manager, host, port, tls_cert=tls_cert,
-                       tls_key=tls_key)
+                       tls_key=tls_key, request_template=request_template)
     await http.start()
     grpc_svc = None
     if grpc_port is not None:

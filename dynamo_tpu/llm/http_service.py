@@ -82,10 +82,14 @@ logger = logging.getLogger(__name__)
 class HttpService:
     def __init__(self, manager: ModelManager, host: str = "127.0.0.1",
                  port: int = 0, tls_cert: Optional[str] = None,
-                 tls_key: Optional[str] = None, audit=None) -> None:
+                 tls_key: Optional[str] = None, audit=None,
+                 request_template: Optional[dict] = None) -> None:
         self.manager = manager
         self.host = host
         self.port = port
+        # defaults applied to requests that omit them (request_template.rs:
+        # model, temperature, max_completion_tokens)
+        self.request_template = request_template or {}
         self._audit_owned = audit is None
         if audit is None:
             from dynamo_tpu.llm.audit import audit_bus_from_env
@@ -145,6 +149,20 @@ class HttpService:
     @property
     def scheme(self) -> str:
         return "https" if self.tls_cert else "http"
+
+    def _apply_template(self, body: dict) -> None:
+        t = self.request_template
+        if not t:
+            return
+        if not body.get("model") and t.get("model"):
+            body["model"] = t["model"]
+        if body.get("temperature") is None and \
+                t.get("temperature") is not None:
+            body["temperature"] = t["temperature"]
+        if body.get("max_tokens") is None \
+                and body.get("max_completion_tokens") is None \
+                and t.get("max_completion_tokens") is not None:
+            body["max_tokens"] = t["max_completion_tokens"]
 
     def _audit_begin(self, request_id: str, endpoint: str, body):
         if self.audit is None:
@@ -344,6 +362,8 @@ class HttpService:
             body = await request.json()
         except Exception:
             return self._error(endpoint, OpenAIError("invalid JSON body"))
+        if isinstance(body, dict):
+            self._apply_template(body)
         model = body.get("model") if isinstance(body, dict) else None
         engine = self.manager.engine_for(model) if model else None
         if engine is None:

@@ -252,9 +252,12 @@ def completion_chunk(request_id: str, model: str, created: int, text: str,
 
 
 def completion_response(request_id: str, model: str, created: int, text: str,
-                        finish_reason: str, usage: dict) -> dict:
+                        finish_reason: str, usage: dict,
+                        token_logprobs: Optional[list[float]] = None
+                        ) -> dict:
     return completion_chunk(request_id, model, created, text,
-                            finish_reason, usage)
+                            finish_reason, usage,
+                            token_logprobs=token_logprobs)
 
 
 def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
@@ -324,9 +327,23 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
 
 
 async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
-    """Fold text_completion chunk stream into one text_completion."""
-    return await _aggregate_stream(
-        chunks, lambda ch: ch.get("text"), completion_response)
+    """Fold text_completion chunk stream into one text_completion —
+    including per-chunk token logprobs, which a unary logprobs request
+    must not silently drop."""
+    all_lps: list[float] = []
+
+    def extract(ch: dict):
+        lp = ch.get("logprobs")
+        if lp and lp.get("token_logprobs"):
+            all_lps.extend(lp["token_logprobs"])
+        return ch.get("text")
+
+    def build(request_id, model, created, text, finish, usage):
+        return completion_response(request_id, model, created, text,
+                                   finish, usage,
+                                   token_logprobs=all_lps or None)
+
+    return await _aggregate_stream(chunks, extract, build)
 
 
 # ---------------------------------------------------------------------------

@@ -408,3 +408,45 @@ async def test_openapi_derives_from_route_table():
         assert served == set(spec["paths"])
     finally:
         await teardown_stack(rt, fe, hs, es)
+
+
+async def test_request_template_defaults():
+    """request_template.rs analog: omitted model/temperature/max_tokens
+    fill from the template; explicit values win."""
+    from dynamo_tpu.llm.entrypoint import serve_engine, start_frontend
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    eng = MockEngine(MockEngineConfig(speedup=100.0))
+    card = ModelDeploymentCard(name="tpl", namespace="ns", component="w",
+                               tokenizer_kind="word", tokenizer_path="tpl")
+    h = await serve_engine(rt, eng, card)
+    fe = await start_frontend(rt, request_template={
+        "model": "tpl", "temperature": 0.0,
+        "max_completion_tokens": 3})
+    try:
+        for _ in range(100):
+            if "tpl" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            # model omitted entirely: the template supplies it
+            async with s.post(f"{fe.url}/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}]}) as r:
+                assert r.status == 200
+                out = await r.json()
+            assert out["usage"]["completion_tokens"] == 3  # template cap
+            # explicit values win over the template
+            async with s.post(f"{fe.url}/v1/chat/completions", json={
+                "model": "tpl", "max_tokens": 5,
+                "messages": [{"role": "user", "content": "hi"}]}) as r:
+                out = await r.json()
+            assert out["usage"]["completion_tokens"] == 5
+    finally:
+        await fe.stop()
+        await h.stop()
+        await eng.close()
+        await rt.close()

@@ -20,7 +20,13 @@ roofline decomposition VERDICT r1 asked for:
   live in this run; the ratio folds scheduler+streaming overhead AND the
   required prefill work into one number (conservative)
 - hbm_util_pct: (param bytes + per-step KV traffic) / step-time / 819 GB/s
-  (v5e HBM peak) — how close the decode step runs to memory-bound
+  (v5e HBM peak) — how close the decode step runs to memory-bound.
+  Ablation (2026-07-30): the weight-stream floor alone (matmuls only,
+  no attention/cache/sampling) measures 6.2 ms of the 8.3 ms step at
+  batch 16 — i.e. ~75% of the step is the irreducible weight read at
+  this batch; attention+paged-cache+sampling add 2.1 ms. Pushing
+  further means bigger batches (more tokens per weight read) or
+  quantized weights, not kernel tuning.
 
 Prints ONE JSON line.
 """

@@ -357,16 +357,108 @@ class OpenAIPreprocessor(Operator):
             reasoning=(get_reasoning_parser(self.reasoning_parser)
                        if want_reasoning else None))
 
+    def _one_chat_stream(self, pre, oai, request_id, created, context):
+        stream = self._chat_chunks(pre, oai, request_id, created, context)
+        jail = self._chat_parsers(oai)   # fresh jail per choice: stateful
+        if jail is not None:
+            stream = jail.apply(stream)
+        return stream
+
     async def _postprocess_chat(self, pre: PreprocessedRequest,
                                 oai: ChatCompletionRequest, request_id: str,
                                 created: int, context: Context
                                 ) -> AsyncIterator[dict]:
-        stream = self._chat_chunks(pre, oai, request_id, created, context)
-        jail = self._chat_parsers(oai)
-        if jail is not None:
-            stream = jail.apply(stream)
-        async for chunk in stream:
+        if oai.n <= 1:
+            async for chunk in self._one_chat_stream(
+                    pre, oai, request_id, created, context):
+                yield chunk
+            return
+        # n > 1: one engine stream per choice (distinct seeds), chunks
+        # interleaved with per-choice indices, one trailing usage chunk
+        streams = [
+            self._one_chat_stream(
+                self._reseed(pre, i), oai, request_id, created, context)
+            for i in range(oai.n)]
+        usages: dict[int, dict] = {}
+        async for chunk in self._fanout_choices(streams, usages):
             yield chunk
+        # spec-shaped trailing usage chunk: choices MUST be empty — an
+        # extra index-0 delta after that choice's finish is a protocol
+        # violation to strict stream consumers
+        yield {"id": request_id, "object": "chat.completion.chunk",
+               "created": created, "model": oai.model, "choices": [],
+               "usage": self._merge_usage(usages)}
+
+    @staticmethod
+    def _reseed(pre: PreprocessedRequest, i: int) -> PreprocessedRequest:
+        """Choice i's request: same tokens, decorrelated seed (a fixed
+        user seed must still yield n DISTINCT choices, deterministically).
+        Choice 0 keeps the original seed for n=1 compatibility. Shallow
+        copies only — deep-copying a 100k-token prompt n times would be
+        pure waste when just sampling.seed changes."""
+        import copy as _copy
+
+        if i == 0 or pre.sampling.seed is None:
+            return pre
+        p2 = _copy.copy(pre)
+        p2.sampling = _copy.copy(pre.sampling)
+        p2.sampling.seed = pre.sampling.seed + i
+        return p2
+
+    @staticmethod
+    def _merge_usage(usages: dict[int, dict]) -> dict:
+        prompt = max((u.get("prompt_tokens", 0)
+                      for u in usages.values()), default=0)
+        completion = sum(u.get("completion_tokens", 0)
+                         for u in usages.values())
+        return usage_dict(prompt, completion)
+
+    async def _fanout_choices(self, streams,
+                              usages: dict[int, dict]
+                              ) -> AsyncIterator[dict]:
+        """Merge per-choice chunk streams: relabel indices, strip the
+        per-stream usage chunks into ``usages`` (caller merges).
+
+        Bounded queue: the engine must be paced by the consumer exactly
+        as in the single-stream path, not buffer n full completions. A
+        failing choice cancels its siblings IMMEDIATELY — the client
+        must not wait for (and pay for) n-1 finished generations to
+        learn the request failed."""
+        import asyncio
+
+        queue: asyncio.Queue = asyncio.Queue(maxsize=4)
+
+        async def pump(i, stream):
+            try:
+                async for chunk in stream:
+                    await queue.put((i, chunk, None))
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:
+                await queue.put((i, None, e))
+                return
+            await queue.put((i, None, None))
+
+        tasks = [asyncio.get_running_loop().create_task(pump(i, st))
+                 for i, st in enumerate(streams)]
+        try:
+            done = 0
+            while done < len(streams):
+                i, chunk, err = await queue.get()
+                if chunk is None:
+                    if err is not None:
+                        raise err   # finally cancels the siblings now
+                    done += 1
+                    continue
+                for ch in chunk.get("choices", ()):
+                    ch["index"] = i
+                u = chunk.pop("usage", None)
+                if u:
+                    usages[i] = u
+                yield chunk
+        finally:
+            for t in tasks:
+                t.cancel()
 
     async def _chat_chunks(self, pre: PreprocessedRequest,
                            oai: ChatCompletionRequest, request_id: str,
@@ -392,6 +484,25 @@ class OpenAIPreprocessor(Operator):
                                       oai: CompletionRequest, request_id: str,
                                       created: int, context: Context
                                       ) -> AsyncIterator[dict]:
+        if oai.n > 1:
+            streams = [self._completion_chunks(
+                self._reseed(pre, i), oai, request_id, created, context)
+                for i in range(oai.n)]
+            usages: dict[int, dict] = {}
+            async for chunk in self._fanout_choices(streams, usages):
+                yield chunk
+            yield {"id": request_id, "object": "text_completion",
+                   "created": created, "model": oai.model, "choices": [],
+                   "usage": self._merge_usage(usages)}
+            return
+        async for chunk in self._completion_chunks(pre, oai, request_id,
+                                                   created, context):
+            yield chunk
+
+    async def _completion_chunks(self, pre: PreprocessedRequest,
+                                 oai: CompletionRequest, request_id: str,
+                                 created: int, context: Context
+                                 ) -> AsyncIterator[dict]:
         prompt_tokens = len(pre.token_ids)
         completion_tokens = 0
         finish: Optional[str] = None

@@ -34,6 +34,11 @@ class OpenAIError(ValueError):
                           "param": None, "code": None}}
 
 
+# one request fans out into n engine streams, each holding KV pages and
+# a batch slot — an uncapped n is a single-request denial of service
+MAX_N = 16
+
+
 def _require(cond: bool, msg: str) -> None:
     if not cond:
         raise OpenAIError(msg)
@@ -69,6 +74,8 @@ class ChatCompletionRequest:
         for m in msgs:
             _require(isinstance(m, dict) and "role" in m,
                      "each message needs a 'role'")
+        _require(1 <= int(d.get("n", 1)) <= MAX_N,
+                 f"'n' must be between 1 and {MAX_N}")
         stop = d.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
@@ -142,6 +149,8 @@ class CompletionRequest:
         _require(bool(d.get("model")), "'model' is required")
         prompt = d.get("prompt")
         _require(prompt is not None, "'prompt' is required")
+        _require(1 <= int(d.get("n", 1)) <= MAX_N,
+                 f"'n' must be between 1 and {MAX_N}")
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
             _require(len(prompt) == 1, "batch prompts not supported yet")
             prompt = prompt[0]
@@ -278,6 +287,92 @@ def sse_encode(payload: dict) -> bytes:
         + b"\n\n"
 
 
+async def _fold_chunks(chunks: AsyncIterator[dict], on_choice) -> tuple:
+    """Shared stream-fold scaffolding: header fields + usage capture;
+    ``on_choice(index, choice)`` accumulates per-choice state."""
+    request_id, model, created, usage = "", "", _now(), None
+    async for c in chunks:
+        request_id = c.get("id", request_id)
+        model = c.get("model", model)
+        created = c.get("created", created)
+        if c.get("usage"):
+            usage = c["usage"]
+        for choice in c.get("choices", ()):
+            on_choice(int(choice.get("index", 0)), choice)
+    return request_id, model, created, usage or usage_dict(0, 0)
+
+
+def chat_completion(request_id: str, model: str, created: int, text: str,
+                    finish_reason: str, usage: dict,
+                    tool_calls: Optional[list[dict]] = None,
+                    reasoning: str = "") -> dict:
+    message: dict[str, Any] = {"role": "assistant", "content": text}
+    if tool_calls:
+        # unary shape carries no streaming 'index' field
+        message["tool_calls"] = [
+            {k: v for k, v in tc.items() if k != "index"}
+            for tc in tool_calls]
+    if reasoning:
+        message["reasoning_content"] = reasoning
+    return {
+        "id": request_id, "object": "chat.completion", "created": created,
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": message,
+            "finish_reason": finish_reason,
+        }],
+        "usage": usage,
+    }
+
+
+def completion_chunk(request_id: str, model: str, created: int, text: str,
+                     finish_reason: Optional[str] = None,
+                     usage: Optional[dict] = None,
+                     token_logprobs: Optional[list[float]] = None) -> dict:
+    logprobs = None
+    if token_logprobs is not None:
+        logprobs = {"token_logprobs": token_logprobs,
+                    "tokens": None, "top_logprobs": None,
+                    "text_offset": None}
+    out = {
+        "id": request_id, "object": "text_completion", "created": created,
+        "model": model,
+        "choices": [{"index": 0, "text": text,
+                     "finish_reason": finish_reason, "logprobs": logprobs}],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+def completion_response(request_id: str, model: str, created: int, text: str,
+                        finish_reason: str, usage: dict,
+                        token_logprobs: Optional[list[float]] = None
+                        ) -> dict:
+    return completion_chunk(request_id, model, created, text,
+                            finish_reason, usage,
+                            token_logprobs=token_logprobs)
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {"prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens}
+
+
+# ---------------------------------------------------------------------------
+# SSE codec (protocols/codec.rs)
+# ---------------------------------------------------------------------------
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_encode(payload: dict) -> bytes:
+    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() \
+        + b"\n\n"
+
+
 async def _aggregate_stream(chunks: AsyncIterator[dict], extract_text,
                             build) -> dict:
     """Shared delta→full fold (aggregator.rs); `extract_text` pulls the text
@@ -303,47 +398,77 @@ async def _aggregate_stream(chunks: AsyncIterator[dict], extract_text,
 
 async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
     """Fold chat.completion.chunk stream into one chat.completion —
-    including `delta.tool_calls` and `delta.reasoning_content` from the
-    jailed stream (aggregator.rs folds the same three delta kinds)."""
-    tool_calls: list[dict] = []
-    reasoning_parts: list[str] = []
+    per CHOICE INDEX (n>1 interleaves choices), including
+    `delta.tool_calls` and `delta.reasoning_content` from the jailed
+    stream (aggregator.rs folds the same three delta kinds)."""
+    per: dict[int, dict] = {}
 
-    def extract(ch: dict):
-        delta = ch.get("delta", {})
+    def empty() -> dict:
+        return {"text": [], "tool_calls": [], "reasoning": [],
+                "finish": "stop"}
+
+    def on_choice(i: int, choice: dict) -> None:
+        st = per.setdefault(i, empty())
+        delta = choice.get("delta", {})
+        if delta.get("content"):
+            st["text"].append(delta["content"])
         for tc in delta.get("tool_calls") or ():
             tc = dict(tc)
-            tc["index"] = len(tool_calls)
-            tool_calls.append(tc)
+            tc["index"] = len(st["tool_calls"])
+            st["tool_calls"].append(tc)
         if delta.get("reasoning_content"):
-            reasoning_parts.append(delta["reasoning_content"])
-        return delta.get("content")
+            st["reasoning"].append(delta["reasoning_content"])
+        if choice.get("finish_reason"):
+            st["finish"] = choice["finish_reason"]
 
-    def build(request_id, model, created, text, finish, usage):
-        return chat_completion(
-            request_id, model, created, text, finish, usage,
-            tool_calls=tool_calls, reasoning="".join(reasoning_parts))
-
-    return await _aggregate_stream(chunks, extract, build)
+    request_id, model, created, usage = await _fold_chunks(chunks,
+                                                           on_choice)
+    choices = []
+    for i in sorted(per) if per else [0]:
+        st = per.get(i, empty())
+        one = chat_completion(
+            request_id, model, created, "".join(st["text"]), st["finish"],
+            usage, tool_calls=st["tool_calls"],
+            reasoning="".join(st["reasoning"]))["choices"][0]
+        one["index"] = i
+        choices.append(one)
+    return {"id": request_id, "object": "chat.completion",
+            "created": created, "model": model, "choices": choices,
+            "usage": usage}
 
 
 async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
-    """Fold text_completion chunk stream into one text_completion —
-    including per-chunk token logprobs, which a unary logprobs request
-    must not silently drop."""
-    all_lps: list[float] = []
+    """Fold text_completion chunk stream into one text_completion — per
+    choice index (n>1), keeping token logprobs (a unary logprobs request
+    must not silently drop them)."""
+    per: dict[int, dict] = {}
 
-    def extract(ch: dict):
-        lp = ch.get("logprobs")
+    def empty() -> dict:
+        return {"text": [], "lps": [], "finish": "stop"}
+
+    def on_choice(i: int, choice: dict) -> None:
+        st = per.setdefault(i, empty())
+        if choice.get("text"):
+            st["text"].append(choice["text"])
+        lp = choice.get("logprobs")
         if lp and lp.get("token_logprobs"):
-            all_lps.extend(lp["token_logprobs"])
-        return ch.get("text")
+            st["lps"].extend(lp["token_logprobs"])
+        if choice.get("finish_reason"):
+            st["finish"] = choice["finish_reason"]
 
-    def build(request_id, model, created, text, finish, usage):
-        return completion_response(request_id, model, created, text,
-                                   finish, usage,
-                                   token_logprobs=all_lps or None)
-
-    return await _aggregate_stream(chunks, extract, build)
+    request_id, model, created, usage = await _fold_chunks(chunks,
+                                                           on_choice)
+    choices = []
+    for i in sorted(per) if per else [0]:
+        st = per.get(i, empty())
+        one = completion_response(
+            request_id, model, created, "".join(st["text"]), st["finish"],
+            usage, token_logprobs=st["lps"] or None)["choices"][0]
+        one["index"] = i
+        choices.append(one)
+    return {"id": request_id, "object": "text_completion",
+            "created": created, "model": model, "choices": choices,
+            "usage": usage}
 
 
 # ---------------------------------------------------------------------------

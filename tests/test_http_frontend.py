@@ -450,3 +450,83 @@ async def test_request_template_defaults():
         await h.stop()
         await eng.close()
         await rt.close()
+
+
+async def test_n_choices_streaming_and_unary():
+    """n=3: three indexed choices, merged usage, distinct sampling."""
+    rt, fe, hs, es = await setup_stack()
+    try:
+        body = {"model": "mock-model", "max_tokens": 4, "n": 3,
+                "temperature": 0.8, "seed": 7,
+                "messages": [{"role": "user", "content": "three ways"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        assert all(c["message"]["content"] for c in out["choices"])
+        assert all(c["finish_reason"] for c in out["choices"])
+        # usage sums completion tokens across choices
+        assert out["usage"]["completion_tokens"] == 12
+        # streaming: indices interleave, every choice finishes
+        body["stream"] = True
+        finishes = set()
+        indices = set()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: ") or \
+                            line == "data: [DONE]":
+                        continue
+                    for ch in json.loads(line[6:])["choices"]:
+                        indices.add(ch["index"])
+                        if ch.get("finish_reason"):
+                            finishes.add(ch["index"])
+        assert indices == finishes == {0, 1, 2}
+
+        # completions endpoint too
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/completions", json={
+                "model": "mock-model", "prompt": "a b c",
+                "max_tokens": 3, "n": 2, "temperature": 0.7}) as r:
+                out = await r.json()
+        assert [c["index"] for c in out["choices"]] == [0, 1]
+        assert all(c["text"] for c in out["choices"])
+        assert out["usage"]["completion_tokens"] == 6
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_n_capped_and_error_cancels_siblings():
+    import aiohttp as _a
+
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with _a.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/chat/completions", json={
+                "model": "mock-model", "n": 100000,
+                "messages": [{"role": "user", "content": "x"}]}) as r:
+                assert r.status == 400
+                err = await r.json()
+        assert "'n' must be between" in err["error"]["message"]
+        # streaming trailing usage chunk has EMPTY choices (spec shape)
+        async with _a.ClientSession() as s:
+            async with s.post(f"{fe.url}/v1/chat/completions", json={
+                "model": "mock-model", "n": 2, "max_tokens": 3,
+                "stream": True,
+                "messages": [{"role": "user", "content": "x"}]}) as r:
+                chunks = []
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if line.startswith("data: ") and \
+                            line != "data: [DONE]":
+                        chunks.append(json.loads(line[6:]))
+        with_usage = [c for c in chunks if c.get("usage")]
+        assert len(with_usage) == 1
+        assert with_usage[0]["choices"] == []
+        assert with_usage[0]["usage"]["completion_tokens"] == 6
+    finally:
+        await teardown_stack(rt, fe, hs, es)

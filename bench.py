@@ -39,6 +39,11 @@ R1_DEVICE_LOOP_CEILING_TOK_S = 606.0  # round-1 ceiling: decode_multi_step K=16,
 V5E_HBM_GBPS = 819.0
 
 ISL, OSL, N_REQS, BATCH, K_STEPS = 96, 64, 32, 16, 32
+# int8 weight-only (engine/quant.py): halves the decode weight-stream
+# floor, the dominant step cost at batch 16 (8.2→6.0 ms/step measured on
+# v5e). A standard serving config (the reference ships FP8/INT8 engine
+# recipes); bf16 comparison is reported in the extras.
+QUANTIZE = "int8"
 
 
 def bench_cfg():
@@ -50,13 +55,14 @@ def bench_cfg():
         page_size=16, max_pages_per_seq=64)
 
 
-async def run_engine_bench(cfg):
+async def run_engine_bench(cfg, quantize=QUANTIZE):
     from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
     from dynamo_tpu.runtime.context import Context
 
     eng = TpuEngine(TpuEngineConfig(
         model=cfg, num_pages=2048, max_batch_size=BATCH, prefill_chunk=128,
-        default_max_tokens=OSL, decode_steps_per_sync=K_STEPS))
+        default_max_tokens=OSL, decode_steps_per_sync=K_STEPS,
+        quantize=quantize))
 
     async def one(i, osl=OSL):
         req = {"token_ids": [(7 * i + j) % 31999 + 1 for j in range(ISL)],
@@ -181,6 +187,7 @@ def main():
         "hbm_util_pct": round(
             100.0 * hbm / loop_step_s / 1e9 / V5E_HBM_GBPS, 1),
         "isl": ISL, "osl": OSL, "n_requests": N_REQS, "batch": BATCH,
+        "quantize": QUANTIZE,
         **kv_stats,
     }))
 

@@ -47,6 +47,7 @@ from dynamo_tpu.protocols import (
     KvCacheEvent,
     KvStats,
     PreprocessedRequest,
+    SpecDecodeStats,
     WorkerStats,
 )
 from dynamo_tpu.runtime.context import Context
@@ -83,6 +84,19 @@ class TpuEngineConfig:
     # step runs SPMD over it. One engine = one rank's (sub)mesh; dp ranks
     # each own a disjoint tp submesh (WorkerWithDpRank addressing).
     mesh: Optional[Any] = None
+    # Weight quantization: None (bf16) or "int8" (per-channel weight-only,
+    # engine/quant.py). Halves the decode weight-stream floor; applied
+    # device-side with donation after params are placed.
+    quantize: Optional[str] = None
+    # Speculative decoding (engine/spec.py): a small draft model proposes
+    # spec_gamma tokens per iteration, the target verifies them in ONE
+    # forward. Must share the target's page geometry (page_size,
+    # max_pages_per_seq) — draft caches are indexed by the same page
+    # tables. Spec bursts serve batches whose lanes all have top_p == 1
+    # and top_k == 0; other batches take the normal fused decode path.
+    draft_model: Optional[LlamaConfig] = None
+    spec_gamma: int = 4
+    spec_iters_per_sync: int = 8
 
 
 @dataclass
@@ -97,6 +111,7 @@ class _Seq:
     # disagg: host KV data to preload into this seq's pages before prefill
     import_kv: Optional[tuple] = None     # (np array (2,L,KVH,n,P,D), len)
     cached_len: int = 0                   # prefix-cache hit length
+    draft_pos: int = 0                    # draft-cache-valid positions < this
     next_token: int = -1                  # sampled, KV not yet written
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
@@ -120,7 +135,7 @@ class TpuEngine:
                  params: Optional[dict] = None,
                  event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
                  metrics_sink: Optional[Callable[[ForwardPassMetrics], None]]
-                 = None) -> None:
+                 = None, draft_params: Optional[dict] = None) -> None:
         self.config = config or TpuEngineConfig()
         cfg = self.config
         self.model_cfg = cfg.model
@@ -153,6 +168,47 @@ class TpuEngine:
                 lambda: init_cache(mcfg, cfg.num_pages),
                 out_shardings=cache_sharding(cfg.mesh),
             )()
+        self.draft_params = None
+        self.dk_cache = self.dv_cache = None
+        self._spec_stats = None
+        if cfg.draft_model is not None:
+            dm = cfg.draft_model
+            if (dm.page_size != mcfg.page_size
+                    or dm.max_pages_per_seq != mcfg.max_pages_per_seq):
+                raise ValueError(
+                    "draft model must share the target's page geometry")
+            self._spec_stats = SpecDecodeStats()
+            if cfg.mesh is None:
+                self.draft_params = draft_params if draft_params is not None \
+                    else init_params(
+                        jax.random.PRNGKey(cfg.rng_seed + 1), dm)
+                self.dk_cache, self.dv_cache = init_cache(dm, cfg.num_pages)
+            else:
+                from dynamo_tpu.engine.sharding import (
+                    cache_sharding,
+                    param_sharding,
+                    shard_params,
+                )
+
+                if draft_params is None:
+                    self.draft_params = jax.jit(
+                        lambda key: init_params(key, dm),
+                        out_shardings=param_sharding(cfg.mesh),
+                    )(jax.random.PRNGKey(cfg.rng_seed + 1))
+                else:
+                    self.draft_params = shard_params(draft_params, cfg.mesh)
+                self.dk_cache, self.dv_cache = jax.jit(
+                    lambda: init_cache(dm, cfg.num_pages),
+                    out_shardings=cache_sharding(cfg.mesh),
+                )()
+        if cfg.quantize:
+            if cfg.quantize != "int8":
+                raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
+            from dynamo_tpu.engine.quant import quantize_params_jit
+
+            self.params = quantize_params_jit(self.params)
+            if self.draft_params is not None:
+                self.draft_params = quantize_params_jit(self.draft_params)
         self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
                              cfg.worker_id, cfg.dp_rank, event_sink)
         self.kvbm = None   # set by kvbm.KvbmManager when attached
@@ -174,6 +230,18 @@ class TpuEngine:
         # deadline)); reaped by the scheduler loop after transfer_ttl.
         self._transfers: dict[str, tuple[list[int], int, float]] = {}
         self.transfer_ttl = 60.0
+
+    @property
+    def _burst_lookahead(self) -> int:
+        """Worst-case positions a single decode burst advances past the
+        admitted prompt+max_tokens — the admission guard must budget the
+        LARGER of the normal and spec burst shapes, or near-max-context
+        requests overflow max_pages_per_seq mid-decode."""
+        cfg = self.config
+        la = cfg.decode_steps_per_sync
+        if cfg.draft_model is not None:
+            la = max(la, cfg.spec_iters_per_sync * (cfg.spec_gamma + 1))
+        return la
 
     # -- engine contract ----------------------------------------------------
 
@@ -206,11 +274,11 @@ class TpuEngine:
                 return
             yield await self._embed_one(req)
             return
-        # decode bursts may overshoot by up to decode_steps_per_sync tokens
-        max_len = (mcfg.page_size * mcfg.max_pages_per_seq
-                   - cfg.decode_steps_per_sync)
+        # decode bursts may overshoot by up to one burst's lookahead
+        lookahead = self._burst_lookahead
+        max_len = mcfg.page_size * mcfg.max_pages_per_seq - lookahead
         need_pages = (len(req.token_ids) + req.stop.max_tokens
-                      + cfg.decode_steps_per_sync
+                      + lookahead
                       + mcfg.page_size - 1) // mcfg.page_size
         if len(req.token_ids) + req.stop.max_tokens > max_len \
                 or need_pages > self.pool.capacity:
@@ -405,6 +473,12 @@ class TpuEngine:
             return False
         mcfg, cfg = self.model_cfg, self.config
 
+        def run_chunks(params_, model_cfg, kc, vc, offsets):
+            return self._chunk_rounds(
+                params_, model_cfg, kc, vc, pending, offsets,
+                tokens_of=lambda s: s.prompt,
+                target_len_of=lambda s: len(s.prompt))
+
         def prefill_all():
             for seq in pending:
                 if seq.import_kv is not None:
@@ -413,50 +487,20 @@ class TpuEngine:
                     self.write_kv_pages(seq.pages[:n_pages], data)
                     seq.import_kv = None
             offsets = {id(s): s.cached_len for s in pending}
-            last_logits: dict[int, Any] = {}
-            while True:
-                ready = [s for s in pending
-                         if offsets[id(s)] < len(s.prompt)]
-                if not ready:
-                    break
-                # rounds are grouped by page-alignment of the cached
-                # offset: mid-page starts (disagg imports) need the row
-                # write path — batching them with aligned lanes would
-                # drag everyone onto it
-                aligned_s = [s for s in ready
-                             if offsets[id(s)] % mcfg.page_size == 0]
-                active = aligned_s or ready
-                aligned = bool(aligned_s)
-                # pow2 batch width: compiles stay bounded to log2 widths
-                # per bucket while low-concurrency prefill (compute-bound,
-                # unlike decode) avoids paying max_batch_size× the FLOPs
-                bp = _next_pow2(len(active), 1, cfg.max_batch_size)
-                active = active[:bp]
-                chunk_lens = [min(len(s.prompt) - offsets[id(s)],
-                                  cfg.prefill_chunk) for s in active]
-                t_bucket = _next_pow2(max(chunk_lens),
-                                      cfg.min_prefill_bucket,
-                                      cfg.prefill_chunk)
-                toks = np.zeros((bp, t_bucket), dtype=np.int32)
-                tables = np.zeros((bp, mcfg.max_pages_per_seq),
-                                  dtype=np.int32)
-                cached = np.zeros(bp, dtype=np.int32)
-                seq_lens = np.zeros(bp, dtype=np.int32)
-                for i, s in enumerate(active):
-                    off, n = offsets[id(s)], chunk_lens[i]
-                    toks[i, :n] = s.prompt[off:off + n]
-                    tables[i, :len(s.pages)] = s.pages
-                    cached[i] = off
-                    seq_lens[i] = off + n
-                logits_b, self.k_cache, self.v_cache = prefill_batch(
-                    self.params, self.k_cache, self.v_cache,
-                    jax.numpy.asarray(toks), jax.numpy.asarray(tables),
-                    jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
-                    mcfg, aligned)
-                for i, s in enumerate(active):
-                    offsets[id(s)] += chunk_lens[i]
-                    if offsets[id(s)] >= len(s.prompt):
-                        last_logits[id(s)] = logits_b[i]
+            self.k_cache, self.v_cache, last_logits = run_chunks(
+                self.params, mcfg, self.k_cache, self.v_cache, offsets)
+            if self.draft_params is not None:
+                # the draft's paged cache must hold the prompt KV too —
+                # over the FULL prompt, never trusting the cached prefix:
+                # prefix pages can carry target-only KV (disagg imports,
+                # KVBM onboarding, pages registered during non-spec
+                # fallback bursts). Recomputing is cheap — the draft is
+                # small by construction — and rewriting shared pages is
+                # idempotent (same tokens ⇒ same values).
+                d_offsets = {id(s): 0 for s in pending}
+                self.dk_cache, self.dv_cache, _ = run_chunks(
+                    self.draft_params, self.config.draft_model,
+                    self.dk_cache, self.dv_cache, d_offsets)
             # pad to a fixed width so sampling compiles exactly once
             width = cfg.max_batch_size
             stack = [last_logits[id(s)] for s in pending]
@@ -491,6 +535,7 @@ class TpuEngine:
                     seq.pages[block.block_index], block.seq_hash,
                     block.local_hash, block.parent_seq_hash)
             seq.prefilled = True
+            seq.draft_pos = len(seq.prompt)
             self._emit_token(seq, int(token), float(lp))
         return True
 
@@ -504,7 +549,17 @@ class TpuEngine:
         # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
         # compilation for the engine's lifetime. Underfull lanes/steps waste
         # a little compute; recompiles (tens of seconds) waste far more.
-        k_steps = cfg.decode_steps_per_sync
+        # Spec bursts only serve sampling configs the rejection test
+        # covers (no nucleus/top-k filtering) — mixed batches fall back.
+        # checked over ALL runnable lanes (not just the first batch-width):
+        # preemption inside the page-allocation loop below can promote a
+        # later lane into the batch, and a nucleus/top-k lane must never
+        # ride a spec burst
+        use_spec = self.draft_params is not None and all(
+            s.req.sampling.top_p >= 1.0 and s.req.sampling.top_k == 0
+            for s in runnable)
+        k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
+                   if use_spec else cfg.decode_steps_per_sync)
         # every runnable seq needs pages covering pos .. pos+k_steps-1
         for s in list(runnable):
             if s not in runnable:
@@ -554,6 +609,56 @@ class TpuEngine:
             top_ps[i] = s.req.sampling.top_p
             top_ks[i] = s.req.sampling.top_k
 
+        if use_spec:
+            from dynamo_tpu.engine.spec import spec_decode_multi_step
+
+            stale = [s for s in batch if s.draft_pos < s.pos]
+            if stale:
+                # tokens decoded via non-spec fallback bursts never wrote
+                # draft KV; replay them through the draft before the spec
+                # burst or its proposals attend garbage
+                await self._draft_catchup(stale)
+
+            def run_spec_burst():
+                packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
+                    self.params, self.draft_params,
+                    self.k_cache, self.v_cache, self.dk_cache,
+                    self.dv_cache, jax.numpy.asarray(tokens),
+                    jax.numpy.asarray(positions),
+                    jax.numpy.asarray(page_tables),
+                    jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                    jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    mcfg, cfg.draft_model, cfg.spec_gamma,
+                    cfg.spec_iters_per_sync)
+                return np.asarray(packed), kc, vc, dk, dv  # ONE host sync
+
+            async with self._device_lock:
+                (packed, self.k_cache, self.v_cache, self.dk_cache,
+                 self.dv_cache) = await asyncio.to_thread(run_spec_burst)
+            toks_out = packed[0].astype(np.int32)   # (S, gamma+1, B)
+            lps_out = packed[1]                     # (S, gamma+1, B)
+            counts = packed[2, :, 0, :].astype(np.int32)  # (S, B)
+            st = self._spec_stats
+            for i, s in enumerate(batch):
+                for it in range(cfg.spec_iters_per_sync):
+                    if s.finished or s not in self._running:
+                        break  # overshoot iterations discarded
+                    n_emit = int(counts[it, i])
+                    st.num_draft_tokens += cfg.spec_gamma
+                    st.num_accepted_tokens += n_emit - 1
+                    for k in range(n_emit):
+                        if s.finished or s not in self._running:
+                            break
+                        block = s.token_seq.append(s.next_token)
+                        if block is not None:
+                            self.pool.register_page(
+                                s.pages[block.block_index], block.seq_hash,
+                                block.local_hash, block.parent_seq_hash)
+                        self._emit_token(s, int(toks_out[it, k, i]),
+                                         float(lps_out[it, k, i]))
+                s.draft_pos = s.pos
+            return True
+
         def run_burst():
             sampled, kc, vc = decode_multi_step(
                 self.params, self.k_cache, self.v_cache,
@@ -582,6 +687,78 @@ class TpuEngine:
                 self._emit_token(s, int(sampled[k, i]),
                                  float(logprobs[k, i]))
         return True
+
+    def _chunk_rounds(self, params_, model_cfg, kc, vc, seqs, offsets,
+                      tokens_of, target_len_of):
+        """Batched prefill chunk rounds over `seqs` until every seq's
+        offset reaches target_len_of(s). tokens_of(s) supplies the token
+        list offsets index into. Returns (kc, vc, final-round logits per
+        seq id). Shared by prompt prefill (target AND draft) and the
+        draft catch-up replay, so bucketing/compile shapes can't diverge
+        between them."""
+        cfg = self.config
+        last_logits: dict[int, Any] = {}
+        while True:
+            ready = [s for s in seqs if offsets[id(s)] < target_len_of(s)]
+            if not ready:
+                break
+            # rounds are grouped by page-alignment of the cached
+            # offset: mid-page starts (disagg imports) need the row
+            # write path — batching them with aligned lanes would
+            # drag everyone onto it
+            aligned_s = [s for s in ready
+                         if offsets[id(s)] % model_cfg.page_size == 0]
+            active = aligned_s or ready
+            aligned = bool(aligned_s)
+            # pow2 batch width: compiles stay bounded to log2 widths
+            # per bucket while low-concurrency prefill (compute-bound,
+            # unlike decode) avoids paying max_batch_size× the FLOPs
+            bp = _next_pow2(len(active), 1, cfg.max_batch_size)
+            active = active[:bp]
+            chunk_lens = [min(target_len_of(s) - offsets[id(s)],
+                              cfg.prefill_chunk) for s in active]
+            t_bucket = _next_pow2(max(chunk_lens),
+                                  cfg.min_prefill_bucket,
+                                  cfg.prefill_chunk)
+            toks = np.zeros((bp, t_bucket), dtype=np.int32)
+            tables = np.zeros((bp, model_cfg.max_pages_per_seq),
+                              dtype=np.int32)
+            cached = np.zeros(bp, dtype=np.int32)
+            seq_lens = np.zeros(bp, dtype=np.int32)
+            for i, s in enumerate(active):
+                off, n = offsets[id(s)], chunk_lens[i]
+                toks[i, :n] = tokens_of(s)[off:off + n]
+                tables[i, :len(s.pages)] = s.pages
+                cached[i] = off
+                seq_lens[i] = off + n
+            logits_b, kc, vc = prefill_batch(
+                params_, kc, vc,
+                jax.numpy.asarray(toks), jax.numpy.asarray(tables),
+                jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
+                model_cfg, aligned)
+            for i, s in enumerate(active):
+                offsets[id(s)] += chunk_lens[i]
+                if offsets[id(s)] >= target_len_of(s):
+                    last_logits[id(s)] = logits_b[i]
+        return kc, vc, last_logits
+
+    async def _draft_catchup(self, lanes: list[_Seq]) -> None:
+        """Replay tokens the draft cache is missing (positions
+        draft_pos..pos-1, known from token_seq) through draft prefill
+        rounds."""
+
+        def rounds():
+            offsets = {id(s): s.draft_pos for s in lanes}
+            self.dk_cache, self.dv_cache, _ = self._chunk_rounds(
+                self.draft_params, self.config.draft_model,
+                self.dk_cache, self.dv_cache, lanes, offsets,
+                tokens_of=lambda s: s.token_seq.tokens,
+                target_len_of=lambda s: s.pos)
+
+        async with self._device_lock:
+            await asyncio.to_thread(rounds)
+        for s in lanes:
+            s.draft_pos = s.pos
 
     # -- lifecycle helpers --------------------------------------------------
 
@@ -759,4 +936,5 @@ class TpuEngine:
                 kv_active_blocks=self.pool.active_pages,
                 kv_total_blocks=self.pool.capacity,
                 hbm_cache_usage=self.pool.usage()),
+            spec_decode_stats=self._spec_stats,
         ))

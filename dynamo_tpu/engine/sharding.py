@@ -72,10 +72,22 @@ def cache_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
+    from dynamo_tpu.engine.quant import QTensor, scale_spec
+
     specs = param_specs()
+
+    def place(x, s):
+        if isinstance(x, QTensor):
+            # weight shards like its bf16 twin; the (*1s, N) scale can only
+            # shard its last (output) dim
+            return QTensor(
+                q=jax.device_put(x.q, NamedSharding(mesh, s)),
+                s=jax.device_put(
+                    x.s, NamedSharding(mesh, scale_spec(s, x.s.ndim))))
+        return jax.device_put(x, NamedSharding(mesh, s))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs,
+        place, params, specs,
         is_leaf=lambda x: not isinstance(x, dict))
 
 

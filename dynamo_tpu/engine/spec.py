@@ -1,0 +1,207 @@
+"""Speculative decoding: draft-proposes, target-verifies, fused on device.
+
+Reference parity: the reference exposes speculative decode through its
+delegated engines and surfaces `SpecDecodeStats` in worker metrics
+(`lib/llm/src/kv_router/protocols.rs` ForwardPassMetrics). We own the
+implementation, TPU-first:
+
+- The draft model shares the TARGET's page tables: its paged KV caches are
+  allocated with the same (num_pages, page_size) geometry, so one page
+  allocation covers both models. The engine never trusts prefix pages to
+  hold draft KV (disagg imports, KVBM onboarding and non-spec fallback
+  bursts write target KV only): the draft prefills the full prompt and
+  replays fallback-decoded tokens (`_draft_catchup`) before a spec burst.
+- Rollback is FREE with paged attention: rejected positions leave garbage
+  KV in the cache, but attention masks strictly by sequence length, and
+  the next accepted tokens overwrite those slots. No copy, no rewind.
+- Acceptance runs on device inside a fused `num_iters` loop (one host
+  sync per burst, same contract as `decode_multi_step`): per-lane
+  Leviathan et al. rejection sampling —
+    greedy lanes  (temperature == 0): accept while target argmax == draft
+    stochastic lanes: accept draft token c with prob min(1, p_t(c)/p_d(c))
+      over the temperature-scaled full softmax; on rejection, resample
+      from the residual max(p_t - p_d, 0). The engine gates the spec path
+      to batches with top_p == 1 and top_k == 0 (the ratio test over
+      filtered distributions is not implemented — lanes with nucleus/top-k
+      sampling take the normal fused decode path instead).
+
+Output is PACKED into one f32 array (3, num_iters, gamma+1, B):
+row 0 token ids, row 1 chosen-token target logprobs, row 2 the per-lane
+emitted-count (broadcast) — one host transfer per burst (the tunnel
+charges ~95 ms per sync regardless of payload).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.engine.quant import qm
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    _decode_once,
+    paged_forward,
+    rms_norm,
+)
+
+# xor'd into seeds for the draft's sampling stream so draft and target
+# never consume the same (seed, step) randomness
+_DRAFT_SEED_SALT = jnp.uint32(0x9E3779B9)
+
+
+def _softmax_t(logits: jax.Array, temperature: jax.Array) -> jax.Array:
+    """Temperature-scaled softmax; temperature==0 lanes get a one-hot
+    argmax distribution (greedy as the T→0 limit, exact).
+
+    logits: (B, ..., V); temperature: (B,) broadcast over the middle dims.
+    """
+    shape = (temperature.shape[0],) + (1,) * (logits.ndim - 1)
+    tcol = temperature.reshape(shape)
+    t = jnp.where(tcol > 0, tcol, 1.0)
+    p = jax.nn.softmax(logits.astype(jnp.float32) / t, axis=-1)
+    hard = jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
+                          dtype=jnp.float32)
+    return jnp.where(tcol > 0, p, hard)
+
+
+def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
+    """Sample index from a probability vector (log trick; probs >= 0)."""
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)))
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "draft_cfg", "gamma", "num_iters"),
+         donate_argnums=(2, 3, 4, 5))
+def spec_decode_multi_step(
+        params: dict, draft_params: dict,
+        k_cache: tuple, v_cache: tuple,
+        dk_cache: tuple, dv_cache: tuple,
+        tokens: jax.Array, positions: jax.Array, page_tables: jax.Array,
+        valid: jax.Array, seeds: jax.Array, steps0: jax.Array,
+        temperature: jax.Array, cfg: LlamaConfig, draft_cfg: LlamaConfig,
+        gamma: int, num_iters: int):
+    """`num_iters` fused draft→verify→accept iterations, ONE host sync.
+
+    tokens/positions/valid/seeds/steps0/temperature: (B,). Pages for
+    positions .. positions + num_iters*(gamma+1) - 1 must be
+    pre-allocated in `page_tables` (engine guarantees).
+
+    Returns (packed (3, num_iters, gamma+1, B) f32, k_cache, v_cache,
+    dk_cache, dv_cache, new_positions (B,)); packed rows: token ids /
+    target logprobs / emitted-count per (iter, lane) (count broadcast
+    along the gamma+1 axis; slots >= count are padding).
+    """
+    B = tokens.shape[0]
+    G1 = gamma + 1
+    draft_seeds = seeds.astype(jnp.uint32) ^ _DRAFT_SEED_SALT
+
+    def one_iter(it, carry):
+        cur, pos, kc, vc, dk, dv, steps, out = carry
+
+        # -- draft: gamma autoregressive proposals (its own small cache).
+        # gamma+1 forwards: the last one's logits are unused but it WRITES
+        # d_gamma's KV, so after an all-accept iteration the draft cache
+        # has no hole at pos+gamma (a stale slot there would poison every
+        # later draft attention over it).
+        d_tokens = [cur]
+        d_probs = []
+        dtok = cur
+        for j in range(gamma + 1):
+            dlogits, dk, dv = _decode_once(
+                draft_params, dk, dv, dtok, pos + j, page_tables, valid,
+                draft_cfg)
+            if j == gamma:
+                break
+            dp = _softmax_t(dlogits, temperature)          # (B, V)
+            key = jax.vmap(
+                lambda s, st: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(s), st),
+                    jnp.uint32(j))
+            )(draft_seeds, steps)
+            stoch = jax.vmap(_categorical)(key, dp)
+            dtok = jnp.where(temperature > 0, stoch,
+                             jnp.argmax(dlogits, axis=-1)).astype(jnp.int32)
+            d_tokens.append(dtok)
+            d_probs.append(dp)
+        verify_toks = jnp.stack(d_tokens, axis=1)          # (B, G1)
+        draft_p = jnp.stack(d_probs, axis=1)               # (B, gamma, V)
+
+        # -- target: one forward over all G1 positions ---------------------
+        seq_lens = jnp.where(valid, pos + G1, pos)
+        x, kc, vc = paged_forward(params, kc, vc, verify_toks, page_tables,
+                                  pos, seq_lens, cfg, False)
+        logits = qm(x, params["lm_head"]).astype(jnp.float32)  # (B, G1, V)
+        target_p = _softmax_t(logits, temperature)         # (B, G1, V)
+
+        # -- acceptance ----------------------------------------------------
+        cand = verify_toks[:, 1:]                          # (B, gamma)
+        p_t = jnp.take_along_axis(
+            target_p[:, :gamma], cand[..., None], axis=-1)[..., 0]
+        p_d = jnp.take_along_axis(draft_p, cand[..., None], axis=-1)[..., 0]
+        ukey = jax.vmap(
+            lambda s, st: jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(s), st),
+                jnp.uint32(0x5EC0))
+        )(seeds.astype(jnp.uint32), steps)
+        u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(ukey)
+        ratio_ok = u * jnp.maximum(p_d, 1e-30) < p_t       # (B, gamma)
+        greedy_ok = jnp.argmax(logits[:, :gamma], axis=-1) == cand
+        ok = jnp.where((temperature > 0)[:, None], ratio_ok, greedy_ok)
+        n_acc = jnp.argmin(
+            jnp.concatenate([ok, jnp.zeros((B, 1), bool)], axis=1)
+            .astype(jnp.int32), axis=1)                    # leading trues
+
+        # -- extra token: residual sample (reject) or bonus (all accept) ---
+        l_at_n = jnp.take_along_axis(
+            logits, n_acc[:, None, None], axis=1)[:, 0]    # (B, V)
+        pt_at_n = jnp.take_along_axis(
+            target_p, n_acc[:, None, None], axis=1)[:, 0]
+        pd_at_n = jnp.take_along_axis(
+            jnp.concatenate(
+                [draft_p, jnp.zeros((B, 1, draft_p.shape[-1]),
+                                    jnp.float32)], axis=1),
+            n_acc[:, None, None], axis=1)[:, 0]
+        residual = jnp.maximum(pt_at_n - pd_at_n, 0.0)
+        res_mass = residual.sum(axis=-1, keepdims=True)
+        # degenerate residual (p_t == p_d exactly) → fall back to p_t
+        res_dist = jnp.where(res_mass > 1e-9, residual / res_mass, pt_at_n)
+        dist = jnp.where((n_acc == gamma)[:, None], pt_at_n, res_dist)
+        xkey = jax.vmap(
+            lambda s, st: jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(s), st),
+                jnp.uint32(0xB0E5))
+        )(seeds.astype(jnp.uint32), steps + n_acc)
+        stoch_x = jax.vmap(_categorical)(xkey, dist)
+        extra = jnp.where(temperature > 0, stoch_x,
+                          jnp.argmax(l_at_n, axis=-1)).astype(jnp.int32)
+
+        # -- emit ----------------------------------------------------------
+        emitted = jnp.where(
+            jnp.arange(gamma)[None, :] < n_acc[:, None], cand, 0)
+        emitted = jnp.concatenate([emitted, jnp.zeros((B, 1), jnp.int32)],
+                                  axis=1)                  # (B, G1)
+        emitted = emitted.at[jnp.arange(B), n_acc].set(extra)
+        count = n_acc + 1                                  # (B,)
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        chosen_lp = jnp.take_along_axis(
+            logp_all, emitted[..., None], axis=-1)[..., 0]  # (B, G1)
+
+        out = out.at[0, it].set(emitted.T.astype(jnp.float32))
+        out = out.at[1, it].set(chosen_lp.T)
+        out = out.at[2, it].set(
+            jnp.broadcast_to(count[None, :].astype(jnp.float32), (G1, B)))
+
+        last = emitted[jnp.arange(B), n_acc]
+        new_pos = jnp.where(valid, pos + count, pos)
+        return (last, new_pos, kc, vc, dk, dv,
+                steps + count.astype(jnp.uint32), out)
+
+    out0 = jnp.zeros((3, num_iters, G1, B), dtype=jnp.float32)
+    cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, out = lax.fori_loop(
+        0, num_iters, one_iter,
+        (tokens, positions, k_cache, v_cache, dk_cache, dv_cache,
+         steps0.astype(jnp.uint32), out0))
+    return out, k_cache, v_cache, dk_cache, dv_cache, pos

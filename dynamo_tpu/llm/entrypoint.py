@@ -167,6 +167,9 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      decode_steps_per_sync: int = 8, mesh=None,
                      worker_id: int = 0, dp_rank: int = 0,
                      random_init: bool = False, kvbm_host_blocks: int = 0,
+                     quantize: Optional[str] = None,
+                     draft_model: Optional[str] = None, spec_gamma: int = 4,
+                     spec_iters_per_sync: int = 8,
                      **model_overrides):
     """(TpuEngine, ModelDeploymentCard) for a real checkpoint.
 
@@ -175,6 +178,9 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
     frontends build the matching HF tokenizer. `random_init=True` skips
     the weight read (benchmarks on synthetic weights). `model_overrides`
     tune geometry, e.g. ``max_pages_per_seq`` to bound context.
+    `quantize="int8"` serves weight-only-quantized (engine/quant.py);
+    `draft_model` names a second (small) checkpoint for speculative
+    decoding — its page geometry is forced to the target's.
     """
     import os
 
@@ -188,12 +194,23 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
     path = resolve_model(model)
     cfg = config_from_hf(path, **model_overrides)
     params = None if random_init else load_llama_params(path, cfg)
+    draft_cfg = draft_params = None
+    if draft_model is not None:
+        dpath = resolve_model(draft_model)
+        draft_cfg = config_from_hf(
+            dpath, page_size=cfg.page_size,
+            max_pages_per_seq=cfg.max_pages_per_seq)
+        draft_params = None if random_init \
+            else load_llama_params(dpath, draft_cfg)
     engine = TpuEngine(
         TpuEngineConfig(model=cfg, num_pages=num_pages,
                         max_batch_size=max_batch_size,
                         decode_steps_per_sync=decode_steps_per_sync,
-                        mesh=mesh, worker_id=worker_id, dp_rank=dp_rank),
-        params=params)
+                        mesh=mesh, worker_id=worker_id, dp_rank=dp_rank,
+                        quantize=quantize, draft_model=draft_cfg,
+                        spec_gamma=spec_gamma,
+                        spec_iters_per_sync=spec_iters_per_sync),
+        params=params, draft_params=draft_params)
     if kvbm_host_blocks:
         from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
 

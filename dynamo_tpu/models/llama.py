@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.engine.attention import paged_attention_decode, prefill_attention
+from dynamo_tpu.engine.quant import qm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,8 +181,8 @@ def _write_kv(k_cache: jax.Array, v_cache: jax.Array, k: jax.Array,
 
 
 def _swiglu(h: jax.Array, lp: dict) -> jax.Array:
-    gate = jax.nn.silu(h @ lp["w_gate"])
-    return (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(qm(h, lp["w_gate"]))
+    return qm(gate * qm(h, lp["w_up"]), lp["w_down"])
 
 
 # ---------------------------------------------------------------------------
@@ -213,28 +214,16 @@ def prefill_step(params: dict, k_cache: tuple, v_cache: tuple,
     return logits[0], k_cache, v_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "aligned"), donate_argnums=(1, 2))
-def prefill_batch(params: dict, k_cache: tuple, v_cache: tuple,
+def paged_forward(params: dict, k_cache: tuple, v_cache: tuple,
                   tokens: jax.Array, page_tables: jax.Array,
                   cached_lens: jax.Array, seq_lens: jax.Array,
                   cfg: LlamaConfig, aligned: bool = False
                   ) -> tuple[jax.Array, tuple, tuple]:
-    """Prefill a BATCH of sequences' chunks in one device pass.
-
-    tokens: (Bp, T) uncached suffix chunks (padded); page_tables:
-    (Bp, max_pages); cached_lens/seq_lens: (Bp,). Returns (last-token
-    logits (Bp, V), caches). One weight stream serves all Bp sequences —
-    per-sequence prefill re-reads every weight per sequence, which
-    dominated serving TTFT (measured 8.7 ms/seq vs ~10 ms for a whole
-    batched round on the r2 bench model).
-
-    Padding lanes (seq_len == cached_len) write only to scratch page 0 and
-    produce garbage logits the engine ignores.
-
-    `aligned` (static): caller guarantees every cached_len is a multiple
-    of page_size AND T is — enabling the full-page store kernel
-    (kernels.paged_kv_write_pages) instead of per-row writes.
-    """
+    """Paged multi-token forward shared by prefill and spec-verify
+    (traceable): writes the chunk's KV into the paged caches, attends
+    causally against cache + chunk, returns the FINAL-NORMED hidden
+    states for every position ((Bp, T, E), k_cache, v_cache) — callers
+    pick which positions to project through lm_head."""
     from dynamo_tpu.engine.attention import use_pallas
     from dynamo_tpu.engine.kernels import (
         kv_write_supported,
@@ -272,9 +261,9 @@ def prefill_batch(params: dict, k_cache: tuple, v_cache: tuple,
         lp = _layer_params(params, l)
         kc, vc = k_cache[l], v_cache[l]
         hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = (hn @ lp["wq"]).reshape(Bp, T, cfg.num_heads, cfg.head_dim)
-        k = (hn @ lp["wk"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
-        v = (hn @ lp["wv"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        q = qm(hn, lp["wq"]).reshape(Bp, T, cfg.num_heads, cfg.head_dim)
+        k = qm(hn, lp["wk"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        v = qm(hn, lp["wv"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if page_path:
@@ -288,17 +277,45 @@ def prefill_batch(params: dict, k_cache: tuple, v_cache: tuple,
                 q1, kc, vc, pt, q_positions=pos1, seq_len=sl,
                 page_size=cfg.page_size)
         )(q, page_tables, positions, seq_lens)             # (Bp, T, H, D)
-        x = x + attn.reshape(Bp, T, -1) @ lp["wo"]
+        x = x + qm(attn.reshape(Bp, T, -1), lp["wo"])
         hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _swiglu(hn, lp)
         new_k.append(kc)
         new_v.append(vc)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return x, tuple(new_k), tuple(new_v)
+
+
+@partial(jax.jit, static_argnames=("cfg", "aligned"), donate_argnums=(1, 2))
+def prefill_batch(params: dict, k_cache: tuple, v_cache: tuple,
+                  tokens: jax.Array, page_tables: jax.Array,
+                  cached_lens: jax.Array, seq_lens: jax.Array,
+                  cfg: LlamaConfig, aligned: bool = False
+                  ) -> tuple[jax.Array, tuple, tuple]:
+    """Prefill a BATCH of sequences' chunks in one device pass.
+
+    tokens: (Bp, T) uncached suffix chunks (padded); page_tables:
+    (Bp, max_pages); cached_lens/seq_lens: (Bp,). Returns (last-token
+    logits (Bp, V), caches). One weight stream serves all Bp sequences —
+    per-sequence prefill re-reads every weight per sequence, which
+    dominated serving TTFT (measured 8.7 ms/seq vs ~10 ms for a whole
+    batched round on the r2 bench model).
+
+    Padding lanes (seq_len == cached_len) write only to scratch page 0 and
+    produce garbage logits the engine ignores.
+
+    `aligned` (static): caller guarantees every cached_len is a multiple
+    of page_size AND T is — enabling the full-page store kernel
+    (kernels.paged_kv_write_pages) instead of per-row writes.
+    """
+    x, k_cache, v_cache = paged_forward(
+        params, k_cache, v_cache, tokens, page_tables, cached_lens,
+        seq_lens, cfg, aligned)
     last = jnp.maximum(seq_lens - cached_lens - 1, 0)      # (Bp,)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-    logits = x_last @ params["lm_head"]                    # (Bp, V)
-    return logits.astype(jnp.float32), tuple(new_k), tuple(new_v)
+    logits = qm(x_last, params["lm_head"])                 # (Bp, V)
+    return logits.astype(jnp.float32), k_cache, v_cache
 
 
 def _decode_once(params: dict, k_cache: tuple, v_cache: tuple,
@@ -318,22 +335,22 @@ def _decode_once(params: dict, k_cache: tuple, v_cache: tuple,
         lp = _layer_params(params, l)
         kc, vc = k_cache[l], v_cache[l]
         hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = (hn @ lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
-        k = (hn @ lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        v = (hn @ lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q = qm(hn, lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
+        k = qm(hn, lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = qm(hn, lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
         k = rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
         kc, vc = _write_kv(kc, vc, k, v, page_ids, offsets, valid)
         attn = paged_attention_decode(
             q, kc, vc, lengths, page_tables, page_size=cfg.page_size)
-        x = x + attn.reshape(B, -1) @ lp["wo"]
+        x = x + qm(attn.reshape(B, -1), lp["wo"])
         hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _swiglu(hn, lp)
         new_k.append(kc)
         new_v.append(vc)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = x @ params["lm_head"]                         # (B, V)
+    logits = qm(x, params["lm_head"])                      # (B, V)
     return logits.astype(jnp.float32), tuple(new_k), tuple(new_v)
 
 
@@ -410,10 +427,10 @@ def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
     B, T, _ = x.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = rope((h @ lp["wq"]).reshape(B, T, H, D), positions, cfg.rope_theta)
-    k = rope((h @ lp["wk"]).reshape(B, T, KVH, D), positions,
+    q = rope(qm(h, lp["wq"]).reshape(B, T, H, D), positions, cfg.rope_theta)
+    k = rope(qm(h, lp["wk"]).reshape(B, T, KVH, D), positions,
              cfg.rope_theta)
-    v = (h @ lp["wv"]).reshape(B, T, KVH, D)
+    v = qm(h, lp["wv"]).reshape(B, T, KVH, D)
     if KVH != H:
         k = jnp.repeat(k, H // KVH, axis=2)
         v = jnp.repeat(v, H // KVH, axis=2)
@@ -423,7 +440,7 @@ def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
     scores = jnp.where(mask[None, None], scores, -1e30)
     attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1),
                       v.astype(jnp.float32)).astype(x.dtype)
-    return x + attn.reshape(B, T, H * D) @ lp["wo"]
+    return x + qm(attn.reshape(B, T, H * D), lp["wo"])
 
 
 @partial(jax.jit, static_argnames=("cfg",))

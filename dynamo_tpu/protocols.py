@@ -90,6 +90,25 @@ class KvStats:
 
 
 @dataclass
+class SpecDecodeStats:
+    """Speculative-decode counters (reference: SpecDecodeStats in the
+    worker ForwardPassMetrics). Cumulative since engine start."""
+
+    num_draft_tokens: int = 0           # proposed by the draft model
+    num_accepted_tokens: int = 0        # survived target verification
+
+    @property
+    def acceptance_rate(self) -> float:
+        return (self.num_accepted_tokens / self.num_draft_tokens
+                if self.num_draft_tokens else 0.0)
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["acceptance_rate"] = round(self.acceptance_rate, 4)
+        return d
+
+
+@dataclass
 class ForwardPassMetrics:
     """Published per scheduler iteration (reference publisher.rs:691)."""
 
@@ -97,13 +116,17 @@ class ForwardPassMetrics:
     dp_rank: int = 0
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
+    spec_decode_stats: Optional["SpecDecodeStats"] = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "worker_id": self.worker_id, "dp_rank": self.dp_rank,
             "worker_stats": self.worker_stats.to_dict(),
             "kv_stats": self.kv_stats.to_dict(),
         }
+        if self.spec_decode_stats is not None:
+            d["spec_decode_stats"] = self.spec_decode_stats.to_dict()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":
@@ -111,11 +134,15 @@ class ForwardPassMetrics:
             return {k: v for k, v in dd.items()
                     if k in klass.__dataclass_fields__}
 
+        spec = d.get("spec_decode_stats")
         return cls(
             worker_id=d.get("worker_id", 0), dp_rank=d.get("dp_rank", 0),
             worker_stats=WorkerStats(**known(WorkerStats,
                                              d.get("worker_stats", {}))),
             kv_stats=KvStats(**known(KvStats, d.get("kv_stats", {}))),
+            spec_decode_stats=(
+                SpecDecodeStats(**known(SpecDecodeStats, spec))
+                if spec is not None else None),
         )
 
 

@@ -71,6 +71,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--context-length", type=int, default=None,
                    help="override model context (max_pages_per_seq)")
+    p.add_argument("--quantize", default=None, choices=["int8"],
+                   help="weight-only quantization for the TPU engine")
+    p.add_argument("--draft-model", default=None,
+                   help="small checkpoint for speculative decoding")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="draft tokens proposed per spec iteration")
+    p.add_argument("--spec-iters-per-sync", type=int, default=8,
+                   help="fused spec iterations per host sync (scales "
+                        "burst length and the admission lookahead)")
     p.add_argument("--random-init", action="store_true",
                    help="skip weight load (synthetic benchmarking)")
     mn = p.add_argument_group(
@@ -149,7 +158,10 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         decode_steps_per_sync=args.decode_steps_per_sync,
         worker_id=instance_id, mesh=mesh,
         random_init=args.random_init,
-        kvbm_host_blocks=args.kvbm_host_blocks, **overrides)
+        kvbm_host_blocks=args.kvbm_host_blocks,
+        quantize=args.quantize, draft_model=args.draft_model,
+        spec_gamma=args.spec_gamma,
+        spec_iters_per_sync=args.spec_iters_per_sync, **overrides)
     if mesh is not None:
         card.runtime_config.tensor_parallel_size = args.tensor_parallel_size
     engine.config.prefill_chunk = args.prefill_chunk

@@ -1,0 +1,150 @@
+"""Int8 weight-only quantization (engine/quant.py).
+
+Scheme check: per-output-channel symmetric int8 with the scale applied to
+the matmul output is EXACT w.r.t. quantizing the weight itself —
+``(x @ q) * s == x @ (q * s)`` — so the only error is the int8 rounding
+of W, bounded by s/2 per element.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.quant import (
+    QTensor,
+    qm,
+    quantize,
+    quantize_params,
+)
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    init_cache,
+    init_params,
+    prefill_step,
+)
+
+set_attention_impl("xla")
+
+CFG = LlamaConfig.tiny()
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == w.shape
+    assert qt.s.shape == (1, 32)
+    deq = qt.q.astype(jnp.float32) * qt.s
+    # rounding error ≤ s/2 per element
+    assert np.all(np.abs(np.asarray(deq - w)) <= np.asarray(qt.s) / 2 + 1e-7)
+
+
+def test_qm_matches_dequantized_matmul():
+    k = jax.random.split(jax.random.PRNGKey(1), 2)
+    x = jax.random.normal(k[0], (4, 64), jnp.float32)
+    w = jax.random.normal(k[1], (64, 32), jnp.float32)
+    qt = quantize(w)
+    got = qm(x, qt)
+    want = x @ (qt.q.astype(jnp.float32) * qt.s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # and close to the unquantized product (int8 rounding only)
+    err = float(jnp.max(jnp.abs(got - x @ w)))
+    assert err < 0.05 * float(jnp.max(jnp.abs(x @ w)))
+
+
+def test_qm_plain_array_passthrough():
+    x = jnp.ones((2, 8), jnp.bfloat16)
+    w = jnp.ones((8, 4), jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(qm(x, w)), np.asarray(x @ w))
+
+
+def test_quantized_params_halve_bytes():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    qp = quantize_params(params)
+    dense = sum(x.nbytes for k, x in params["layers"].items()
+                if k not in ("attn_norm", "mlp_norm"))
+    qdense = sum(qp["layers"][k].nbytes for k in qp["layers"]
+                 if k not in ("attn_norm", "mlp_norm"))
+    assert qdense < 0.6 * dense
+    assert isinstance(qp["layers"]["wq"], QTensor)
+    assert isinstance(qp["lm_head"], QTensor)
+    # embeddings/norms untouched
+    assert qp["embed"] is params["embed"]
+
+
+def test_layer_slice_maps_through_qtensor():
+    # models/llama.py _layer_params tree-maps w[l] over the layer dict;
+    # QTensor must slice q and s together
+    params = quantize_params(init_params(jax.random.PRNGKey(0), CFG))
+    lp = jax.tree.map(lambda w: w[0], params["layers"])
+    assert lp["wq"].q.ndim == 2
+    assert lp["wq"].s.shape == (1, CFG.num_heads * CFG.head_dim)
+
+
+def test_prefill_logits_close_to_bf16():
+    tokens = list(range(1, 11))
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    pt = np.zeros(CFG.max_pages_per_seq, dtype=np.int32)
+    pt[:4] = np.arange(1, 5)
+    pt = jnp.asarray(pt)
+
+    def run(p):
+        kc, vc = init_cache(CFG, 32)
+        padded = np.zeros(16, dtype=np.int32)
+        padded[:len(tokens)] = tokens
+        logits, _, _ = prefill_step(p, kc, vc, jnp.asarray(padded), pt,
+                                    jnp.int32(0), jnp.int32(len(tokens)),
+                                    CFG)
+        return np.asarray(logits)
+
+    base = run(params)
+    quant = run(quantize_params(params))
+    scale = np.abs(base).max()
+    assert np.abs(quant - base).max() < 0.1 * scale
+
+
+async def test_engine_int8_generates_deterministically():
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.runtime.context import Context
+
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=2, quantize="int8",
+        default_max_tokens=8))
+    req = {"token_ids": [1, 2, 3, 4, 5], "model": "m",
+           "sampling": {"temperature": 0.0}, "stop": {"max_tokens": 8}}
+
+    async def collect():
+        toks = []
+        async for o in eng.generate(dict(req), Context()):
+            toks += o.get("token_ids", [])
+        return toks
+
+    a = await collect()
+    b = await collect()
+    assert len(a) == 8 and a == b
+    await eng.close()
+
+
+def test_sharded_quantized_prefill_matches_unsharded(cpu_mesh_devices):
+    from dynamo_tpu.engine.sharding import make_mesh, shard_cache, shard_params
+
+    mesh = make_mesh(dp=1, tp=2, devices=cpu_mesh_devices)
+    tokens = list(range(1, 11))
+    params = quantize_params(init_params(jax.random.PRNGKey(0), CFG))
+    pt = np.zeros(CFG.max_pages_per_seq, dtype=np.int32)
+    pt[:4] = np.arange(1, 5)
+    pt = jnp.asarray(pt)
+    padded = np.zeros(16, dtype=np.int32)
+    padded[:len(tokens)] = tokens
+
+    kc, vc = init_cache(CFG, 32)
+    ref, _, _ = prefill_step(params, kc, vc, jnp.asarray(padded), pt,
+                             jnp.int32(0), jnp.int32(len(tokens)), CFG)
+
+    sp = shard_params(params, mesh)
+    assert isinstance(sp["layers"]["wq"], QTensor)
+    skc, svc = shard_cache(init_cache(CFG, 32), mesh)
+    got, _, _ = prefill_step(sp, skc, svc, jnp.asarray(padded), pt,
+                             jnp.int32(0), jnp.int32(len(tokens)), CFG)
+    assert float(jnp.max(jnp.abs(got - ref))) < 4e-2

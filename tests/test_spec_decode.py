@@ -1,0 +1,171 @@
+"""Speculative decoding (engine/spec.py + engine integration).
+
+The load-bearing property: GREEDY spec output equals the target-only
+greedy sequence for ANY draft — accepted tokens pass the argmax-equality
+test and the extra token is itself a target argmax, so the draft only
+changes HOW FAST tokens come out, never WHICH tokens. That makes
+"random draft, greedy, compare against no-draft engine" the strongest
+rollback/cache-garbage test available.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+CFG = LlamaConfig.tiny()
+PROMPT = [1, 2, 3, 4, 5, 6, 7]
+
+
+async def run_engine(draft_params=None, draft_cfg=None, temperature=0.0,
+                     top_p=1.0, n_tokens=24, metrics=None):
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2,
+        default_max_tokens=n_tokens, decode_steps_per_sync=4,
+        draft_model=draft_cfg, spec_gamma=3, spec_iters_per_sync=2),
+        draft_params=draft_params, metrics_sink=metrics)
+    req = {"token_ids": list(PROMPT), "model": "m",
+           "sampling": {"temperature": temperature, "top_p": top_p},
+           "stop": {"max_tokens": n_tokens}}
+    toks = []
+    async for o in eng.generate(req, Context()):
+        toks += o.get("token_ids", [])
+    stats = eng._spec_stats
+    await eng.close()
+    return toks, stats
+
+
+async def test_greedy_spec_with_random_draft_matches_target_only():
+    base, _ = await run_engine()
+    # a draft with DIFFERENT weights: low acceptance, same greedy output
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    spec, stats = await run_engine(draft_params=draft_params, draft_cfg=CFG)
+    assert spec == base
+    assert stats.num_draft_tokens > 0
+
+
+async def test_greedy_spec_with_self_draft_accepts_everything():
+    base, _ = await run_engine()
+    # draft == target: every proposal verifies (modulo bf16 near-ties
+    # between the decode and verify attention paths)
+    target_params = init_params(jax.random.PRNGKey(0), CFG)
+    spec, stats = await run_engine(draft_params=target_params,
+                                   draft_cfg=CFG)
+    assert spec == base
+    assert stats.acceptance_rate > 0.8, stats.to_dict()
+
+
+async def test_stochastic_spec_self_draft_high_acceptance():
+    target_params = init_params(jax.random.PRNGKey(0), CFG)
+    toks, stats = await run_engine(draft_params=target_params,
+                                   draft_cfg=CFG, temperature=0.8)
+    assert len(toks) == 24
+    # p_t == p_d ⇒ the ratio test accepts with probability ~1
+    assert stats.acceptance_rate > 0.8, stats.to_dict()
+
+
+async def test_nucleus_lane_falls_back_to_normal_decode():
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    toks, stats = await run_engine(draft_params=draft_params, draft_cfg=CFG,
+                                   temperature=0.8, top_p=0.5)
+    assert len(toks) == 24
+    assert stats.num_draft_tokens == 0  # spec path never engaged
+
+
+async def test_spec_output_deterministic():
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    a, _ = await run_engine(draft_params=draft_params, draft_cfg=CFG,
+                            temperature=0.7)
+    b, _ = await run_engine(draft_params=draft_params, draft_cfg=CFG,
+                            temperature=0.7)
+    assert a == b and len(a) == 24
+
+
+async def test_spec_with_quantized_engine():
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2, default_max_tokens=8,
+        draft_model=CFG, spec_gamma=2, spec_iters_per_sync=2,
+        quantize="int8"), draft_params=draft_params)
+    req = {"token_ids": list(PROMPT), "model": "m",
+           "sampling": {"temperature": 0.0}, "stop": {"max_tokens": 8}}
+    toks = []
+    async for o in eng.generate(req, Context()):
+        toks += o.get("token_ids", [])
+    assert len(toks) == 8
+    await eng.close()
+
+
+def test_spec_geometry_mismatch_rejected():
+    import pytest
+
+    bad = LlamaConfig.tiny(page_size=8)
+    with pytest.raises(ValueError):
+        TpuEngine(TpuEngineConfig(model=CFG, draft_model=bad))
+
+
+async def test_near_max_context_spec_does_not_overflow_page_table():
+    # spec lookahead (spec_iters*(gamma+1)=24) > decode_steps_per_sync:
+    # the admission guard must budget the spec shape, and an admitted
+    # request at the boundary must decode without overflowing
+    # max_pages_per_seq (r2 review finding)
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=1, default_max_tokens=8,
+        decode_steps_per_sync=4, draft_model=CFG, spec_gamma=3,
+        spec_iters_per_sync=6))
+    ctx_len = CFG.page_size * CFG.max_pages_per_seq  # 64
+    lookahead = 6 * 4
+    prompt_len = ctx_len - lookahead - 8              # max admissible
+    req = {"token_ids": [(i % 250) + 1 for i in range(prompt_len)],
+           "model": "m", "sampling": {"temperature": 0.0},
+           "stop": {"max_tokens": 8}}
+    outs = [o async for o in eng.generate(dict(req), Context())]
+    assert outs[-1].get("finish_reason") == "length", outs[-1]
+    # one token longer must be refused, not crash mid-decode
+    req["token_ids"].append(1)
+    outs = [o async for o in eng.generate(dict(req), Context())]
+    assert outs[-1].get("finish_reason") == "error"
+    await eng.close()
+
+
+async def test_draft_catchup_after_fallback_burst():
+    # lane A (greedy) decodes alongside lane B (nucleus) ⇒ the batch is
+    # spec-incompatible and A's tokens come from FALLBACK bursts with no
+    # draft KV. When B finishes, A's next spec burst must replay those
+    # tokens through the draft (engine._draft_catchup) — output must
+    # still equal the target-only greedy sequence (r2 review finding)
+    base, _ = await run_engine(n_tokens=40)
+
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2, default_max_tokens=40,
+        decode_steps_per_sync=4, draft_model=CFG, spec_gamma=3,
+        spec_iters_per_sync=2),
+        draft_params=init_params(jax.random.PRNGKey(0), CFG))
+
+    async def greedy():
+        req = {"token_ids": list(PROMPT), "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 40}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    async def nucleus():
+        req = {"token_ids": [9, 8, 7], "model": "m",
+               "sampling": {"temperature": 0.9, "top_p": 0.5},
+               "stop": {"max_tokens": 6}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    toks_a, toks_b = await asyncio.gather(greedy(), nucleus())
+    assert len(toks_b) == 6
+    assert toks_a == base
+    # the spec path DID engage after the nucleus lane drained
+    assert eng._spec_stats.num_draft_tokens > 0
+    await eng.close()

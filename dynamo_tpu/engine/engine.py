@@ -23,9 +23,11 @@ import logging
 import time
 import uuid
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, AsyncIterator, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine.pages import PagePool
@@ -54,6 +56,24 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
+
+
+@partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0, 1))
+def _sp_writeback(k_cache: tuple, v_cache: tuple, k_all, v_all,
+                  page_ids, page_size: int) -> tuple[tuple, tuple]:
+    """Scatter sequence-parallel prefill KV ((L, T, KVH, D), T page-
+    aligned) into the paged caches at `page_ids` ((T/page_size,))."""
+
+    def blocks(a):
+        t, kvh, d = a.shape
+        b = a.reshape(t // page_size, page_size, kvh, d)
+        return jnp.transpose(b, (2, 0, 1, 3))           # (KVH, nP, P, D)
+
+    new_k = tuple(kc.at[:, page_ids].set(blocks(k_all[l]))
+                  for l, kc in enumerate(k_cache))
+    new_v = tuple(vc.at[:, page_ids].set(blocks(v_all[l]))
+                  for l, vc in enumerate(v_cache))
+    return new_k, new_v
 
 
 def _next_pow2(n: int, lo: int, hi: int) -> int:
@@ -97,6 +117,18 @@ class TpuEngineConfig:
     draft_model: Optional[LlamaConfig] = None
     spec_gamma: int = 4
     spec_iters_per_sync: int = 8
+    # Sequence-parallel long-prompt prefill (models/llama_sp.py): NOVEL
+    # prompts (no cached prefix) whose uncached span exceeds sp_threshold
+    # run ring-attention prefill over sp_mesh's "sp" axis; the
+    # sequence-sharded KV is paged back into the cache and the tail (plus
+    # last-token logits) finishes through the normal chunk loop. Requires
+    # mesh=None (params are replicated onto sp_mesh; composing sp×tp on a
+    # 2-D mesh is the multi-host evolution point). sp_threshold=0 disables.
+    sp_mesh: Optional[Any] = None
+    sp_threshold: int = 0
+    # "contiguous" or "zigzag" (balanced causal ring; ~2× less attend
+    # work — engine/ring_attention.py)
+    sp_layout: str = "contiguous"
 
 
 @dataclass
@@ -177,6 +209,9 @@ class TpuEngine:
                     or dm.max_pages_per_seq != mcfg.max_pages_per_seq):
                 raise ValueError(
                     "draft model must share the target's page geometry")
+            if cfg.spec_gamma < 1 or cfg.spec_iters_per_sync < 1:
+                raise ValueError(
+                    "spec_gamma and spec_iters_per_sync must be >= 1")
             self._spec_stats = SpecDecodeStats()
             if cfg.mesh is None:
                 self.draft_params = draft_params if draft_params is not None \
@@ -209,6 +244,21 @@ class TpuEngine:
             self.params = quantize_params_jit(self.params)
             if self.draft_params is not None:
                 self.draft_params = quantize_params_jit(self.draft_params)
+        self._sp_params = None
+        if cfg.sp_mesh is not None and cfg.sp_threshold > 0:
+            if cfg.mesh is not None:
+                raise ValueError(
+                    "sp_mesh requires mesh=None (sp×tp composition is not "
+                    "wired into the engine yet)")
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sp_params = jax.device_put(
+                self.params, NamedSharding(cfg.sp_mesh, PartitionSpec()))
+            # weights must exist ONCE per chip: the single-device step
+            # functions reuse the ring's device-0 shard (a view of the
+            # same buffer) instead of keeping a second full copy resident
+            self.params = jax.tree.map(
+                lambda a: a.addressable_shards[0].data, self._sp_params)
         self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
                              cfg.worker_id, cfg.dp_rank, event_sink)
         self.kvbm = None   # set by kvbm.KvbmManager when attached
@@ -487,6 +537,8 @@ class TpuEngine:
                     self.write_kv_pages(seq.pages[:n_pages], data)
                     seq.import_kv = None
             offsets = {id(s): s.cached_len for s in pending}
+            if self._sp_params is not None:
+                self._sp_bulk_prefill(pending, offsets)
             self.k_cache, self.v_cache, last_logits = run_chunks(
                 self.params, mcfg, self.k_cache, self.v_cache, offsets)
             if self.draft_params is not None:
@@ -687,6 +739,51 @@ class TpuEngine:
                 self._emit_token(s, int(sampled[k, i]),
                                  float(logprobs[k, i]))
         return True
+
+    def _sp_bulk_prefill(self, pending: list[_Seq],
+                         offsets: dict[int, int]) -> None:
+        """Ring-attention bulk prefill for long NOVEL prompts: the first
+        page-and-ring-aligned t_sp < len(prompt) tokens run sequence-
+        parallel (models/llama_sp.py), the KV pages are scattered into
+        the cache device-side, and `offsets` advances so the normal chunk
+        loop finishes the tail and produces the last-token logits.
+
+        Prompts with a cached prefix are skipped: the ring only covers
+        its own span, so queries inside it could not attend cached KV."""
+        from dynamo_tpu.models.llama_sp import sp_prefill
+
+        cfg, mcfg = self.config, self.model_cfg
+        sp = cfg.sp_mesh.shape["sp"]
+        unit = sp * mcfg.page_size
+        if cfg.sp_layout == "zigzag":
+            unit *= 2
+        for s in pending:
+            if offsets[id(s)] != 0:
+                continue
+            if len(s.prompt) - offsets[id(s)] < cfg.sp_threshold:
+                continue
+            m = (len(s.prompt) - 1) // unit
+            if m <= 0:
+                continue
+            # pow2 multiples of the ring unit: compile count stays
+            # logarithmic in prompt length (the bulk covers >= half the
+            # prompt; the chunk loop absorbs the rest)
+            t_sp = unit * (1 << (m.bit_length() - 1))
+            toks = jnp.asarray(
+                np.asarray(s.prompt[:t_sp], dtype=np.int32))[None]
+            _, k_all, v_all = sp_prefill(self._sp_params, toks, mcfg,
+                                         cfg.sp_mesh,
+                                         layout=cfg.sp_layout)
+            # gather the sequence-sharded KV onto the cache's device and
+            # scatter it into this sequence's pages
+            dev = list(self.k_cache[0].devices())[0]
+            k_all, v_all = jax.device_put((k_all[:, 0], v_all[:, 0]), dev)
+            ids = jnp.asarray(np.asarray(
+                s.pages[:t_sp // mcfg.page_size], dtype=np.int32))
+            self.k_cache, self.v_cache = _sp_writeback(
+                self.k_cache, self.v_cache, k_all, v_all, ids,
+                mcfg.page_size)
+            offsets[id(s)] = t_sp
 
     def _chunk_rounds(self, params_, model_cfg, kc, vc, seqs, offsets,
                       tokens_of, target_len_of):

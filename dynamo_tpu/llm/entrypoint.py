@@ -169,7 +169,8 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      random_init: bool = False, kvbm_host_blocks: int = 0,
                      quantize: Optional[str] = None,
                      draft_model: Optional[str] = None, spec_gamma: int = 4,
-                     spec_iters_per_sync: int = 8,
+                     spec_iters_per_sync: int = 8, sp_degree: int = 0,
+                     sp_threshold: int = 2048, sp_layout: str = "zigzag",
                      **model_overrides):
     """(TpuEngine, ModelDeploymentCard) for a real checkpoint.
 
@@ -180,7 +181,9 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
     tune geometry, e.g. ``max_pages_per_seq`` to bound context.
     `quantize="int8"` serves weight-only-quantized (engine/quant.py);
     `draft_model` names a second (small) checkpoint for speculative
-    decoding — its page geometry is forced to the target's.
+    decoding — its page geometry is forced to the target's. `sp_degree>1`
+    builds an "sp" ring over the first N local devices for sequence-
+    parallel long-prompt prefill (models/llama_sp.py).
     """
     import os
 
@@ -194,6 +197,11 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
     path = resolve_model(model)
     cfg = config_from_hf(path, **model_overrides)
     params = None if random_init else load_llama_params(path, cfg)
+    sp_mesh = None
+    if sp_degree > 1:
+        from dynamo_tpu.engine.ring_attention import sp_mesh as make_sp
+
+        sp_mesh = make_sp(sp_degree)
     draft_cfg = draft_params = None
     if draft_model is not None:
         dpath = resolve_model(draft_model)
@@ -209,7 +217,10 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                         mesh=mesh, worker_id=worker_id, dp_rank=dp_rank,
                         quantize=quantize, draft_model=draft_cfg,
                         spec_gamma=spec_gamma,
-                        spec_iters_per_sync=spec_iters_per_sync),
+                        spec_iters_per_sync=spec_iters_per_sync,
+                        sp_mesh=sp_mesh,
+                        sp_threshold=sp_threshold if sp_mesh else 0,
+                        sp_layout=sp_layout),
         params=params, draft_params=draft_params)
     if kvbm_host_blocks:
         from dynamo_tpu.kvbm import KvbmConfig, KvbmManager

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     _swiglu,
@@ -88,7 +89,7 @@ def _pp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         y = jnp.where(active, y, jnp.zeros_like(y))
         # last stage: project the microbatch's final token to logits
         xf = rms_norm(y[:, -1], params["final_norm"], cfg.rms_eps)
-        logits = (xf @ params["lm_head"]).astype(jnp.float32)  # (Bm, V)
+        logits = qm(xf, params["lm_head"]).astype(jnp.float32)  # (Bm, V)
         write = active & (stage == n_stages - 1)
         out = lax.dynamic_update_index_in_dim(
             out,

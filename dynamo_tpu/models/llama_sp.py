@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.engine.ring_attention import ring_attention_local
 from dynamo_tpu.models.llama import (
     LlamaConfig,
@@ -38,41 +39,50 @@ from dynamo_tpu.models.llama import (
 
 
 def _sp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-                      axis: str):
+                      axis: str, layout: str = "contiguous"):
     """Per-shard body (inside shard_map): tokens (B, Tc) local chunk.
 
     Returns (logits (1, B, V) — this shard's LAST-token logits, k_all,
     v_all (L, B, Tc, KVH, D) — this chunk's KV for cache writeback)."""
+    from dynamo_tpu.engine.ring_attention import zigzag_positions
+
     idx = lax.axis_index(axis)
+    sp_size = lax.psum(1, axis)
     B, Tc = tokens.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    positions = (idx * Tc + jnp.arange(Tc))[None, :]       # global positions
+    if layout == "zigzag":
+        positions = zigzag_positions(idx, Tc, sp_size)[None, :]
+    else:
+        positions = (idx * Tc + jnp.arange(Tc))[None, :]   # global positions
     x = params["embed"][tokens]                            # (B, Tc, E)
     ks, vs = [], []
     for l in range(cfg.num_layers):
         lp = _layer_params(params, l)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = rope((h @ lp["wq"]).reshape(B, Tc, H, D), positions,
+        q = rope(qm(h, lp["wq"]).reshape(B, Tc, H, D), positions,
                  cfg.rope_theta)
-        k = rope((h @ lp["wk"]).reshape(B, Tc, KVH, D), positions,
+        k = rope(qm(h, lp["wk"]).reshape(B, Tc, KVH, D), positions,
                  cfg.rope_theta)
-        v = (h @ lp["wv"]).reshape(B, Tc, KVH, D)
+        v = qm(h, lp["wv"]).reshape(B, Tc, KVH, D)
         ks.append(k)
         vs.append(v)
-        attn = ring_attention_local(q, k, v, axis, causal=True)
-        x = x + attn.reshape(B, Tc, H * D) @ lp["wo"]
+        attn = ring_attention_local(q, k, v, axis, causal=True,
+                                    layout=layout)
+        x = x + qm(attn.reshape(B, Tc, H * D), lp["wo"])
         x = x + _swiglu(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp)
     xf = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
-    logits = (xf @ params["lm_head"]).astype(jnp.float32)  # (B, V)
+    logits = qm(xf, params["lm_head"]).astype(jnp.float32)  # (B, V)
     return logits[None], jnp.stack(ks), jnp.stack(vs)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "mesh", "axis"))
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "mesh", "axis", "layout"))
 def _sp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
-                    axis: str):
+                    axis: str, layout: str = "contiguous"):
     param_spec = jax.tree.map(lambda _: P(), params)
     fn = jax.shard_map(
-        functools.partial(_sp_forward_local, cfg=cfg, axis=axis),
+        functools.partial(_sp_forward_local, cfg=cfg, axis=axis,
+                          layout=layout),
         mesh=mesh,
         in_specs=(param_spec, P(None, axis)),
         out_specs=(P(axis, None, None),
@@ -82,20 +92,31 @@ def _sp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
 
 
 def sp_prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-               mesh: Mesh, axis: str = "sp"):
+               mesh: Mesh, axis: str = "sp", layout: str = "contiguous"):
     """Sequence-parallel prefill of a long prompt.
 
-    tokens: (B, T) with T divisible by the "sp" axis size. Returns
-    (last_logits (B, V) float32, k_all, v_all (L, B, T, KVH, D) — KV
-    sequence-sharded over the mesh).
+    tokens: (B, T) with T divisible by the "sp" axis size (2× that for
+    layout="zigzag", which balances causal work across the ring — see
+    engine/ring_attention.py). Returns (last_logits (B, V) float32,
+    k_all, v_all (L, B, T, KVH, D) — KV sequence-sharded over the mesh,
+    in NATURAL token order for either layout).
 
     Params are replicated over "sp" (P() spec): each chip streams the
     weights once per its chunk — the standard megatron-style memory/compute
     trade; combine with "tp" on a 2-D mesh to shard weights too."""
+    from dynamo_tpu.engine.ring_attention import zigzag_permutation
+
     sp = mesh.shape[axis]
-    assert tokens.shape[1] % sp == 0, (
-        f"prompt length {tokens.shape[1]} not divisible by sp={sp}")
+    unit = 2 * sp if layout == "zigzag" else sp
+    assert tokens.shape[1] % unit == 0, (
+        f"prompt length {tokens.shape[1]} not divisible by {unit}")
+    if layout == "zigzag":
+        perm, inv = zigzag_permutation(tokens.shape[1], sp)
+        tokens = tokens[:, perm]
     tokens = jax.device_put(tokens, NamedSharding(mesh, P(None, axis)))
     logits_all, k_all, v_all = _sp_prefill_jit(params, tokens, cfg, mesh,
-                                               axis)
+                                               axis, layout)
+    if layout == "zigzag":
+        # global last token lives in stripe 2sp-1 → device 0's last row
+        return logits_all[0], k_all[:, :, inv], v_all[:, :, inv]
     return logits_all[-1], k_all, v_all

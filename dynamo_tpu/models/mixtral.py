@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     dense_attention,
@@ -165,7 +166,7 @@ def moe_forward(params: dict, tokens: jax.Array, cfg: MoeConfig
         x = x + moe_mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp,
                         cfg).astype(x.dtype)
     xf = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
-    return (xf @ params["lm_head"]).astype(jnp.float32)
+    return qm(xf, params["lm_head"]).astype(jnp.float32)
 
 
 def ep_param_specs() -> dict:

@@ -80,6 +80,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--spec-iters-per-sync", type=int, default=8,
                    help="fused spec iterations per host sync (scales "
                         "burst length and the admission lookahead)")
+    p.add_argument("--sp-degree", type=int, default=0,
+                   help="ring size for sequence-parallel long-prompt "
+                        "prefill (0 = off; uses the first N local "
+                        "devices)")
+    p.add_argument("--sp-threshold", type=int, default=2048,
+                   help="min uncached prompt tokens to engage sp prefill")
+    p.add_argument("--sp-layout", default="zigzag",
+                   choices=["contiguous", "zigzag"])
     p.add_argument("--random-init", action="store_true",
                    help="skip weight load (synthetic benchmarking)")
     mn = p.add_argument_group(
@@ -161,7 +169,9 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         kvbm_host_blocks=args.kvbm_host_blocks,
         quantize=args.quantize, draft_model=args.draft_model,
         spec_gamma=args.spec_gamma,
-        spec_iters_per_sync=args.spec_iters_per_sync, **overrides)
+        spec_iters_per_sync=args.spec_iters_per_sync,
+        sp_degree=args.sp_degree, sp_threshold=args.sp_threshold,
+        sp_layout=args.sp_layout, **overrides)
     if mesh is not None:
         card.runtime_config.tensor_parallel_size = args.tensor_parallel_size
     engine.config.prefill_chunk = args.prefill_chunk

@@ -773,11 +773,21 @@ class TpuEngine:
                 np.asarray(s.prompt[:t_sp], dtype=np.int32))[None]
             _, k_all, v_all = sp_prefill(self._sp_params, toks, mcfg,
                                          cfg.sp_mesh,
-                                         layout=cfg.sp_layout)
+                                         layout=cfg.sp_layout,
+                                         kv_order="ring")
             # gather the sequence-sharded KV onto the cache's device and
-            # scatter it into this sequence's pages
+            # scatter it into this sequence's pages. kv_order="ring":
+            # un-permuting BEFORE the gather would all-gather full-T KV
+            # onto every ring chip; instead permute locally post-gather
             dev = list(self.k_cache[0].devices())[0]
             k_all, v_all = jax.device_put((k_all[:, 0], v_all[:, 0]), dev)
+            if cfg.sp_layout == "zigzag":
+                from dynamo_tpu.engine.ring_attention import (
+                    zigzag_permutation,
+                )
+
+                _, inv = zigzag_permutation(t_sp, sp)
+                k_all, v_all = k_all[:, inv], v_all[:, inv]
             ids = jnp.asarray(np.asarray(
                 s.pages[:t_sp // mcfg.page_size], dtype=np.int32))
             self.k_cache, self.v_cache = _sp_writeback(

@@ -68,6 +68,19 @@ def _block_attend(q5, k, v, q_pos, kv_pos, causal: bool):
     return o_part, m_part, l_part
 
 
+def _merge(m, l, acc, o_p, m_p, l_p):
+    """Online-softmax merge of one partial block into the running
+    (max, denom, accumulator) — THE numerics-critical recurrence, shared
+    by both ring layouts so they can never diverge. Stats are
+    (B, KVH, G, Tq); acc/o_p are (B, Tq, KVH, G, D)."""
+    m_new = jnp.maximum(m, m_p)
+    scale_old = jnp.exp(m - m_new)
+    scale_new = jnp.exp(m_p - m_new)
+    acc = (acc * scale_old.transpose(0, 3, 1, 2)[..., None]
+           + o_p * scale_new.transpose(0, 3, 1, 2)[..., None])
+    return m_new, l * scale_old + l_p * scale_new, acc
+
+
 def zigzag_permutation(t: int, sp: int):
     """(perm, inv) host-side index arrays: ``x[perm]`` reorders a length-t
     sequence into zigzag device order (device i gets stripes i and
@@ -111,14 +124,6 @@ def _ring_zigzag_local(q, k, v, axis_name: str):
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def merge(m, l, acc, o_p, m_p, l_p):
-        m_new = jnp.maximum(m, m_p)
-        scale_old = jnp.exp(m - m_new)
-        scale_new = jnp.exp(m_p - m_new)
-        acc = (acc * scale_old.transpose(0, 3, 1, 2)[..., None]
-               + o_p * scale_new.transpose(0, 3, 1, 2)[..., None])
-        return m_new, l * scale_old + l_p * scale_new, acc
-
     def body(s, carry):
         k_cur, v_cur, m, l, acc = carry
         src = (idx - s) % sp
@@ -151,7 +156,7 @@ def _ring_zigzag_local(q, k, v, axis_name: str):
             s == 0, diagonal,
             lambda _: lax.cond(src < idx, low_half, high_half, None),
             None)
-        m, l, acc = merge(m, l, acc, o_p, m_p, l_p)
+        m, l, acc = _merge(m, l, acc, o_p, m_p, l_p)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m, l, acc
@@ -195,18 +200,12 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
         kv_pos = src * tk + jnp.arange(tk)
         o_p, m_p, l_p = _block_attend(q5, k_cur.astype(jnp.float32),
                                       v_cur, q_pos, kv_pos, causal)
-        m_new = jnp.maximum(m, m_p)
-        scale_old = jnp.exp(m - m_new)
-        scale_new = jnp.exp(m_p - m_new)
-        # stats are (B, KVH, G, Tq); acc is (B, Tq, KVH, G, D)
-        acc = (acc * scale_old.transpose(0, 3, 1, 2)[..., None]
-               + o_p * scale_new.transpose(0, 3, 1, 2)[..., None])
-        l = l * scale_old + l_p * scale_new
+        m, l, acc = _merge(m, l, acc, o_p, m_p, l_p)
         # rotate K/V one hop around the ring (ICI neighbor exchange);
         # XLA overlaps the permute with the next block's compute
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m_new, l, acc
+        return k_nxt, v_nxt, m, l, acc
 
     m0 = jnp.full((b, kvh, groups, tq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, kvh, groups, tq), jnp.float32)

@@ -92,14 +92,21 @@ def _sp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
 
 
 def sp_prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-               mesh: Mesh, axis: str = "sp", layout: str = "contiguous"):
+               mesh: Mesh, axis: str = "sp", layout: str = "contiguous",
+               kv_order: str = "natural"):
     """Sequence-parallel prefill of a long prompt.
 
     tokens: (B, T) with T divisible by the "sp" axis size (2× that for
     layout="zigzag", which balances causal work across the ring — see
     engine/ring_attention.py). Returns (last_logits (B, V) float32,
-    k_all, v_all (L, B, T, KVH, D) — KV sequence-sharded over the mesh,
-    in NATURAL token order for either layout).
+    k_all, v_all (L, B, T, KVH, D) — KV sequence-sharded over the mesh).
+
+    kv_order (zigzag only): "natural" un-permutes the KV to token order —
+    convenient, but the permutation makes XLA ALL-GATHER the full-T KV
+    onto every chip, defeating sp's memory point on a real ring. Callers
+    that gather to one device anyway (the engine's cache writeback)
+    should pass "ring" and apply `zigzag_permutation`'s inverse locally
+    after their own gather.
 
     Params are replicated over "sp" (P() spec): each chip streams the
     weights once per its chunk — the standard megatron-style memory/compute
@@ -118,5 +125,7 @@ def sp_prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
                                                axis, layout)
     if layout == "zigzag":
         # global last token lives in stripe 2sp-1 → device 0's last row
-        return logits_all[0], k_all[:, :, inv], v_all[:, :, inv]
+        if kv_order == "natural":
+            return logits_all[0], k_all[:, :, inv], v_all[:, :, inv]
+        return logits_all[0], k_all, v_all
     return logits_all[-1], k_all, v_all

@@ -123,6 +123,60 @@ def moe_mlp(h: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     return out
 
 
+def moe_mlp_capacity(h: jax.Array, lp: dict, cfg: MoeConfig,
+                     capacity_factor: float = 1.25) -> jax.Array:
+    """Capacity-based (GShard-style) expert dispatch. h: (B, T, E).
+
+    Each expert processes at most C = ceil(T·k/X · capacity_factor)
+    tokens; earlier tokens win slots, overflow tokens are DROPPED (their
+    residual connection passes the hidden state through unchanged —
+    standard Switch/GShard semantics). FLOPs are the ROUTED cost
+    (≈ k·T·capacity_factor tokens of FFN) instead of dense-dispatch's
+    X·T, which is what makes large expert counts viable.
+
+    All-to-all ready: the dispatch einsum 'btxc,bte->bxce' maps token-
+    dimension data onto the expert dimension — under a mesh where the
+    expert weight axis is sharded over "ep" (and tokens over "dp"/"sp"),
+    GSPMD lowers exactly that contraction to the expert all-to-all the
+    reference's wideep recipes get from DeepEP, then partitions the FFN
+    per chip and psums the combine."""
+    B, T, E = h.shape
+    X, k = cfg.num_experts, cfg.experts_per_token
+    C = max(k, int(math.ceil(T * k / X * capacity_factor)))
+    router_logits = (h @ lp["router"]).astype(jnp.float32)  # (B, T, X)
+    topv, topi = jax.lax.top_k(router_logits, k)            # (B, T, k)
+    gates = jax.nn.softmax(topv, axis=-1)                   # (B, T, k)
+
+    # slot assignment: flatten choices token-major ((t, j) → s = t*k+j) so
+    # earlier tokens claim expert slots first; exclusive cumsum per expert
+    # gives each choice its position within the expert's capacity. Only
+    # (B, S, X) and (B, T, k, ·) intermediates are materialized — the
+    # (·, X, C) cross product appears once, contracted straight into the
+    # (B, T, X, C) dispatch/combine the einsums need.
+    sel = jax.nn.one_hot(topi, X, dtype=jnp.float32)        # (B, T, k, X)
+    sel_flat = sel.reshape(B, T * k, X)
+    pos = jnp.cumsum(sel_flat, axis=1) - sel_flat           # (B, S, X)
+    # position of each (t, j) choice within ITS chosen expert
+    pos_tk = jnp.sum(pos * sel_flat, axis=-1).reshape(B, T, k)
+    keep = (pos_tk < C).astype(jnp.float32)                 # (B, T, k)
+    slot = jax.nn.one_hot(pos_tk.astype(jnp.int32), C,
+                          dtype=jnp.float32)                # (B, T, k, C)
+    # collapse the k slots onto tokens (top-k indices are distinct, so a
+    # token never occupies two slots of the same expert)
+    dispatch_t = jnp.einsum("btkx,btkc->btxc",
+                            sel * keep[..., None], slot)
+    combine_t = jnp.einsum("btkx,btkc->btxc",
+                           sel * (keep * gates)[..., None], slot)
+
+    hf = h.astype(jnp.float32)
+    xin = jnp.einsum("btxc,bte->bxce", dispatch_t, hf).astype(h.dtype)
+    gate = jax.nn.silu(jnp.einsum("bxce,xef->bxcf", xin, lp["w_gate"]))
+    up = jnp.einsum("bxce,xef->bxcf", xin, lp["w_up"])
+    down = jnp.einsum("bxcf,xfe->bxce", gate * up, lp["w_down"])
+    return jnp.einsum("btxc,bxce->bte", combine_t,
+                      down.astype(jnp.float32)).astype(h.dtype)
+
+
 def moe_mlp_reference(h: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     """Per-token loop reference (slow, obviously-correct) for tests."""
     import numpy as np
@@ -150,21 +204,26 @@ def _layer_params(params: dict, l: int) -> dict:
     return jax.tree.map(lambda w: w[l], params["layers"])
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def moe_forward(params: dict, tokens: jax.Array, cfg: MoeConfig
-                ) -> jax.Array:
+@partial(jax.jit, static_argnames=("cfg", "dispatch"))
+def moe_forward(params: dict, tokens: jax.Array, cfg: MoeConfig,
+                dispatch: str = "dense") -> jax.Array:
     """Full-sequence forward (no KV cache): last-token logits (B, V).
     The serving engine reuses llama's paged machinery; this entry is the
-    EP-shardable forward used for parity tests and the multichip dryrun."""
+    EP-shardable forward used for parity tests and the multichip dryrun.
+    dispatch: "dense" (mask-weighted, all experts compute all tokens) or
+    "capacity" (GShard-style all-to-all dispatch, routed FLOPs only)."""
+    if dispatch not in ("dense", "capacity"):
+        raise ValueError(f"unknown dispatch mode {dispatch!r}")
     B, T = tokens.shape
     positions = jnp.arange(T)[None, :]
     x = params["embed"][tokens]
     mask = jnp.tril(jnp.ones((T, T), bool))
+    mlp = moe_mlp if dispatch == "dense" else moe_mlp_capacity
     for l in range(cfg.num_layers):
         lp = _layer_params(params, l)
         x = dense_attention(x, lp, positions, mask, cfg)
-        x = x + moe_mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp,
-                        cfg).astype(x.dtype)
+        x = x + mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp,
+                    cfg).astype(x.dtype)
     xf = rms_norm(x[:, -1], params["final_norm"], cfg.rms_eps)
     return qm(xf, params["lm_head"]).astype(jnp.float32)
 

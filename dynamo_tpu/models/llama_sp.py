@@ -113,6 +113,8 @@ def sp_prefill(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     trade; combine with "tp" on a 2-D mesh to shard weights too."""
     from dynamo_tpu.engine.ring_attention import zigzag_permutation
 
+    if kv_order not in ("natural", "ring"):
+        raise ValueError(f"unknown kv_order {kv_order!r}")
     sp = mesh.shape[axis]
     unit = 2 * sp if layout == "zigzag" else sp
     assert tokens.shape[1] % unit == 0, (

@@ -204,21 +204,26 @@ def _layer_params(params: dict, l: int) -> dict:
     return jax.tree.map(lambda w: w[l], params["layers"])
 
 
-@partial(jax.jit, static_argnames=("cfg", "dispatch"))
+@partial(jax.jit, static_argnames=("cfg", "dispatch", "capacity_factor"))
 def moe_forward(params: dict, tokens: jax.Array, cfg: MoeConfig,
-                dispatch: str = "dense") -> jax.Array:
+                dispatch: str = "dense",
+                capacity_factor: float = 1.25) -> jax.Array:
     """Full-sequence forward (no KV cache): last-token logits (B, V).
     The serving engine reuses llama's paged machinery; this entry is the
     EP-shardable forward used for parity tests and the multichip dryrun.
     dispatch: "dense" (mask-weighted, all experts compute all tokens) or
-    "capacity" (GShard-style all-to-all dispatch, routed FLOPs only)."""
+    "capacity" (GShard-style all-to-all dispatch, routed FLOPs only;
+    capacity_factor tunes drop rate vs FLOPs)."""
     if dispatch not in ("dense", "capacity"):
         raise ValueError(f"unknown dispatch mode {dispatch!r}")
     B, T = tokens.shape
     positions = jnp.arange(T)[None, :]
     x = params["embed"][tokens]
     mask = jnp.tril(jnp.ones((T, T), bool))
-    mlp = moe_mlp if dispatch == "dense" else moe_mlp_capacity
+    if dispatch == "dense":
+        mlp = moe_mlp
+    else:
+        mlp = partial(moe_mlp_capacity, capacity_factor=capacity_factor)
     for l in range(cfg.num_layers):
         lp = _layer_params(params, l)
         x = dense_attention(x, lp, positions, mask, cfg)

@@ -1,0 +1,36 @@
+"""Kubernetes operator for TPU serving graphs.
+
+The reference ships a 20k-LoC Go operator (`deploy/cloud/operator/`)
+reconciling `DynamoGraphDeployment` / `DynamoComponentDeployment` CRDs
+into Deployments/Services/PVCs. This is the TPU-native analog, in Python
+like the rest of the control plane:
+
+- `types.py` — the CRD model (graph of components: frontend, workers,
+  planner, coordinator) and the CustomResourceDefinition manifests.
+- `kube.py` — a minimal typed K8s API client (stdlib HTTP against the
+  apiserver; in-cluster serviceaccount or kubeconfig token) plus an
+  in-memory `FakeKube` so the whole reconcile loop is testable hermetic.
+- `reconciler.py` — renders desired child resources (ownerReferences,
+  TPU node selectors, probes), diffs against observed state, and runs
+  the watch+resync controller loop; also bridges the SLA planner's
+  store-published replica targets into CR patches (the reference's
+  KubernetesConnector analog).
+"""
+
+from dynamo_tpu.operator.kube import FakeKube, HttpKube, KubeClient
+from dynamo_tpu.operator.reconciler import (
+    GraphReconciler,
+    PlannerSync,
+    render_children,
+)
+from dynamo_tpu.operator.types import (
+    ComponentSpec,
+    DynamoGraphDeployment,
+    crd_manifests,
+)
+
+__all__ = [
+    "ComponentSpec", "DynamoGraphDeployment", "crd_manifests",
+    "KubeClient", "FakeKube", "HttpKube",
+    "GraphReconciler", "PlannerSync", "render_children",
+]

@@ -1,0 +1,86 @@
+"""Operator CLI: `python -m dynamo_tpu.operator`.
+
+Reference: `deploy/cloud/operator/cmd/main.go` (manager setup + flags).
+Runs the poll/reconcile controller against a cluster (in-cluster
+serviceaccount, or --api-url e.g. `kubectl proxy`). `--print-crds` emits
+the CRD manifests for `kubectl apply -f -`; `--once` runs one reconcile
+pass and exits (CI / smoke checks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.operator")
+    p.add_argument("--namespace", default="default",
+                   help="k8s namespace to watch")
+    p.add_argument("--api-url", default=None,
+                   help="apiserver URL (default: in-cluster)")
+    p.add_argument("--token", default=None)
+    p.add_argument("--ca-file", default=None)
+    p.add_argument("--resync", type=float, default=10.0)
+    p.add_argument("--once", action="store_true",
+                   help="one reconcile pass, print states, exit")
+    p.add_argument("--print-crds", action="store_true")
+    p.add_argument("--store", default=None,
+                   help="runtime store URL for the planner bridge")
+    p.add_argument("--planner-namespace", default="dynamo")
+    p.add_argument("--planner-dgd", default=None,
+                   help="DynamoGraphDeployment name the planner scales")
+    return p.parse_args(argv)
+
+
+async def amain(args) -> int:
+    from dynamo_tpu.operator.kube import HttpKube
+    from dynamo_tpu.operator.reconciler import ControllerLoop, PlannerSync
+
+    client = HttpKube(api_url=args.api_url, token=args.token,
+                      ca_file=args.ca_file)
+    planner_sync = None
+    rt = None
+    if args.store and args.planner_dgd:
+        from dynamo_tpu.runtime.config import RuntimeConfig
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        rt = await DistributedRuntime.create(
+            RuntimeConfig(store_url=args.store))
+        planner_sync = PlannerSync(client, rt.store,
+                                   args.planner_namespace,
+                                   args.planner_dgd,
+                                   dgd_namespace=args.namespace)
+    loop = ControllerLoop(client, namespace=args.namespace,
+                          resync=args.resync, planner_sync=planner_sync)
+    try:
+        if args.once:
+            states = await loop.step()
+            print(json.dumps(states))
+            return 0
+        print("OPERATOR_READY", flush=True)
+        await loop.run()
+        return 0
+    finally:
+        if rt is not None:
+            await rt.close()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args(argv)
+    if args.print_crds:
+        from dynamo_tpu.operator.types import crd_manifests
+
+        for m in crd_manifests():
+            print("---")
+            print(json.dumps(m, indent=2))
+        return 0
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Minimal Kubernetes API client + in-memory fake.
+
+The reference operator uses controller-runtime; the analogous seam here
+is a small typed client over the apiserver's REST paths. Resources are
+plain dicts in their JSON wire shape — no client library, no codegen.
+`FakeKube` implements the same surface in memory (with resourceVersion
+bumps and label selection) so the reconciler and controller loop are
+fully testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Optional
+
+# (group, version, plural) per supported kind
+_KIND_PATHS = {
+    "Deployment": ("apps", "v1", "deployments"),
+    "Service": ("", "v1", "services"),
+    "ConfigMap": ("", "v1", "configmaps"),
+    "PersistentVolumeClaim": ("", "v1", "persistentvolumeclaims"),
+    "DynamoGraphDeployment": ("dynamo.tpu", "v1alpha1",
+                              "dynamographdeployments"),
+    "CustomResourceDefinition": ("apiextensions.k8s.io", "v1",
+                                 "customresourcedefinitions"),
+}
+_CLUSTER_SCOPED = {"CustomResourceDefinition"}
+
+
+class KubeError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class KubeClient:
+    """Interface; see FakeKube / HttpKube."""
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def list(self, kind: str, namespace: str,
+             label_selector: Optional[dict] = None) -> list[dict]:
+        raise NotImplementedError
+
+    def create(self, kind: str, namespace: str, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update(self, kind: str, namespace: str, name: str,
+               obj: dict) -> dict:
+        raise NotImplementedError
+
+    def patch_status(self, kind: str, namespace: str, name: str,
+                     status: dict) -> dict:
+        raise NotImplementedError
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+
+class FakeKube(KubeClient):
+    """In-memory apiserver: enough semantics (404/409, resourceVersion,
+    label selectors, readiness defaulting) to exercise the reconciler."""
+
+    def __init__(self) -> None:
+        # (kind, ns, name) -> obj
+        self._store: dict[tuple, dict] = {}
+        self._rv = itertools.count(1)
+        self.actions: list[tuple] = []     # (verb, kind, name) audit log
+
+    def _key(self, kind, ns, name):
+        ns = "" if kind in _CLUSTER_SCOPED else ns
+        return (kind, ns, name)
+
+    def get(self, kind, namespace, name):
+        obj = self._store.get(self._key(kind, namespace, name))
+        if obj is None:
+            raise KubeError(404, f"{kind} {namespace}/{name} not found")
+        return json.loads(json.dumps(obj))
+
+    def list(self, kind, namespace, label_selector=None):
+        out = []
+        for (k, ns, _), obj in self._store.items():
+            if k != kind or (kind not in _CLUSTER_SCOPED
+                             and ns != namespace):
+                continue
+            labels = obj.get("metadata", {}).get("labels", {})
+            if label_selector and any(labels.get(lk) != lv
+                                      for lk, lv in label_selector.items()):
+                continue
+            out.append(json.loads(json.dumps(obj)))
+        return out
+
+    def create(self, kind, namespace, obj):
+        name = obj["metadata"]["name"]
+        key = self._key(kind, namespace, name)
+        if key in self._store:
+            raise KubeError(409, f"{kind} {name} already exists")
+        obj = json.loads(json.dumps(obj))
+        obj["metadata"].setdefault("namespace", namespace)
+        obj["metadata"]["resourceVersion"] = str(next(self._rv))
+        obj["metadata"].setdefault("uid", f"uid-{kind}-{name}")
+        if kind == "Deployment":
+            # a fresh fake Deployment reports fully ready (tests flip
+            # this to exercise pending states)
+            reps = obj.get("spec", {}).get("replicas", 1)
+            obj.setdefault("status", {"readyReplicas": reps,
+                                      "replicas": reps})
+        self._store[key] = obj
+        self.actions.append(("create", kind, name))
+        return json.loads(json.dumps(obj))
+
+    def update(self, kind, namespace, name, obj):
+        key = self._key(kind, namespace, name)
+        if key not in self._store:
+            raise KubeError(404, f"{kind} {name} not found")
+        cur = self._store[key]
+        obj = json.loads(json.dumps(obj))
+        obj["metadata"]["resourceVersion"] = str(next(self._rv))
+        obj["metadata"].setdefault("uid", cur["metadata"].get("uid"))
+        obj.setdefault("status", cur.get("status", {}))
+        self._store[key] = obj
+        self.actions.append(("update", kind, name))
+        return json.loads(json.dumps(obj))
+
+    def patch_status(self, kind, namespace, name, status):
+        key = self._key(kind, namespace, name)
+        if key not in self._store:
+            raise KubeError(404, f"{kind} {name} not found")
+        self._store[key].setdefault("status", {}).update(
+            json.loads(json.dumps(status)))
+        self.actions.append(("patch_status", kind, name))
+        return json.loads(json.dumps(self._store[key]))
+
+    def delete(self, kind, namespace, name):
+        key = self._key(kind, namespace, name)
+        if key not in self._store:
+            raise KubeError(404, f"{kind} {name} not found")
+        del self._store[key]
+        self.actions.append(("delete", kind, name))
+
+    # test helper
+    def set_ready(self, name: str, namespace: str, ready: int) -> None:
+        obj = self._store[self._key("Deployment", namespace, name)]
+        obj.setdefault("status", {})["readyReplicas"] = ready
+
+
+class HttpKube(KubeClient):
+    """Stdlib-HTTP client against the apiserver.
+
+    Auth: in-cluster (serviceaccount token + CA at the conventional
+    paths) or explicit `api_url`/`token`/`ca_file` (e.g. `kubectl proxy`
+    with no token). Synchronous — the controller loop runs it in a
+    thread."""
+
+    SA = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, api_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None) -> None:
+        if api_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise KubeError(0, "no api_url and not in-cluster")
+            api_url = f"https://{host}:{port}"
+            if token is None and os.path.exists(f"{self.SA}/token"):
+                with open(f"{self.SA}/token") as f:
+                    token = f.read().strip()
+            if ca_file is None and os.path.exists(f"{self.SA}/ca.crt"):
+                ca_file = f"{self.SA}/ca.crt"
+        self.api_url = api_url.rstrip("/")
+        self.token = token
+        self._ctx = ssl.create_default_context(cafile=ca_file) \
+            if api_url.startswith("https") else None
+
+    def _path(self, kind: str, namespace: str, name: str = "") -> str:
+        group, version, plural = _KIND_PATHS[kind]
+        root = f"/api/{version}" if group == "" \
+            else f"/apis/{group}/{version}"
+        if kind in _CLUSTER_SCOPED:
+            p = f"{root}/{plural}"
+        else:
+            p = f"{root}/namespaces/{namespace}/{plural}"
+        return p + (f"/{name}" if name else "")
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None,
+             content_type: str = "application/json") -> dict:
+        req = urllib.request.Request(
+            self.api_url + path, method=method,
+            data=None if body is None else json.dumps(body).encode())
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=30) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise KubeError(e.code, e.read().decode()[:300]) from e
+
+    def get(self, kind, namespace, name):
+        return self._req("GET", self._path(kind, namespace, name))
+
+    def list(self, kind, namespace, label_selector=None):
+        path = self._path(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += f"?labelSelector={urllib.parse.quote(sel)}"
+        return self._req("GET", path).get("items", [])
+
+    def create(self, kind, namespace, obj):
+        return self._req("POST", self._path(kind, namespace), obj)
+
+    def update(self, kind, namespace, name, obj):
+        return self._req("PUT", self._path(kind, namespace, name), obj)
+
+    def patch_status(self, kind, namespace, name, status):
+        return self._req(
+            "PATCH", self._path(kind, namespace, name) + "/status",
+            {"status": status},
+            content_type="application/merge-patch+json")
+
+    def delete(self, kind, namespace, name):
+        self._req("DELETE", self._path(kind, namespace, name))
+
+
+def apply(client: KubeClient, kind: str, namespace: str,
+          obj: dict) -> dict:
+    """create-or-update by name."""
+    name = obj["metadata"]["name"]
+    try:
+        cur = client.get(kind, namespace, name)
+    except KubeError as e:
+        if e.status != 404:
+            raise
+        return client.create(kind, namespace, obj)
+    obj = json.loads(json.dumps(obj))
+    obj["metadata"]["resourceVersion"] = \
+        cur["metadata"].get("resourceVersion", "")
+    return client.update(kind, namespace, name, obj)

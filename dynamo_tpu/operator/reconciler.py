@@ -1,0 +1,341 @@
+"""Graph reconciler: CR → child Deployments/Services, watch loop,
+planner bridge.
+
+Reference: `deploy/cloud/operator/internal/controller/
+dynamographdeployment_controller.go` (Reconcile → reconcileResources →
+per-service child rendering + readiness rollup) and the planner's
+KubernetesConnector (patching CR replicas). TPU-native rendering: worker
+pods get `google.com/tpu` resource requests and GKE accelerator/topology
+node selectors; commands are this repo's `python -m dynamo_tpu.*`
+entrypoints (deploy/k8s/agg.yaml conventions).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from dynamo_tpu.operator.kube import KubeClient, KubeError, apply
+from dynamo_tpu.operator.types import (
+    GROUP,
+    KIND,
+    VERSION,
+    ComponentSpec,
+    DynamoGraphDeployment,
+)
+
+logger = logging.getLogger(__name__)
+
+MANAGED_BY = "dynamo-tpu-operator"
+_STORE_PORT = 4222
+_HTTP_PORT = 8080
+_GRPC_PORT = 8787
+
+
+def _owner_ref(dgd: DynamoGraphDeployment) -> dict:
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": KIND,
+        "name": dgd.name,
+        "uid": dgd.uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _labels(dgd: DynamoGraphDeployment, svc_name: str) -> dict:
+    return {
+        "app": f"{dgd.name}-{svc_name}",
+        "app.kubernetes.io/managed-by": MANAGED_BY,
+        "dynamo.tpu/deployment": dgd.name,
+        "dynamo.tpu/service": svc_name,
+    }
+
+
+def _command(dgd: DynamoGraphDeployment, name: str,
+             spec: ComponentSpec) -> list[str]:
+    store = f"tcp://{dgd.name}-coordinator:{_STORE_PORT}"
+    kind = spec.component_type
+    if kind == "coordinator":
+        cmd = ["python", "-m", "dynamo_tpu.coordinator",
+               "--host", "0.0.0.0", "--port", str(_STORE_PORT)]
+    elif kind == "frontend":
+        cmd = ["python", "-m", "dynamo_tpu.frontend",
+               "--host", "0.0.0.0", "--port", str(spec.port or _HTTP_PORT),
+               "--store", store]
+    elif kind in ("worker", "prefill_worker"):
+        cmd = ["python", "-m", "dynamo_tpu.worker", "--store", store]
+        if spec.model:
+            cmd += ["--model", spec.model]
+        if kind == "prefill_worker":
+            cmd += ["--is-prefill-worker"]
+    elif kind == "planner":
+        cmd = ["python", "-m", "dynamo_tpu.planner", "--store", store]
+    elif kind == "mocker":
+        cmd = ["python", "-m", "dynamo_tpu.worker", "--mock",
+               "--store", store]
+    elif kind == "router":
+        cmd = ["python", "-m", "dynamo_tpu.router", "--store", store]
+    else:
+        raise ValueError(f"unknown componentType {kind!r} for {name}")
+    return cmd + list(spec.args)
+
+
+def _service_ports(spec: ComponentSpec) -> list[dict]:
+    if spec.component_type == "coordinator":
+        return [{"name": "store", "port": _STORE_PORT}]
+    if spec.component_type == "frontend":
+        return [{"name": "http", "port": spec.port or _HTTP_PORT},
+                {"name": "grpc", "port": _GRPC_PORT}]
+    return []
+
+
+def render_children(dgd: DynamoGraphDeployment) -> list[tuple[str, dict]]:
+    """Desired (kind, manifest) children for a graph CR, deterministic
+    order (coordinator first so dependents resolve its Service DNS)."""
+    order = {"coordinator": 0, "frontend": 2}
+    out: list[tuple[str, dict]] = []
+    for name, spec in sorted(
+            dgd.services.items(),
+            key=lambda kv: order.get(kv[1].component_type, 1)):
+        labels = _labels(dgd, name)
+        child_name = f"{dgd.name}-{name}"
+        env = [{"name": k, "value": v}
+               for k, v in {**dgd.envs, **spec.envs}.items()]
+        container = {
+            "name": name,
+            "image": spec.image,
+            "command": _command(dgd, name, spec),
+        }
+        if env:
+            container["env"] = env
+        pod_spec: dict = {"containers": [container]}
+        if spec.component_type == "frontend":
+            port = spec.port or _HTTP_PORT
+            container["readinessProbe"] = {
+                "httpGet": {"path": "/health", "port": port}}
+            container["livenessProbe"] = {
+                "httpGet": {"path": "/live", "port": port}}
+        if spec.tpu_chips:
+            tpu = {"google.com/tpu": str(spec.tpu_chips)}
+            container["resources"] = {"requests": tpu, "limits": tpu}
+            pod_spec["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator":
+                    spec.tpu_accelerator,
+                "cloud.google.com/gke-tpu-topology": spec.tpu_topology,
+            }
+        if spec.extra_pod_spec:
+            pod_spec.update(spec.extra_pod_spec)
+        out.append(("Deployment", {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": child_name, "namespace": dgd.namespace,
+                         "labels": labels,
+                         "ownerReferences": [_owner_ref(dgd)]},
+            "spec": {
+                "replicas": spec.replicas,
+                "selector": {"matchLabels": {"app": labels["app"]}},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }))
+        ports = _service_ports(spec)
+        if ports:
+            out.append(("Service", {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": child_name,
+                             "namespace": dgd.namespace,
+                             "labels": labels,
+                             "ownerReferences": [_owner_ref(dgd)]},
+                "spec": {"selector": {"app": labels["app"]},
+                         "ports": ports},
+            }))
+    return out
+
+
+class GraphReconciler:
+    """Level-triggered reconcile: desired children from the CR spec,
+    create/update present ones, delete orphans, roll child readiness up
+    into `.status` (controller.go reconcileResources analog)."""
+
+    def __init__(self, client: KubeClient) -> None:
+        self.client = client
+
+    def reconcile(self, namespace: str, name: str) -> str:
+        try:
+            raw = self.client.get(KIND, namespace, name)
+        except KubeError as e:
+            if e.status == 404:
+                return "gone"   # children die via ownerReferences GC
+            raise
+        dgd = DynamoGraphDeployment.from_dict(raw)
+        desired = render_children(dgd)
+        desired_names = {(k, m["metadata"]["name"]) for k, m in desired}
+
+        for kind, manifest in desired:
+            cur = None
+            try:
+                cur = self.client.get(kind, namespace,
+                                      manifest["metadata"]["name"])
+            except KubeError as e:
+                if e.status != 404:
+                    raise
+            if cur is not None and _spec_matches(cur, manifest):
+                continue
+            apply(self.client, kind, namespace, manifest)
+
+        # orphans: previously-rendered children this CR no longer wants
+        sel = {"dynamo.tpu/deployment": dgd.name,
+               "app.kubernetes.io/managed-by": MANAGED_BY}
+        for kind in ("Deployment", "Service"):
+            for obj in self.client.list(kind, namespace,
+                                        label_selector=sel):
+                key = (kind, obj["metadata"]["name"])
+                if key not in desired_names:
+                    self.client.delete(kind, namespace,
+                                       obj["metadata"]["name"])
+
+        state = self._rollup(dgd, namespace)
+        self.client.patch_status(KIND, namespace, name, {"state": state})
+        return state
+
+    def _rollup(self, dgd: DynamoGraphDeployment, namespace: str) -> str:
+        for name, spec in dgd.services.items():
+            try:
+                dep = self.client.get("Deployment", namespace,
+                                      f"{dgd.name}-{name}")
+            except KubeError:
+                return "pending"
+            ready = dep.get("status", {}).get("readyReplicas", 0) or 0
+            if ready < dep.get("spec", {}).get("replicas", 1):
+                return "pending"
+        return "ready"
+
+
+def _spec_matches(current: dict, desired: dict) -> bool:
+    """Compare only the fields the operator renders (the apiserver adds
+    defaults we must not fight)."""
+    return json.dumps(_projection(current), sort_keys=True) == \
+        json.dumps(_projection(desired), sort_keys=True)
+
+
+def _projection(obj: dict) -> dict:
+    spec = obj.get("spec", {})
+    if obj.get("kind") == "Service":
+        return {"selector": spec.get("selector"),
+                "ports": [{"name": p.get("name"), "port": p.get("port")}
+                          for p in spec.get("ports", [])]}
+    tmpl = spec.get("template", {})
+    return {
+        "replicas": spec.get("replicas"),
+        "labels": obj.get("metadata", {}).get("labels"),
+        "pod": {
+            "nodeSelector": tmpl.get("spec", {}).get("nodeSelector"),
+            "containers": [
+                {"image": c.get("image"), "command": c.get("command"),
+                 "env": c.get("env"), "resources": c.get("resources")}
+                for c in tmpl.get("spec", {}).get("containers", [])
+            ],
+        },
+    }
+
+
+class PlannerSync:
+    """Bridge the SLA planner's store-published replica targets into CR
+    patches (reference KubernetesConnector analog: the planner stays
+    cluster-agnostic, the operator owns kubectl rights).
+
+    Watches `v1/planner/<ns>/target_replicas` in the runtime store and
+    rewrites the matching CR services' replica counts; the reconcile
+    loop then scales the child Deployments."""
+
+    def __init__(self, client: KubeClient, store, namespace: str,
+                 dgd_name: str, dgd_namespace: str = "default") -> None:
+        self.client = client
+        self.store = store
+        self.namespace = namespace
+        self.dgd_name = dgd_name
+        self.dgd_namespace = dgd_namespace
+
+    async def apply_targets(self) -> Optional[dict]:
+        """One sync pass; returns the applied {service: replicas} or
+        None when no targets are published."""
+        from dynamo_tpu.planner.connector import target_key
+
+        kv = await self.store.get(target_key(self.namespace))
+        if kv is None:
+            return None
+        payload = json.loads(kv.value)
+        # planner targets carry sub_component_type "prefill"/"decode";
+        # map onto the CR's componentType roles
+        by_role: dict[str, int] = {}
+        for t in payload.get("targets", []):
+            sub = t.get("sub_component_type") or "decode"
+            role = "prefill_worker" if sub == "prefill" else "worker"
+            by_role[role] = int(t["desired_replicas"])
+        if not by_role:
+            return None
+        cr = self.client.get(KIND, self.dgd_namespace, self.dgd_name)
+        services = cr["spec"].get("services", {})
+        changed = {}
+        for svc_name, svc in services.items():
+            want = by_role.get(svc.get("componentType", "worker"))
+            if want is not None and svc.get("replicas") != want:
+                svc["replicas"] = want
+                changed[svc_name] = want
+        if changed:
+            self.client.update(KIND, self.dgd_namespace, self.dgd_name,
+                               cr)
+        return changed or None
+
+
+class ControllerLoop:
+    """Poll-based controller: list CRs, reconcile each, run the planner
+    bridge, repeat every `resync` seconds. (The HttpKube watch endpoint
+    upgrade is mechanical; polling keeps the loop dependency-free and is
+    plenty for the CR counts an inference cluster sees.)"""
+
+    def __init__(self, client: KubeClient, namespace: str = "default",
+                 resync: float = 10.0,
+                 planner_sync: Optional[PlannerSync] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.resync = resync
+        self.planner_sync = planner_sync
+        self.reconciler = GraphReconciler(client)
+        self._stop = asyncio.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("reconcile pass failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.resync)
+            except asyncio.TimeoutError:
+                pass
+
+    async def step(self) -> dict[str, str]:
+        if self.planner_sync is not None:
+            try:
+                applied = await self.planner_sync.apply_targets()
+                if applied:
+                    logger.info("planner targets applied: %s", applied)
+            except KubeError as e:
+                logger.warning("planner sync failed: %s", e)
+        states = {}
+        for cr in await asyncio.to_thread(
+                self.client.list, KIND, self.namespace):
+            name = cr["metadata"]["name"]
+            states[name] = await asyncio.to_thread(
+                self.reconciler.reconcile, self.namespace, name)
+        return states

@@ -1,0 +1,185 @@
+"""CRD model for TPU serving graphs.
+
+Reference: `deploy/cloud/operator/api/v1alpha1/dynamographdeployment_
+types.go` (DynamoGraphDeploymentSpec: services map + envs + pvcs) and
+`dynamocomponentdeployment_types.go` (componentType/subComponentType,
+replicas, autoscaling, resources, extraPodSpec). Same shape, TPU-native
+fields: tpu chip count + GKE accelerator/topology selectors instead of
+GPU counts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+GROUP = "dynamo.tpu"
+VERSION = "v1alpha1"
+PLURAL = "dynamographdeployments"
+KIND = "DynamoGraphDeployment"
+
+COMPONENT_KINDS = ("coordinator", "frontend", "worker", "prefill_worker",
+                   "planner", "mocker", "router")
+
+
+@dataclass
+class Autoscaling:
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+
+
+@dataclass
+class ComponentSpec:
+    """One service in the graph (DynamoComponentDeploymentSharedSpec)."""
+
+    component_type: str = "worker"      # COMPONENT_KINDS
+    replicas: int = 1
+    model: Optional[str] = None         # worker checkpoint
+    image: str = "dynamo-tpu:latest"
+    args: list[str] = field(default_factory=list)   # extra CLI args
+    envs: dict[str, str] = field(default_factory=dict)
+    tpu_chips: int = 0                  # google.com/tpu request per pod
+    tpu_accelerator: str = "tpu-v5-lite-podslice"
+    tpu_topology: str = "1x1"
+    port: Optional[int] = None          # service port override
+    autoscaling: Optional[Autoscaling] = None
+    extra_pod_spec: dict = field(default_factory=dict)  # merged verbatim
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "componentType": self.component_type,
+            "replicas": self.replicas,
+            "image": self.image,
+        }
+        if self.model:
+            d["model"] = self.model
+        if self.args:
+            d["args"] = list(self.args)
+        if self.envs:
+            d["envs"] = dict(self.envs)
+        if self.tpu_chips:
+            d["tpu"] = {"chips": self.tpu_chips,
+                        "accelerator": self.tpu_accelerator,
+                        "topology": self.tpu_topology}
+        if self.port is not None:
+            d["port"] = self.port
+        if self.autoscaling is not None:
+            d["autoscaling"] = {
+                "enabled": self.autoscaling.enabled,
+                "minReplicas": self.autoscaling.min_replicas,
+                "maxReplicas": self.autoscaling.max_replicas,
+            }
+        if self.extra_pod_spec:
+            d["extraPodSpec"] = dict(self.extra_pod_spec)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComponentSpec":
+        tpu = d.get("tpu") or {}
+        auto = d.get("autoscaling")
+        return cls(
+            component_type=d.get("componentType", "worker"),
+            replicas=int(d.get("replicas", 1)),
+            model=d.get("model"),
+            image=d.get("image", "dynamo-tpu:latest"),
+            args=list(d.get("args", [])),
+            envs=dict(d.get("envs", {})),
+            tpu_chips=int(tpu.get("chips", 0)),
+            tpu_accelerator=tpu.get("accelerator", "tpu-v5-lite-podslice"),
+            tpu_topology=tpu.get("topology", "1x1"),
+            port=d.get("port"),
+            autoscaling=Autoscaling(
+                enabled=bool(auto.get("enabled", False)),
+                min_replicas=int(auto.get("minReplicas", 1)),
+                max_replicas=int(auto.get("maxReplicas", 8)),
+            ) if auto else None,
+            extra_pod_spec=dict(d.get("extraPodSpec", {})),
+        )
+
+
+@dataclass
+class DynamoGraphDeployment:
+    """The graph CR: a named set of components + shared env."""
+
+    name: str
+    namespace: str = "default"
+    services: dict[str, ComponentSpec] = field(default_factory=dict)
+    envs: dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    generation: int = 1
+    # status
+    state: str = ""                     # "" | "pending" | "ready" | "failed"
+    conditions: list[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": KIND,
+            "metadata": {"name": self.name, "namespace": self.namespace,
+                         "uid": self.uid, "generation": self.generation},
+            "spec": {
+                "services": {n: s.to_dict()
+                             for n, s in self.services.items()},
+                "envs": dict(self.envs),
+            },
+            "status": {"state": self.state,
+                       "conditions": list(self.conditions)},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DynamoGraphDeployment":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        status = d.get("status", {})
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            services={n: ComponentSpec.from_dict(s)
+                      for n, s in (spec.get("services") or {}).items()},
+            envs=dict(spec.get("envs", {})),
+            uid=meta.get("uid", ""),
+            generation=int(meta.get("generation", 1)),
+            state=status.get("state", ""),
+            conditions=list(status.get("conditions", [])),
+        )
+
+
+def crd_manifests() -> list[dict]:
+    """CustomResourceDefinition manifests to install on the cluster
+    (the analog of the reference's config/crd bases)."""
+    return [{
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": KIND, "plural": PLURAL,
+                      "singular": "dynamographdeployment",
+                      "shortNames": ["dgd"]},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                        "status": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                }},
+                "additionalPrinterColumns": [
+                    {"name": "State", "type": "string",
+                     "jsonPath": ".status.state"},
+                ],
+            }],
+        },
+    }]

@@ -1,0 +1,211 @@
+"""K8s operator: CRD rendering, reconcile semantics, planner bridge.
+
+All against FakeKube (in-memory apiserver) — the reconcile loop is pure
+dict-shuffling, so full lifecycle (create → scale → orphan-delete →
+status rollup) tests run hermetic.
+"""
+
+import json
+
+import pytest
+
+from dynamo_tpu.operator.kube import FakeKube, KubeError, apply
+from dynamo_tpu.operator.reconciler import (
+    ControllerLoop,
+    GraphReconciler,
+    PlannerSync,
+    render_children,
+)
+from dynamo_tpu.operator.types import (
+    KIND,
+    ComponentSpec,
+    DynamoGraphDeployment,
+    crd_manifests,
+)
+
+
+def graph(**svc_overrides):
+    services = {
+        "coordinator": ComponentSpec(component_type="coordinator"),
+        "frontend": ComponentSpec(component_type="frontend"),
+        "backend": ComponentSpec(component_type="worker", replicas=2,
+                                 model="meta-llama/Llama-3.1-8B",
+                                 tpu_chips=1,
+                                 args=["--quantize", "int8"]),
+    }
+    services.update(svc_overrides)
+    return DynamoGraphDeployment(name="demo", namespace="default",
+                                 uid="uid-1", services=services,
+                                 envs={"DYN_LOG": "info"})
+
+
+def put_cr(kube, dgd):
+    try:
+        kube.create(KIND, dgd.namespace, dgd.to_dict())
+    except KubeError:
+        cur = kube.get(KIND, dgd.namespace, dgd.name)
+        d = dgd.to_dict()
+        d["metadata"]["resourceVersion"] = \
+            cur["metadata"]["resourceVersion"]
+        kube.update(KIND, dgd.namespace, dgd.name, d)
+
+
+def test_render_children_shapes():
+    children = render_children(graph())
+    kinds = [(k, m["metadata"]["name"]) for k, m in children]
+    assert ("Deployment", "demo-backend") in kinds
+    assert ("Service", "demo-coordinator") in kinds
+    assert ("Service", "demo-frontend") in kinds
+    # coordinator renders first (dependents resolve its DNS)
+    assert kinds[0][1] == "demo-coordinator"
+    dep = dict(children)[("Deployment", "demo-backend")] \
+        if False else [m for k, m in children
+                       if (k, m["metadata"]["name"])
+                       == ("Deployment", "demo-backend")][0]
+    pod = dep["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"] == "1"
+    assert pod["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert "--quantize" in c["command"] and "int8" in c["command"]
+    assert "--store" in c["command"]
+    store_arg = c["command"][c["command"].index("--store") + 1]
+    assert store_arg == "tcp://demo-coordinator:4222"
+    assert {"name": "DYN_LOG", "value": "info"} in c["env"]
+    assert dep["metadata"]["ownerReferences"][0]["uid"] == "uid-1"
+
+
+def test_reconcile_creates_children_and_reports_ready():
+    kube = FakeKube()
+    put_cr(kube, graph())
+    state = GraphReconciler(kube).reconcile("default", "demo")
+    assert state == "ready"   # FakeKube deployments come up ready
+    assert kube.get("Deployment", "default", "demo-backend")
+    assert kube.get("Service", "default", "demo-frontend")
+    cr = kube.get(KIND, "default", "demo")
+    assert cr["status"]["state"] == "ready"
+
+
+def test_reconcile_pending_until_children_ready():
+    kube = FakeKube()
+    put_cr(kube, graph())
+    rec = GraphReconciler(kube)
+    rec.reconcile("default", "demo")
+    kube.set_ready("demo-backend", "default", 0)
+    assert rec.reconcile("default", "demo") == "pending"
+    kube.set_ready("demo-backend", "default", 2)
+    assert rec.reconcile("default", "demo") == "ready"
+
+
+def test_reconcile_scales_existing_deployment():
+    kube = FakeKube()
+    put_cr(kube, graph())
+    rec = GraphReconciler(kube)
+    rec.reconcile("default", "demo")
+    g2 = graph(backend=ComponentSpec(
+        component_type="worker", replicas=5,
+        model="meta-llama/Llama-3.1-8B", tpu_chips=1,
+        args=["--quantize", "int8"]))
+    put_cr(kube, g2)
+    rec.reconcile("default", "demo")
+    dep = kube.get("Deployment", "default", "demo-backend")
+    assert dep["spec"]["replicas"] == 5
+
+
+def test_reconcile_deletes_orphans():
+    kube = FakeKube()
+    put_cr(kube, graph())
+    rec = GraphReconciler(kube)
+    rec.reconcile("default", "demo")
+    g2 = graph()
+    del g2.services["backend"]
+    put_cr(kube, g2)
+    rec.reconcile("default", "demo")
+    with pytest.raises(KubeError):
+        kube.get("Deployment", "default", "demo-backend")
+    # the other children survive
+    assert kube.get("Deployment", "default", "demo-frontend")
+
+
+def test_reconcile_idempotent_no_spurious_updates():
+    kube = FakeKube()
+    put_cr(kube, graph())
+    rec = GraphReconciler(kube)
+    rec.reconcile("default", "demo")
+    before = [a for a in kube.actions if a[0] in ("create", "update")]
+    rec.reconcile("default", "demo")
+    after = [a for a in kube.actions if a[0] in ("create", "update")]
+    assert before == after  # second pass changed nothing
+
+
+def test_reconcile_gone_cr():
+    kube = FakeKube()
+    assert GraphReconciler(kube).reconcile("default", "nope") == "gone"
+
+
+def test_crd_manifest_shape():
+    (crd,) = crd_manifests()
+    assert crd["metadata"]["name"] == "dynamographdeployments.dynamo.tpu"
+    v = crd["spec"]["versions"][0]
+    assert v["subresources"] == {"status": {}}
+
+
+def test_cr_roundtrip():
+    d = graph().to_dict()
+    back = DynamoGraphDeployment.from_dict(d)
+    assert back.services["backend"].tpu_chips == 1
+    assert back.services["backend"].args == ["--quantize", "int8"]
+    assert back.to_dict() == d
+
+
+class _FakeStoreKV:
+    def __init__(self, value):
+        self.value = value
+
+
+class _FakeStore:
+    def __init__(self):
+        self.data = {}
+
+    async def get(self, key):
+        v = self.data.get(key)
+        return None if v is None else _FakeStoreKV(v)
+
+
+async def test_planner_sync_patches_cr_and_reconcile_scales():
+    from dynamo_tpu.planner.connector import target_key
+
+    kube = FakeKube()
+    put_cr(kube, graph(prefill=ComponentSpec(
+        component_type="prefill_worker", replicas=1, tpu_chips=1,
+        model="m")))
+    store = _FakeStore()
+    store.data[target_key("dynamo")] = json.dumps({
+        "revision": 3,
+        "targets": [
+            {"component": "backend", "sub_component_type": "decode",
+             "desired_replicas": 4},
+            {"component": "backend_prefill",
+             "sub_component_type": "prefill", "desired_replicas": 2},
+        ],
+    })
+    sync = PlannerSync(kube, store, "dynamo", "demo")
+    applied = await sync.apply_targets()
+    assert applied == {"backend": 4, "prefill": 2}
+    loop = ControllerLoop(kube, planner_sync=sync)
+    states = await loop.step()
+    assert states == {"demo": "ready"}
+    assert kube.get("Deployment", "default",
+                    "demo-backend")["spec"]["replicas"] == 4
+    assert kube.get("Deployment", "default",
+                    "demo-prefill")["spec"]["replicas"] == 2
+    # re-applying identical targets is a no-op
+    assert await sync.apply_targets() is None
+
+
+def test_print_crds_cli(capsys):
+    from dynamo_tpu.operator.__main__ import main
+
+    assert main(["--print-crds"]) == 0
+    out = capsys.readouterr().out
+    assert "dynamographdeployments.dynamo.tpu" in out

@@ -454,6 +454,12 @@ class TpuEngine:
             try:
                 self._reap_transfers()
                 self._admit()
+                if self.kvbm is not None and self.kvbm.remote is not None:
+                    # G4: continue freshly-admitted prompts' block chains
+                    # from peer workers' tiers before prefill
+                    for s in self._running:
+                        if not s.prefilled and s.import_kv is None:
+                            await self.kvbm.onboard_remote(s)
                 progressed = await self._prefill_pending()
                 progressed |= await self._decode_iter()
                 self._publish_metrics()

@@ -8,8 +8,10 @@ device↔host copies (the CUDA `block_copy.cu` analog is the engine's
 read/write_kv_pages); tier demotion G2→G3 is host file IO.
 """
 
+from dynamo_tpu.kvbm.distributed import KVBM_PULL_ENDPOINT, KvbmDistributed
 from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmManager, KvbmStats
 from dynamo_tpu.kvbm.tiers import DiskTier, HostTier, TieredStore
 
 __all__ = ["KvbmManager", "KvbmConfig", "KvbmStats", "TieredStore",
-           "HostTier", "DiskTier"]
+           "HostTier", "DiskTier", "KvbmDistributed",
+           "KVBM_PULL_ENDPOINT"]

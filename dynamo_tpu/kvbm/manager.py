@@ -42,10 +42,16 @@ class KvbmStats:
     offloaded: int = 0
     onboarded: int = 0
     onboard_queries: int = 0
+    remote_onboarded: int = 0
 
 
 class KvbmManager:
-    """Attaches G2/G3 tiers to a TpuEngine (see module docstring)."""
+    """Attaches G2/G3 tiers to a TpuEngine (see module docstring).
+
+    The G4 remote tier (cross-worker pull) attaches separately:
+    `kvbm.distributed.KvbmDistributed(manager, runtime, ...)` — it sets
+    ``self.remote`` and subscribes to tier changes via
+    ``on_tiers_changed``."""
 
     def __init__(self, engine, config: Optional[KvbmConfig] = None) -> None:
         self.engine = engine
@@ -54,6 +60,8 @@ class KvbmManager:
                                  self.config.disk_blocks,
                                  self.config.disk_dir)
         self.stats = KvbmStats()
+        self.remote = None
+        self.on_tiers_changed = None
         engine.pool.evict_hook = self._on_evict
         engine.kvbm = self
 
@@ -75,6 +83,8 @@ class KvbmManager:
         for i, (_, seq_hash) in enumerate(batch):
             self.store.put(seq_hash, data[:, :, :, i])
             self.stats.offloaded += 1
+        if self.on_tiers_changed is not None:
+            self.on_tiers_changed()
 
     # -- onboard (G2/G3 → G1) -----------------------------------------------
 
@@ -101,16 +111,63 @@ class KvbmManager:
             i += 1
         if not hits:
             return seq.cached_len
-        # one batched device write for the whole contiguous hit run
+        self._write_and_register(seq, start, hits)
+        self.stats.onboarded += len(hits)
+        return i * ps
+
+    def _write_and_register(self, seq, start: int, blocks_data) -> None:
+        """Shared onboard tail for the local AND remote paths: one
+        batched device write of the contiguous run, then page
+        registration (emits KV_STORED for the router's view)."""
         import numpy as np
 
+        ps = self.engine.model_cfg.page_size
+        end = start + len(blocks_data)
         self.engine.write_kv_pages(
-            seq.pages[start:i], np.stack(hits, axis=3))
+            seq.pages[start:end], np.stack(blocks_data, axis=3))
         blocks = TokenBlockSequence(ps, seq.prompt).blocks
-        for j in range(start, i):
+        for j in range(start, end):
             blk = blocks[j]
             self.engine.pool.register_page(
                 seq.pages[j], blk.seq_hash, blk.local_hash,
                 blk.parent_seq_hash)
-            self.stats.onboarded += 1
-        return i * ps
+
+    def block_shape(self) -> tuple:
+        """(2, L, KVH, P, D) — the wire/tier shape of one block."""
+        m = self.engine.model_cfg
+        return (2, m.num_layers, m.num_kv_heads, m.page_size, m.head_dim)
+
+    # -- remote onboard (G4 → G1) -------------------------------------------
+
+    async def onboard_remote(self, seq) -> int:
+        """Continue `seq`'s block chain from PEER workers' tiers where the
+        local tiers ran out. Called by the engine scheduler after
+        admission (async: it crosses the network), before prefill.
+        Updates ``seq.cached_len`` and returns it. Never raises — a
+        remote-tier failure must degrade to a cache miss, not fail the
+        scheduler iteration."""
+        if self.remote is None:
+            return seq.cached_len
+        try:
+            ps = self.engine.model_cfg.page_size
+            hashes = seq.prompt_hashes
+            max_blocks = (len(seq.prompt) - 1) // ps
+            start = seq.cached_len // ps
+            if start >= max_blocks or start >= len(hashes):
+                return seq.cached_len
+            blocks_data = await self.remote.fetch(
+                hashes[start:max_blocks],
+                expect_shape=self.block_shape())
+            if not blocks_data:
+                return seq.cached_len
+            async with self.engine._device_lock:
+                self._write_and_register(seq, start, blocks_data)
+            self.stats.remote_onboarded += len(blocks_data)
+            seq.cached_len = (start + len(blocks_data)) * ps
+            logger.info("kvbm: onboarded %d remote blocks "
+                        "(cached_len=%d)", len(blocks_data),
+                        seq.cached_len)
+        except Exception:
+            logger.exception("kvbm remote onboard failed; continuing "
+                             "with local prefix only")
+        return seq.cached_len

@@ -178,3 +178,11 @@ class TieredStore:
                 break
             n += 1
         return n
+
+    def hashes(self) -> list[int]:
+        """All block hashes across tiers (the distributed advert)."""
+        out = list(self.host._blocks.keys())
+        if self.disk is not None:
+            out += [h for h in self.disk._lru.keys()
+                    if h not in self.host._blocks]
+        return out

@@ -355,6 +355,16 @@ def main(argv=None) -> None:
                 os._exit(43)
 
             rt.health.on_unhealthy = _canary_dead
+        if getattr(engine, "kvbm", None) is not None:
+            # G4 remote tier: advertise + serve this worker's offloaded
+            # blocks and pull peers' at admission
+            from dynamo_tpu.kvbm.distributed import KvbmDistributed
+
+            kvbm_dist = KvbmDistributed(
+                engine.kvbm, rt, card.namespace, card.component,
+                worker_id=instance_id)
+            await kvbm_dist.start()
+            extra.append(_Stoppable(kvbm_dist.close))
         handle = await serve_engine(rt, serving, card,
                                     instance_id=instance_id)
         monitor = EngineDeathMonitor(engine)

@@ -1,0 +1,118 @@
+"""Distributed KVBM (G4 remote tier): cross-worker block pull.
+
+Two engines on one runtime: worker A serves a prompt and offloads its
+blocks (eviction churn); worker B — which has NEVER seen the prompt —
+must onboard A's blocks over the `kvbm_pull` endpoint at admission and
+produce identical output while skipping the cached prefix's prefill.
+"""
+
+import jax
+
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.kvbm import KvbmConfig, KvbmDistributed, KvbmManager
+from dynamo_tpu.kvbm.distributed import registry_key
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(jax.random.PRNGKey(0), CFG)
+
+
+def make_engine(num_pages=10):
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=num_pages, max_batch_size=2,
+        prefill_chunk=32, min_prefill_bucket=8, default_max_tokens=4,
+        decode_steps_per_sync=2), params=PARAMS)
+    mgr = KvbmManager(eng, KvbmConfig(host_blocks=64))
+    return eng, mgr
+
+
+def req(tokens, max_tokens=4):
+    return {"token_ids": list(tokens), "model": "m",
+            "sampling": {"temperature": 0.0},
+            "stop": {"max_tokens": max_tokens}}
+
+
+async def collect(eng, r):
+    return [t async for o in eng.generate(r, Context())
+            for t in o.get("token_ids", ())]
+
+
+async def _runtime():
+    # long lease TTL: cold-start jit compiles starve the event loop's
+    # keepalive timer and a default-TTL lease can expire mid-test
+    return await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory", lease_ttl=30.0))
+
+
+async def test_remote_onboard_from_peer_tier():
+    rt = await _runtime()
+    eng_a, mgr_a = make_engine()
+    eng_b, mgr_b = make_engine()
+    dist_a = KvbmDistributed(mgr_a, rt, "dyn", "backend", worker_id=1,
+                             publish_debounce=0.01)
+    dist_b = KvbmDistributed(mgr_b, rt, "dyn", "backend", worker_id=2,
+                             publish_debounce=0.01)
+    try:
+        await dist_a.start()
+        await dist_b.start()
+        prompt = list(range(1, 13))            # 3 complete blocks
+        out_a = await collect(eng_a, req(prompt))
+        # churn A so the prompt's pages offload to A's host tier
+        for base in (50, 80, 110):
+            await collect(eng_a, req(list(range(base, base + 12))))
+        assert mgr_a.stats.offloaded >= 3
+        await dist_a._publish()                # skip the debounce in tests
+
+        out_b = await collect(eng_b, req(prompt))
+        assert out_b == out_a
+        assert mgr_b.stats.remote_onboarded >= 2
+    finally:
+        await dist_a.close()
+        await dist_b.close()
+        await eng_a.close()
+        await eng_b.close()
+        await rt.close()
+
+
+async def test_registry_advertises_and_dies_with_lease():
+    rt = await _runtime()
+    eng, mgr = make_engine()
+    dist = KvbmDistributed(mgr, rt, "dyn", "backend", worker_id=7,
+                           publish_debounce=0.01)
+    try:
+        await dist.start()
+        await collect(eng, req(list(range(1, 13))))
+        for base in (50, 80, 110):
+            await collect(eng, req(list(range(base, base + 12))))
+        await dist._publish()
+        kv = await rt.store.get(registry_key("dyn", "backend", 7))
+        assert kv is not None
+        import json
+
+        adv = json.loads(kv.value)
+        assert adv["worker_id"] == 7 and len(adv["blocks"]) >= 3
+    finally:
+        await dist.close()
+        await eng.close()
+        store = rt.store
+        await rt.close()
+    # lease revoked on rt.close(): the advert must be gone from the store
+    assert (await store.get(registry_key("dyn", "backend", 7))) is None
+
+
+async def test_fetch_with_no_peers_is_noop():
+    rt = await _runtime()
+    eng, mgr = make_engine()
+    dist = KvbmDistributed(mgr, rt, "dyn", "backend", worker_id=3)
+    try:
+        await dist.start()
+        out = await collect(eng, req(list(range(1, 13))))
+        assert len(out) == 4
+        assert mgr.stats.remote_onboarded == 0
+    finally:
+        await dist.close()
+        await eng.close()
+        await rt.close()

@@ -456,10 +456,15 @@ class TpuEngine:
                 self._admit()
                 if self.kvbm is not None and self.kvbm.remote is not None:
                     # G4: continue freshly-admitted prompts' block chains
-                    # from peer workers' tiers before prefill
-                    for s in self._running:
-                        if not s.prefilled and s.import_kv is None:
-                            await self.kvbm.onboard_remote(s)
+                    # from peer workers' tiers before prefill. Fetches
+                    # run CONCURRENTLY so the worst-case admission stall
+                    # is one fetch_timeout per wave, not per sequence
+                    # (onboard_remote never raises)
+                    fresh = [s for s in self._running
+                             if not s.prefilled and s.import_kv is None]
+                    if fresh:
+                        await asyncio.gather(
+                            *(self.kvbm.onboard_remote(s) for s in fresh))
                 progressed = await self._prefill_pending()
                 progressed |= await self._decode_iter()
                 self._publish_metrics()

@@ -52,7 +52,7 @@ class KvbmDistributed:
 
     def __init__(self, manager, runtime, namespace: str, component: str,
                  worker_id: int, publish_debounce: float = 0.2,
-                 fetch_timeout: float = 10.0) -> None:
+                 fetch_timeout: float = 2.0) -> None:
         self.manager = manager
         self.runtime = runtime
         self.namespace = namespace
@@ -68,8 +68,11 @@ class KvbmDistributed:
         self._adverts: Optional[list] = None
         self._adverts_at = 0.0
         manager.remote = self
-        # tier mutations (offload/demote) schedule a debounced re-advert
-        manager.on_tiers_changed = self._schedule_publish
+        # EVERY tier mutation (offload, LRU displacement, disk demotion,
+        # promote-drop) schedules a debounced re-advert — an advert that
+        # over-claims blocks steals best-peer selection from workers that
+        # genuinely hold them
+        manager.store.on_change = self._schedule_publish
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.push import PushRouter
@@ -131,14 +134,23 @@ class KvbmDistributed:
         """Stream the leading contiguous run of requested blocks this
         worker holds. Frames carry raw bytes + dtype/shape; stopping at
         the first miss keeps the chain contract (callers onboard
-        prefix-contiguous runs only)."""
-        for h in request.get("seq_hashes", []):
-            data = self.manager.store.get(int(h))
+        prefix-contiguous runs only). Tier reads (possibly disk IO) and
+        the bytes copy run in a thread — serving a pull must not stall
+        THIS worker's scheduler loop."""
+
+        def read_frame(h: int):
+            data = self.manager.store.get(h)
             if data is None:
+                return None
+            return {"seq_hash": h, "dtype": str(data.dtype),
+                    "shape": list(data.shape),
+                    "data": np.ascontiguousarray(data).tobytes()}
+
+        for h in request.get("seq_hashes", []):
+            frame = await asyncio.to_thread(read_frame, int(h))
+            if frame is None:
                 break
-            yield {"seq_hash": int(h), "dtype": str(data.dtype),
-                   "shape": list(data.shape),
-                   "data": np.ascontiguousarray(data).tobytes()}
+            yield frame
 
     # -- fetch --------------------------------------------------------------
 
@@ -185,23 +197,40 @@ class KvbmDistributed:
                 best_id, best_n = wid, n
         if best_id is None:
             return []
-        try:
-            return await asyncio.wait_for(
-                self._pull(best_id, seq_hashes[:best_n], expect_shape),
-                self.fetch_timeout)
-        except asyncio.TimeoutError:
-            logger.warning("kvbm remote pull from %s timed out after "
-                           "%.1fs", best_id, self.fetch_timeout)
-            return []
-
-    async def _pull(self, peer_id: int, seq_hashes: list[int],
-                    expect_shape: Optional[tuple]) -> list[np.ndarray]:
-        from dynamo_tpu.runtime.context import Context
-
         blocks: list[np.ndarray] = []
         try:
+            await asyncio.wait_for(
+                self._pull(best_id, seq_hashes[:best_n], expect_shape,
+                           blocks),
+                self.fetch_timeout)
+        except asyncio.TimeoutError:
+            # a slow peer's partial leading run is still valid — keep it
+            logger.warning("kvbm remote pull from %s timed out after "
+                           "%.1fs with %d blocks", best_id,
+                           self.fetch_timeout, len(blocks))
+        return blocks
+
+    async def _pull(self, peer_id: int, seq_hashes: list[int],
+                    expect_shape: Optional[tuple],
+                    out: list[np.ndarray]) -> None:
+        """Appends verified blocks to `out` as frames arrive (the caller
+        keeps the partial run on timeout)."""
+        from dynamo_tpu.runtime.context import Context
+
+        try:
+            i = 0
             async for frame in self._router.direct(
                     {"seq_hashes": seq_hashes}, peer_id, Context()):
+                if i >= len(seq_hashes):
+                    break
+                if int(frame.get("seq_hash", -1)) != seq_hashes[i]:
+                    # a skewed peer (e.g. one that skips a missing middle
+                    # block instead of stopping) would misalign frames
+                    # with hashes and poison the prefix cache
+                    logger.warning(
+                        "kvbm peer %s frame hash mismatch at %d; "
+                        "dropping rest of run", peer_id, i)
+                    break
                 data = np.frombuffer(
                     frame["data"], dtype=_np_dtype(frame["dtype"])
                 ).reshape(frame["shape"])
@@ -212,10 +241,10 @@ class KvbmDistributed:
                         "(mixed geometries?); dropping rest of run",
                         peer_id, data.shape, expect_shape)
                     break
-                blocks.append(data)
+                out.append(data)
+                i += 1
         except Exception as e:
             # peer died or advert was stale: what we got is still a valid
             # leading run
             logger.warning("kvbm remote pull from %s failed after %d "
-                           "blocks: %s", peer_id, len(blocks), e)
-        return blocks
+                           "blocks: %s", peer_id, len(out), e)

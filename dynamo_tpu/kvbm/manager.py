@@ -50,8 +50,8 @@ class KvbmManager:
 
     The G4 remote tier (cross-worker pull) attaches separately:
     `kvbm.distributed.KvbmDistributed(manager, runtime, ...)` — it sets
-    ``self.remote`` and subscribes to tier changes via
-    ``on_tiers_changed``."""
+    ``self.remote`` and subscribes to tier mutations via
+    ``store.on_change``."""
 
     def __init__(self, engine, config: Optional[KvbmConfig] = None) -> None:
         self.engine = engine
@@ -61,7 +61,6 @@ class KvbmManager:
                                  self.config.disk_dir)
         self.stats = KvbmStats()
         self.remote = None
-        self.on_tiers_changed = None
         engine.pool.evict_hook = self._on_evict
         engine.kvbm = self
 
@@ -83,8 +82,6 @@ class KvbmManager:
         for i, (_, seq_hash) in enumerate(batch):
             self.store.put(seq_hash, data[:, :, :, i])
             self.stats.offloaded += 1
-        if self.on_tiers_changed is not None:
-            self.on_tiers_changed()
 
     # -- onboard (G2/G3 → G1) -----------------------------------------------
 

@@ -145,6 +145,14 @@ class TieredStore:
                  disk_dir: Optional[str] = None) -> None:
         self.host = HostTier(host_blocks)
         self.disk = DiskTier(disk_blocks, disk_dir) if disk_blocks else None
+        # fired after ANY mutation of the held-block set (insert, LRU
+        # displacement/drop, promotion) — the distributed advert
+        # subscribes so it can never over-claim for long
+        self.on_change = None
+
+    def _changed(self) -> None:
+        if self.on_change is not None:
+            self.on_change()
 
     def contains(self, seq_hash: int) -> bool:
         return self.host.contains(seq_hash) or (
@@ -154,6 +162,8 @@ class TieredStore:
         for demoted_hash, demoted in self.host.put(seq_hash, data):
             if self.disk is not None:
                 self.disk.put(demoted_hash, demoted)
+            # disk-capacity unlinks and no-disk drops both shrink the set
+        self._changed()
 
     def get(self, seq_hash: int) -> Optional[np.ndarray]:
         data = self.host.get(seq_hash)
@@ -167,7 +177,7 @@ class TieredStore:
             # disk slot (a lingering entry would double-count the block
             # against disk capacity and strand its file)
             self.disk.pop(seq_hash)
-            self.put(seq_hash, data)
+            self.put(seq_hash, data)   # fires _changed
         return data
 
     def match_prefix(self, seq_hashes: list[int]) -> int:

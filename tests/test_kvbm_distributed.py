@@ -116,3 +116,98 @@ async def test_fetch_with_no_peers_is_noop():
         await dist.close()
         await eng.close()
         await rt.close()
+
+
+async def test_fetch_timeout_degrades_to_miss():
+    rt = await _runtime()
+    eng_a, mgr_a = make_engine()
+    eng_b, mgr_b = make_engine()
+    dist_a = KvbmDistributed(mgr_a, rt, "dyn", "backend", worker_id=1,
+                             publish_debounce=0.01)
+    dist_b = KvbmDistributed(mgr_b, rt, "dyn", "backend", worker_id=2,
+                             publish_debounce=0.01, fetch_timeout=0.2)
+    try:
+        await dist_a.start()
+        await dist_b.start()
+        prompt = list(range(1, 13))
+        out_a = await collect(eng_a, req(prompt))
+        for base in (50, 80, 110):
+            await collect(eng_a, req(list(range(base, base + 12))))
+        await dist_a._publish()
+
+        # wedge A's pull endpoint: accepts but never streams
+        import asyncio
+
+        async def wedged(request, context=None):
+            await asyncio.sleep(60)
+            yield {}
+
+        rt.transport_server.register(dist_a._served.instance.subject,
+                                     _FnEngine(wedged))
+        rt.register_local(dist_a._served.instance.subject,
+                          _FnEngine(wedged))
+
+        out_b = await collect(eng_b, req(prompt))
+        # timed out -> B prefilled from scratch, output still correct
+        assert out_b == out_a
+        assert mgr_b.stats.remote_onboarded == 0
+    finally:
+        await dist_a.close()
+        await dist_b.close()
+        await eng_a.close()
+        await eng_b.close()
+        await rt.close()
+
+
+class _FnEngine:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def generate(self, request, context=None):
+        return self.fn(request, context)
+
+
+async def test_shape_mismatch_frames_dropped():
+    import numpy as np
+
+    rt = await _runtime()
+    eng_b, mgr_b = make_engine()
+    dist_b = KvbmDistributed(mgr_b, rt, "dyn", "backend", worker_id=2,
+                             publish_debounce=0.01)
+    try:
+        await dist_b.start()
+        # a fake peer advertising blocks but streaming WRONG-shaped data
+        import json
+
+        from dynamo_tpu.kvbm.distributed import (
+            KVBM_PULL_ENDPOINT,
+            registry_key,
+        )
+
+        prompt = list(range(1, 13))
+        from dynamo_tpu.tokens import compute_seq_hashes
+
+        hashes = compute_seq_hashes(prompt, CFG.page_size)
+
+        async def bad_peer(request, context=None):
+            for h in request["seq_hashes"]:
+                bad = np.zeros((2, 99, 2, 4, 16), np.float32)
+                yield {"seq_hash": h, "dtype": "float32",
+                       "shape": list(bad.shape), "data": bad.tobytes()}
+
+        ep = (rt.namespace("dyn").component("backend")
+              .endpoint(KVBM_PULL_ENDPOINT))
+        served = await ep.serve(bad_peer, instance_id=9)
+        await rt.store.put(
+            registry_key("dyn", "backend", 9),
+            json.dumps({"worker_id": 9,
+                        "blocks": hashes}).encode(), rt.lease_id)
+
+        out_b = await collect(eng_b, req(prompt))
+        assert len(out_b) == 4                    # request survives
+        assert mgr_b.stats.remote_onboarded == 0  # nothing bad onboarded
+        await served.shutdown()
+    finally:
+        await dist_b.close()
+        await eng_b.close()
+        await rt.close()

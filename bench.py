@@ -170,7 +170,22 @@ async def bench_kv_transfer(cfg, n_pages=256):
 
 def main():
     cfg = bench_cfg()
-    tok_s, wall, params = asyncio.run(run_engine_bench(cfg))
+    # the tunneled chip occasionally drops one call mid-run (observed
+    # once as a spurious "engine step failed"); the driver runs this
+    # file exactly once, so retry the engine phase rather than record a
+    # broken round
+    for attempt in (1, 2):
+        try:
+            tok_s, wall, params = asyncio.run(run_engine_bench(cfg))
+            break
+        except Exception:
+            if attempt == 2:
+                raise
+            import traceback
+
+            traceback.print_exc()
+            print("bench: engine phase failed; retrying once",
+                  flush=True)
     kv_stats = asyncio.run(bench_kv_transfer(cfg))
     loop_tok_s, loop_step_s = run_device_loop(cfg, params)
     ms_per_step = 1000.0 * BATCH / tok_s  # engine wall per fused step

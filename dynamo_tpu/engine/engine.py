@@ -144,6 +144,8 @@ class _Seq:
     import_kv: Optional[tuple] = None     # (np array (2,L,KVH,n,P,D), len)
     cached_len: int = 0                   # prefix-cache hit length
     draft_pos: int = 0                    # draft-cache-valid positions < this
+    guided: Optional[Any] = None          # GuidedTables when constrained
+    guided_state: int = 0                 # authoritative DFA state (host)
     next_token: int = -1                  # sampled, KV not yet written
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
@@ -167,7 +169,9 @@ class TpuEngine:
                  params: Optional[dict] = None,
                  event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
                  metrics_sink: Optional[Callable[[ForwardPassMetrics], None]]
-                 = None, draft_params: Optional[dict] = None) -> None:
+                 = None, draft_params: Optional[dict] = None,
+                 token_bytes: Optional[list] = None,
+                 eos_token_id: int = 0) -> None:
         self.config = config or TpuEngineConfig()
         cfg = self.config
         self.model_cfg = cfg.model
@@ -262,6 +266,14 @@ class TpuEngine:
         self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
                              cfg.worker_id, cfg.dp_rank, event_sink)
         self.kvbm = None   # set by kvbm.KvbmManager when attached
+        # guided decoding (llm/guided.py): token-bytes map of the serving
+        # tokenizer + per-grammar DFA tables, stacked onto the device for
+        # the fused guided burst. Slot 0 is the trivial grammar.
+        self._guided_vocab = token_bytes
+        self._guided_eos = eos_token_id
+        self._guided_tables: dict[str, Any] = {}
+        self._guided_slots: dict[str, int] = {}
+        self._guided_stack = None          # (bits_dev, next_dev)
         self.metrics_sink = metrics_sink
         self._waiting: list[_Seq] = []
         self._running: list[_Seq] = []
@@ -311,6 +323,16 @@ class TpuEngine:
                 token_ids=[], finish_reason=FINISH_ERROR,
                 extra={"error": "empty prompt"}).to_dict()
             return
+        guided_tables = None
+        if req.sampling.guided:
+            try:
+                guided_tables = await self._compile_guided(
+                    req.sampling.guided, req)
+            except Exception as e:
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": f"guided decoding: {e}"}).to_dict()
+                return
         if req.extra.get("embed"):
             max_ctx = mcfg.page_size * mcfg.max_pages_per_seq
             if len(req.token_ids) > max_ctx:
@@ -364,6 +386,7 @@ class TpuEngine:
             prompt_hashes=TokenBlockSequence(
                 mcfg.page_size, req.token_ids).seq_hashes(),
             import_kv=import_kv,
+            guided=guided_tables,
             seed=(req.sampling.seed if req.sampling.seed is not None
                   else int(self._rng.randint(0, 2**31 - 1))),
             arrival=self._arrivals,
@@ -569,14 +592,27 @@ class TpuEngine:
             stack = [last_logits[id(s)] for s in pending]
             while len(stack) < width:
                 stack.append(stack[0])
+            guided_mask = None
+            if any(s.guided is not None for s in pending):
+                # first sampled token must already respect the grammar
+                V = mcfg.vocab_size
+                guided_mask = np.zeros((width, V), dtype=np.float32)
+                for i, s in enumerate(pending):
+                    if s.guided is not None:
+                        ok = self._guided_allowed_row(s.guided, s, V)
+                        guided_mask[i, ~ok] = -1e30
 
             def arr(fn, dtype):
                 vals = [fn(s) for s in pending]
                 vals += [vals[0]] * (width - len(pending))
                 return np.asarray(vals, dtype=dtype)
 
+            logits_stack = jax.numpy.stack(stack)
+            if guided_mask is not None:
+                logits_stack = logits_stack + jax.numpy.asarray(
+                    guided_mask)
             sampled = sample_tokens_lp(
-                jax.numpy.stack(stack),
+                logits_stack,
                 arr(lambda s: s.seed, np.uint32),
                 arr(lambda s: s.generated, np.uint32),
                 arr(lambda s: s.req.sampling.temperature, np.float32),
@@ -616,10 +652,11 @@ class TpuEngine:
         # covers (no nucleus/top-k filtering) — mixed batches fall back.
         # checked over ALL runnable lanes (not just the first batch-width):
         # preemption inside the page-allocation loop below can promote a
-        # later lane into the batch, and a nucleus/top-k lane must never
-        # ride a spec burst
+        # later lane into the batch, and a nucleus/top-k or guided lane
+        # must never ride a spec burst
         use_spec = self.draft_params is not None and all(
             s.req.sampling.top_p >= 1.0 and s.req.sampling.top_k == 0
+            and s.guided is None
             for s in runnable)
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
@@ -722,7 +759,35 @@ class TpuEngine:
                 s.draft_pos = s.pos
             return True
 
+        use_guided = any(s.guided is not None for s in batch)
+        if use_guided:
+            from dynamo_tpu.models.llama import decode_multi_step_guided
+
+            g_bits, g_next, g_eos_ok = self._guided_device_stack()
+            g_ids = np.zeros(b, dtype=np.int32)
+            g_states = np.zeros(b, dtype=np.int32)
+            stop_ids = np.full((b, self.GUIDED_STOP_WIDTH), -1,
+                               dtype=np.int32)
+            for i, s in enumerate(batch):
+                g_ids[i] = self._guided_slot_of(s)
+                g_states[i] = s.guided_state
+                for j, t in enumerate(self._guided_stop_ids(s)):
+                    stop_ids[i, j] = t
+
         def run_burst():
+            if use_guided:
+                sampled, kc, vc = decode_multi_step_guided(
+                    self.params, self.k_cache, self.v_cache,
+                    jax.numpy.asarray(tokens),
+                    jax.numpy.asarray(positions),
+                    jax.numpy.asarray(page_tables),
+                    jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                    jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
+                    g_bits, g_next, g_eos_ok, jax.numpy.asarray(g_ids),
+                    jax.numpy.asarray(g_states),
+                    jax.numpy.asarray(stop_ids), mcfg, k_steps)
+                return np.asarray(sampled), kc, vc
             sampled, kc, vc = decode_multi_step(
                 self.params, self.k_cache, self.v_cache,
                 jax.numpy.asarray(tokens), jax.numpy.asarray(positions),
@@ -860,6 +925,119 @@ class TpuEngine:
                     last_logits[id(s)] = logits_b[i]
         return kc, vc, last_logits
 
+    # -- guided decoding ----------------------------------------------------
+
+    MAX_GUIDED_GRAMMARS = 32
+    GUIDED_STOP_WIDTH = 4
+
+    async def _compile_guided(self, spec: dict, req) -> Any:
+        """Compile (or fetch cached) DFA tables for a guided spec. The
+        regex→DFA→token-table build can take seconds for big grammars —
+        it runs in a thread and is cached by the spec's canonical JSON.
+        Tables are EOS-agnostic (stop tokens overlay per lane), so the
+        spec alone is a sound cache key."""
+        import json as _json
+
+        if callable(self._guided_vocab):
+            # lazy: the O(vocab) token-bytes map is only built when the
+            # first guided request arrives, not at engine startup
+            self._guided_vocab = await asyncio.to_thread(
+                self._guided_vocab)
+        if self._guided_vocab is None:
+            raise ValueError(
+                "engine has no tokenizer vocabulary (token_bytes) — "
+                "guided decoding unavailable")
+        key = _json.dumps(spec, sort_keys=True)
+        tables = self._guided_tables.get(key)
+        if tables is not None:
+            return tables
+        from dynamo_tpu.llm.guided import compile_guided
+
+        tables = await asyncio.to_thread(
+            compile_guided, spec, self._guided_vocab)
+        # re-check: a concurrent compile of the same spec may have won
+        # the race while we were in the thread — double-assigning the
+        # slot would alias a later grammar onto it
+        if key not in self._guided_tables:
+            if len(self._guided_tables) >= self.MAX_GUIDED_GRAMMARS:
+                self._evict_guided_unused()
+            if len(self._guided_tables) >= self.MAX_GUIDED_GRAMMARS:
+                raise ValueError(
+                    "too many distinct guided grammars in flight")
+            self._guided_tables[key] = tables
+            self._guided_slots[key] = len(self._guided_slots) + 1
+            self._guided_stack = None      # restack with the new grammar
+        return self._guided_tables[key]
+
+    def _evict_guided_unused(self) -> None:
+        """Drop cached grammars no active sequence references, and
+        renumber slots compactly (the device stack is rebuilt)."""
+        import json as _json
+
+        active = {
+            _json.dumps(s.req.sampling.guided, sort_keys=True)
+            for s in self._running + self._waiting
+            if s.guided is not None}
+        self._guided_tables = {k: v for k, v in
+                               self._guided_tables.items() if k in active}
+        self._guided_slots = {k: i + 1 for i, k in
+                              enumerate(self._guided_tables)}
+        self._guided_stack = None
+
+    def _guided_device_stack(self):
+        """(bits (G, S, ceil(V/8)) u8, next (G, S, V) i16, eos_ok (G, S)
+        bool) covering slot 0 (trivial all-allowed) + every compiled
+        grammar, padded to pow2 G and S so compile shapes stay
+        bounded."""
+        if self._guided_stack is not None:
+            return self._guided_stack
+        V = self.model_cfg.vocab_size
+        bv = (V + 7) // 8
+        tables = sorted(self._guided_tables.items(),
+                        key=lambda kv: self._guided_slots[kv[0]])
+        s_max = max([t.num_states for _, t in tables] or [1])
+        s_pad = _next_pow2(s_max, 1, 1 << 15)
+        g_pad = _next_pow2(len(tables) + 1, 1,
+                           2 * self.MAX_GUIDED_GRAMMARS)
+        bits = np.zeros((g_pad, s_pad, bv), dtype=np.uint8)
+        nxt = np.zeros((g_pad, s_pad, V), dtype=np.int16)
+        eos_ok = np.zeros((g_pad, s_pad), dtype=bool)
+        bits[0, :, :] = 0xFF               # slot 0: everything allowed
+        for key, t in tables:
+            slot = self._guided_slots[key]
+            s = t.num_states
+            bits[slot, :s] = t.allowed_bits[:, :bv]
+            nxt[slot, :s] = t.next_state[:, :V]
+            eos_ok[slot, :s] = t.eos_ok
+        self._guided_stack = (jax.numpy.asarray(bits),
+                              jax.numpy.asarray(nxt),
+                              jax.numpy.asarray(eos_ok))
+        return self._guided_stack
+
+    def _guided_slot_of(self, seq: _Seq) -> int:
+        import json as _json
+
+        if seq.guided is None:
+            return 0
+        return self._guided_slots[_json.dumps(seq.req.sampling.guided,
+                                              sort_keys=True)]
+
+    def _guided_stop_ids(self, seq: _Seq) -> list[int]:
+        ids = list(seq.req.stop.stop_token_ids or [])[
+            :self.GUIDED_STOP_WIDTH]
+        return ids or [self._guided_eos]
+
+    def _guided_allowed_row(self, tables, seq: _Seq,
+                            vocab: int) -> np.ndarray:
+        bits = np.unpackbits(tables.allowed_bits[seq.guided_state],
+                             bitorder="little")
+        row = bits[:vocab].astype(bool)
+        if tables.eos_ok[seq.guided_state]:
+            for t in self._guided_stop_ids(seq):
+                if 0 <= t < vocab:
+                    row[t] = True
+        return row
+
     async def _draft_catchup(self, lanes: list[_Seq]) -> None:
         """Replay tokens the draft cache is missing (positions
         draft_pos..pos-1, known from token_seq) through draft prefill
@@ -882,6 +1060,12 @@ class TpuEngine:
 
     def _emit_token(self, seq: _Seq, token: int,
                     logprob: Optional[float] = None) -> None:
+        if seq.guided is not None:
+            # authoritative DFA state lives host-side (device lane states
+            # are re-seeded from it each burst, so overshoot discards and
+            # preemption replays can't desync the grammar)
+            seq.guided_state = int(
+                seq.guided.next_state[seq.guided_state, token])
         seq.next_token = token
         seq.generated += 1
         finish = None

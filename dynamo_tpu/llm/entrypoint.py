@@ -210,6 +210,31 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
             max_pages_per_seq=cfg.max_pages_per_seq)
         draft_params = None if random_init \
             else load_llama_params(dpath, draft_cfg)
+    # guided decoding needs the serving tokenizer's id→bytes map; pass a
+    # LAZY provider — the O(vocab) build only runs if a guided request
+    # ever arrives, keeping worker startup unchanged
+    token_bytes = None
+    eos_id = 0
+    try:
+        from dynamo_tpu.llm.guided import token_bytes_of
+        from dynamo_tpu.llm.tokenizer import make_tokenizer
+
+        has_tok_files = any(
+            os.path.exists(os.path.join(path, f)) for f in
+            ("tokenizer.json", "tokenizer_config.json", "tokenizer.model"))
+        tok = make_tokenizer("hf" if has_tok_files else "byte",
+                             path if has_tok_files else "")
+        vocab = cfg.vocab_size
+
+        def token_bytes(tok=tok, vocab=vocab):
+            return token_bytes_of(tok, vocab)
+
+        eos_id = tok.eos_token_id() or 0
+    except Exception as e:  # pragma: no cover - degraded, not fatal
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "guided decoding disabled (tokenizer unavailable: %s)", e)
     engine = TpuEngine(
         TpuEngineConfig(model=cfg, num_pages=num_pages,
                         max_batch_size=max_batch_size,
@@ -221,7 +246,8 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                         sp_mesh=sp_mesh,
                         sp_threshold=sp_threshold if sp_mesh else 0,
                         sp_layout=sp_layout),
-        params=params, draft_params=draft_params)
+        params=params, draft_params=draft_params,
+        token_bytes=token_bytes, eos_token_id=eos_id)
     if kvbm_host_blocks:
         from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
 

@@ -44,6 +44,34 @@ def _require(cond: bool, msg: str) -> None:
         raise OpenAIError(msg)
 
 
+def _guided_from(d: dict, nvext: dict) -> Optional[dict]:
+    """Map OpenAI `response_format` + nvext guided_* onto the engine's
+    guided spec ({"regex"|"choice"|"json": ...}); at most one source."""
+    rf = d.get("response_format") or {}
+    rf_type = rf.get("type") if isinstance(rf, dict) else None
+    candidates = []
+    if rf_type == "json_object":
+        candidates.append({"json": True})
+    elif rf_type == "json_schema":
+        js = rf.get("json_schema")
+        _require(js is None or isinstance(js, dict),
+                 "'response_format.json_schema' must be an object")
+        schema = (js or {}).get("schema", js)
+        candidates.append({"json": schema or True})
+    for src in (d, nvext):
+        if src.get("guided_json") is not None:
+            candidates.append({"json": src["guided_json"]})
+        if src.get("guided_regex") is not None:
+            candidates.append({"regex": src["guided_regex"]})
+        if src.get("guided_choice") is not None:
+            candidates.append({"choice": list(src["guided_choice"])})
+    if not candidates:
+        return None
+    _require(len(candidates) == 1,
+             "at most one guided-decoding option may be set")
+    return candidates[0]
+
+
 @dataclass
 class ChatCompletionRequest:
     model: str
@@ -62,6 +90,10 @@ class ChatCompletionRequest:
     min_tokens: Optional[int] = None
     logprobs: bool = False
     n: int = 1
+    # Guided decoding (reference GuidedDecodingOptions / common_ext.rs):
+    # from `response_format` (json_object / json_schema) or nvext
+    # guided_json / guided_regex / guided_choice
+    guided: Optional[dict] = None
     raw: dict = field(default_factory=dict)
 
     @classmethod
@@ -94,6 +126,7 @@ class ChatCompletionRequest:
                                   nvext.get("ignore_eos", False))),
             min_tokens=d.get("min_tokens"),
             logprobs=bool(d.get("logprobs")), n=int(d.get("n", 1)),
+            guided=_guided_from(d, nvext),
             raw=d,
         )
 
@@ -113,6 +146,8 @@ class ChatCompletionRequest:
             s.presence_penalty = float(self.presence_penalty)
         if self.seed is not None:
             s.seed = int(self.seed)
+        if self.guided is not None:
+            s.guided = self.guided
         return s
 
     def stop_conditions(self) -> StopConditions:
@@ -141,6 +176,7 @@ class CompletionRequest:
     echo: bool = False
     logprobs: Optional[int] = None       # OpenAI: int top-k (we emit chosen)
     n: int = 1
+    guided: Optional[dict] = None
     raw: dict = field(default_factory=dict)
 
     @classmethod
@@ -171,7 +207,8 @@ class CompletionRequest:
             min_tokens=d.get("min_tokens"),
             echo=bool(d.get("echo")),
             logprobs=d.get("logprobs"),
-            n=int(d.get("n", 1)), raw=d,
+            n=int(d.get("n", 1)),
+            guided=_guided_from(d, nvext), raw=d,
         )
 
     sampling_options = ChatCompletionRequest.sampling_options

@@ -417,6 +417,66 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
     return out, k_cache, v_cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "num_steps"),
+         donate_argnums=(1, 2))
+def decode_multi_step_guided(params: dict, k_cache, v_cache,
+                             tokens: jax.Array, positions: jax.Array,
+                             page_tables: jax.Array, valid: jax.Array,
+                             seeds: jax.Array, steps0: jax.Array,
+                             temperature: jax.Array, top_p: jax.Array,
+                             top_k: jax.Array, g_bits: jax.Array,
+                             g_next: jax.Array, g_eos_ok: jax.Array,
+                             g_ids: jax.Array, g_states: jax.Array,
+                             stop_ids: jax.Array, cfg: LlamaConfig,
+                             num_steps: int):
+    """`decode_multi_step` with per-lane grammar constraints enforced ON
+    DEVICE, so guided lanes keep the fused one-sync-per-burst contract.
+
+    g_bits: (G, S, ceil(V/8)) uint8 packed allowed-token masks;
+    g_next: (G, S, V) int16 DFA transition; g_eos_ok: (G, S) bool —
+    where the lane's STOP tokens become legal (grammar satisfied, or a
+    dead end that must terminate); g_ids/g_states: (B,) lane grammar
+    slot + current DFA state (slot 0 is the trivial all-allowed grammar
+    for unguided lanes); stop_ids: (B, K) the lane's stop token ids
+    (-1 padding). Disallowed tokens' logits are pushed to -1e30 BEFORE
+    sampling (greedy and stochastic), and each sampled token advances
+    its lane's DFA state for the next iteration (llm/guided.py builds
+    the tables; the engine recomputes authoritative states host-side
+    from the emitted tokens)."""
+    from dynamo_tpu.engine.sampling import (
+        chosen_logprob,
+        sample_tokens_traced,
+    )
+
+    V = cfg.vocab_size
+    byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
+    bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
+    is_stop = (jnp.arange(V, dtype=jnp.int32)[None, None, :]
+               == stop_ids[:, :, None]).any(axis=1)       # (B, V)
+
+    def body(i, carry):
+        toks, st, kc, vc, out = carry
+        logits, kc, vc = _decode_once(
+            params, kc, vc, toks, positions + i, page_tables, valid, cfg)
+        rows = g_bits[g_ids, st]                       # (B, ceil(V/8))
+        allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
+        allow = (allowed > 0) | (g_eos_ok[g_ids, st][:, None] & is_stop)
+        logits = jnp.where(allow, logits, -1e30)
+        sampled = sample_tokens_traced(
+            logits, seeds, steps0 + i, temperature, top_p, top_k)
+        chosen = chosen_logprob(logits, sampled)
+        st = g_next[g_ids, st, sampled].astype(jnp.int32)
+        out = out.at[0, i].set(sampled.astype(jnp.float32))
+        out = out.at[1, i].set(chosen)
+        return sampled, st, kc, vc, out
+
+    out0 = jnp.zeros((2, num_steps, tokens.shape[0]), dtype=jnp.float32)
+    _, _, k_cache, v_cache, out = lax.fori_loop(
+        0, num_steps, body,
+        (tokens, g_states.astype(jnp.int32), k_cache, v_cache, out0))
+    return out, k_cache, v_cache
+
+
 def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
                     mask: jax.Array, cfg: "LlamaConfig") -> jax.Array:
     """One layer's attention sub-block over a dense (unpaged) sequence:

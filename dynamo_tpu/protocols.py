@@ -167,6 +167,10 @@ class SamplingOptions:
     presence_penalty: float = 0.0
     repetition_penalty: float = 1.0
     seed: Optional[int] = None
+    # Guided decoding (reference GuidedDecodingOptions, common.rs:336):
+    # one of {"regex": str} / {"choice": [str]} / {"json": true|schema}.
+    # Enforced natively by the TPU engine (llm/guided.py DFA tables).
+    guided: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return asdict(self)

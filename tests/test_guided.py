@@ -1,0 +1,211 @@
+"""Guided decoding: grammar compiler + engine enforcement.
+
+Engine tests run the tiny model with RANDOM weights and a byte
+tokenizer (token id == byte): masked sampling must force grammatical
+output regardless of what the model 'wants' — the strongest possible
+enforcement check.
+"""
+
+import json
+
+import numpy as np
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.llm.guided import (
+    GrammarError,
+    choice_regex,
+    compile_guided,
+    compile_regex,
+    json_regex,
+    json_schema_regex,
+    match_bytes,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+CFG = LlamaConfig.tiny()                    # vocab 256
+TOKEN_BYTES = [bytes([i]) for i in range(256)]
+EOS = 0
+
+
+# -- compiler ---------------------------------------------------------------
+
+
+def test_regex_compile_and_match():
+    dfa = compile_regex(r"(abc|a\d+)x?")
+    for s, want in [("abc", True), ("a123", True), ("a123x", True),
+                    ("ab", False), ("", False), ("zzz", False)]:
+        assert match_bytes(dfa, s.encode()) == want, s
+
+
+def test_charclass_and_escapes():
+    dfa = compile_regex(r"[a-c]+\s[^0-9]")
+    assert match_bytes(dfa, b"abc x")
+    assert not match_bytes(dfa, b"abc 9")
+    assert not match_bytes(dfa, b"d x")
+
+
+def test_choice_regex_escapes_metachars():
+    dfa = compile_regex(choice_regex(["a+b", "c.d"]))
+    assert match_bytes(dfa, b"a+b") and match_bytes(dfa, b"c.d")
+    assert not match_bytes(dfa, b"aab") and not match_bytes(dfa, b"cxd")
+
+
+def test_json_grammar():
+    dfa = compile_regex(json_regex(3))
+    good = ['{"a": 1, "b": [true, null]}', '[1, 2.5, -3e+4, "s"]',
+            ' "hi"', "42", '{"x": {"y": ["z"]}}']
+    # no trailing whitespace (acceptance must force EOS, not pad) and
+    # no leading zeros (not JSON)
+    bad = ['{"a": }', "{", "tru", '"unterminated', '"hi" ', "007"]
+    for s in good:
+        assert match_bytes(dfa, s.encode()), s
+    for s in bad:
+        assert not match_bytes(dfa, s.encode()), s
+
+
+def test_json_schema_grammar():
+    dfa = compile_regex(json_schema_regex(
+        {"type": "object", "properties": {
+            "name": {"type": "string"},
+            "ok": {"type": "boolean"}}}))
+    assert match_bytes(dfa, b'{"name": "x", "ok": true}')
+    assert not match_bytes(dfa, b'{"ok": true}')
+
+
+def test_minimization_shrinks_json():
+    # pre-minimization depth-3 JSON was ~2.8k states
+    assert compile_regex(json_regex(3)).next.shape[0] < 600
+
+
+def test_bad_grammar_raises():
+    import pytest
+
+    with pytest.raises(GrammarError):
+        compile_regex("(unclosed")
+    with pytest.raises(GrammarError):
+        compile_guided({"nope": 1}, TOKEN_BYTES)
+
+
+# -- engine enforcement -----------------------------------------------------
+
+
+def make_engine(**kw):
+    defaults = dict(model=CFG, num_pages=64, max_batch_size=2,
+                    default_max_tokens=16, decode_steps_per_sync=4)
+    defaults.update(kw)
+    return TpuEngine(TpuEngineConfig(**defaults),
+                     token_bytes=TOKEN_BYTES, eos_token_id=EOS)
+
+
+async def run(eng, guided, prompt=(10, 20, 30), max_tokens=16,
+              temperature=0.0, seed=None):
+    sampling = {"temperature": temperature, "guided": guided}
+    if seed is not None:
+        sampling["seed"] = seed
+    req = {"token_ids": list(prompt), "model": "m",
+           "sampling": sampling,
+           "stop": {"max_tokens": max_tokens, "stop_token_ids": [EOS]}}
+    toks, finish = [], None
+    async for o in eng.generate(req, Context()):
+        toks += o.get("token_ids", [])
+        finish = o.get("finish_reason") or finish
+    return toks, finish
+
+
+def text_of(tokens):
+    body = tokens[:-1] if tokens and tokens[-1] == EOS else tokens
+    return bytes(body)
+
+
+async def test_choice_forces_exact_output():
+    eng = make_engine()
+    try:
+        toks, finish = await run(eng, {"choice": ["hi", "hey"]})
+        assert finish == "stop"
+        assert text_of(toks).decode() in ("hi", "hey")
+    finally:
+        await eng.close()
+
+
+async def test_regex_forced_across_fused_bursts():
+    eng = make_engine()
+    try:
+        # (ab)+ spans many 4-step bursts; every token must obey the DFA
+        toks, finish = await run(eng, {"regex": "(ab)+"}, max_tokens=12)
+        txt = text_of(toks).decode()
+        assert set(txt) <= {"a", "b"}
+        assert txt == "ab" * (len(txt) // 2) or finish == "length"
+        dfa = compile_regex("(ab)+")
+        s = 0
+        for b in text_of(toks):
+            s = int(dfa.next[s, b])
+            assert s != -1          # never left the grammar
+    finally:
+        await eng.close()
+
+
+async def test_json_mode_stays_inside_grammar():
+    eng = make_engine()
+    try:
+        toks, finish = await run(eng, {"json": True}, max_tokens=40)
+        dfa = compile_regex(json_regex())
+        s = 0
+        for b in text_of(toks):
+            s = int(dfa.next[s, b])
+            assert s != -1, text_of(toks)
+        if finish == "stop":        # completed → must parse
+            json.loads(text_of(toks).decode())
+    finally:
+        await eng.close()
+
+
+async def test_stochastic_guided_stays_inside_grammar():
+    eng = make_engine()
+    try:
+        toks, _ = await run(eng, {"regex": "[abc]+"}, temperature=1.0,
+                            seed=7, max_tokens=10)
+        assert set(text_of(toks)) <= set(b"abc")
+    finally:
+        await eng.close()
+
+
+async def test_mixed_batch_guided_and_free():
+    import asyncio
+
+    eng = make_engine()
+    try:
+        (g_toks, _), (f_toks, _) = await asyncio.gather(
+            run(eng, {"choice": ["yes", "no"]}),
+            run(eng, None, prompt=(5, 6, 7), max_tokens=8))
+        assert text_of(g_toks).decode() in ("yes", "no")
+        assert len(f_toks) == 8     # free lane unaffected
+    finally:
+        await eng.close()
+
+
+async def test_guided_without_vocab_errors_cleanly():
+    eng = TpuEngine(TpuEngineConfig(model=CFG, num_pages=32))
+    try:
+        req = {"token_ids": [1, 2], "model": "m",
+               "sampling": {"guided": {"json": True}},
+               "stop": {"max_tokens": 4}}
+        outs = [o async for o in eng.generate(req, Context())]
+        assert outs[-1]["finish_reason"] == "error"
+        assert "guided" in outs[-1]["extra"]["error"]
+    finally:
+        await eng.close()
+
+
+async def test_guided_deterministic_and_cached():
+    eng = make_engine()
+    try:
+        a, _ = await run(eng, {"choice": ["left", "right"]})
+        b, _ = await run(eng, {"choice": ["left", "right"]})
+        assert a == b
+        assert len(eng._guided_tables) == 1   # compiled once
+    finally:
+        await eng.close()

@@ -146,7 +146,18 @@ class _Seq:
     draft_pos: int = 0                    # draft-cache-valid positions < this
     guided: Optional[Any] = None          # GuidedTables when constrained
     guided_state: int = 0                 # authoritative DFA state (host)
+    out_counter: dict = field(default_factory=dict)  # token -> emit count
     next_token: int = -1                  # sampled, KV not yet written
+
+    @property
+    def needs_constrained(self) -> bool:
+        """True when this lane needs the constrained decode burst
+        (grammar mask, min_p, or any sampling penalty)."""
+        sp = self.req.sampling
+        return (self.guided is not None or sp.min_p > 0.0
+                or sp.repetition_penalty != 1.0
+                or sp.frequency_penalty != 0.0
+                or sp.presence_penalty != 0.0)
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
     finished: bool = False
@@ -325,6 +336,13 @@ class TpuEngine:
             return
         guided_tables = None
         if req.sampling.guided:
+            if len(req.stop.stop_token_ids or []) > self.GUIDED_STOP_WIDTH:
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": f"guided decoding supports at most "
+                                    f"{self.GUIDED_STOP_WIDTH} stop "
+                                    f"token ids"}).to_dict()
+                return
             try:
                 guided_tables = await self._compile_guided(
                     req.sampling.guided, req)
@@ -601,6 +619,30 @@ class TpuEngine:
                     if s.guided is not None:
                         ok = self._guided_allowed_row(s.guided, s, V)
                         guided_mask[i, ~ok] = -1e30
+            penalty_args = None
+            if any(s.req.sampling.repetition_penalty != 1.0
+                   or s.req.sampling.frequency_penalty != 0.0
+                   or s.req.sampling.presence_penalty != 0.0
+                   for s in pending):
+                # the FIRST sampled token must see the same penalties as
+                # every decode-burst token (vLLM semantics: repetition
+                # covers prompt tokens)
+                V = mcfg.vocab_size
+                pc = np.zeros((width, V), dtype=np.int32)
+                oc = np.zeros((width, V), dtype=np.int32)
+                for i, s in enumerate(pending):
+                    sp_ = s.req.sampling
+                    if (sp_.repetition_penalty != 1.0
+                            or sp_.frequency_penalty != 0.0
+                            or sp_.presence_penalty != 0.0):
+                        ids, cnts = np.unique(
+                            np.asarray(s.prompt, dtype=np.int64) % V,
+                            return_counts=True)
+                        pc[i, ids] = cnts
+                        for t, c in s.out_counter.items():
+                            if 0 <= t < V:
+                                oc[i, t] = c
+                penalty_args = (pc, oc)
 
             def arr(fn, dtype):
                 vals = [fn(s) for s in pending]
@@ -608,6 +650,19 @@ class TpuEngine:
                 return np.asarray(vals, dtype=dtype)
 
             logits_stack = jax.numpy.stack(stack)
+            if penalty_args is not None:
+                from dynamo_tpu.engine.sampling import apply_penalties
+
+                pc, oc = penalty_args
+                logits_stack = apply_penalties(
+                    logits_stack, jax.numpy.asarray(pc),
+                    jax.numpy.asarray(oc),
+                    arr(lambda s: s.req.sampling.repetition_penalty,
+                        np.float32),
+                    arr(lambda s: s.req.sampling.frequency_penalty,
+                        np.float32),
+                    arr(lambda s: s.req.sampling.presence_penalty,
+                        np.float32))
             if guided_mask is not None:
                 logits_stack = logits_stack + jax.numpy.asarray(
                     guided_mask)
@@ -617,7 +672,8 @@ class TpuEngine:
                 arr(lambda s: s.generated, np.uint32),
                 arr(lambda s: s.req.sampling.temperature, np.float32),
                 arr(lambda s: s.req.sampling.top_p, np.float32),
-                arr(lambda s: s.req.sampling.top_k, np.int32))
+                arr(lambda s: s.req.sampling.top_k, np.int32),
+                arr(lambda s: s.req.sampling.min_p, np.float32))
             return np.asarray(sampled)                    # ONE host sync
 
         async with self._device_lock:
@@ -656,7 +712,7 @@ class TpuEngine:
         # must never ride a spec burst
         use_spec = self.draft_params is not None and all(
             s.req.sampling.top_p >= 1.0 and s.req.sampling.top_k == 0
-            and s.guided is None
+            and not s.needs_constrained
             for s in runnable)
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
@@ -759,23 +815,45 @@ class TpuEngine:
                 s.draft_pos = s.pos
             return True
 
-        use_guided = any(s.guided is not None for s in batch)
-        if use_guided:
+        use_constrained = any(s.needs_constrained for s in batch)
+        if use_constrained:
             from dynamo_tpu.models.llama import decode_multi_step_guided
 
+            V = mcfg.vocab_size
             g_bits, g_next, g_eos_ok = self._guided_device_stack()
             g_ids = np.zeros(b, dtype=np.int32)
             g_states = np.zeros(b, dtype=np.int32)
             stop_ids = np.full((b, self.GUIDED_STOP_WIDTH), -1,
                                dtype=np.int32)
+            min_ps = np.zeros(b, dtype=np.float32)
+            rep_pens = np.ones(b, dtype=np.float32)
+            freq_pens = np.zeros(b, dtype=np.float32)
+            pres_pens = np.zeros(b, dtype=np.float32)
+            prompt_counts = np.zeros((b, V), dtype=np.int32)
+            out_counts = np.zeros((b, V), dtype=np.int32)
             for i, s in enumerate(batch):
                 g_ids[i] = self._guided_slot_of(s)
                 g_states[i] = s.guided_state
                 for j, t in enumerate(self._guided_stop_ids(s)):
                     stop_ids[i, j] = t
+                sp = s.req.sampling
+                min_ps[i] = sp.min_p
+                rep_pens[i] = sp.repetition_penalty
+                freq_pens[i] = sp.frequency_penalty
+                pres_pens[i] = sp.presence_penalty
+                if (sp.repetition_penalty != 1.0
+                        or sp.frequency_penalty != 0.0
+                        or sp.presence_penalty != 0.0):
+                    ids, cnts = np.unique(
+                        np.asarray(s.prompt, dtype=np.int64) % V,
+                        return_counts=True)
+                    prompt_counts[i, ids] = cnts
+                    for t, c in s.out_counter.items():
+                        if 0 <= t < V:
+                            out_counts[i, t] = c
 
         def run_burst():
-            if use_guided:
+            if use_constrained:
                 sampled, kc, vc = decode_multi_step_guided(
                     self.params, self.k_cache, self.v_cache,
                     jax.numpy.asarray(tokens),
@@ -784,6 +862,12 @@ class TpuEngine:
                     jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
                     jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
+                    jax.numpy.asarray(min_ps),
+                    jax.numpy.asarray(rep_pens),
+                    jax.numpy.asarray(freq_pens),
+                    jax.numpy.asarray(pres_pens),
+                    jax.numpy.asarray(prompt_counts),
+                    jax.numpy.asarray(out_counts),
                     g_bits, g_next, g_eos_ok, jax.numpy.asarray(g_ids),
                     jax.numpy.asarray(g_states),
                     jax.numpy.asarray(stop_ids), mcfg, k_steps)
@@ -928,7 +1012,11 @@ class TpuEngine:
     # -- guided decoding ----------------------------------------------------
 
     MAX_GUIDED_GRAMMARS = 32
-    GUIDED_STOP_WIDTH = 4
+    GUIDED_STOP_WIDTH = 8
+    # ceiling on the stacked (G, S, V) device tables — a handful of big
+    # JSON-schema grammars on a 128k vocab must fail the REQUEST, not
+    # OOM the chip mid-serving
+    GUIDED_TABLE_MAX_BYTES = 1 << 30
 
     async def _compile_guided(self, spec: dict, req) -> Any:
         """Compile (or fetch cached) DFA tables for a guided spec. The
@@ -959,15 +1047,35 @@ class TpuEngine:
         # the race while we were in the thread — double-assigning the
         # slot would alias a later grammar onto it
         if key not in self._guided_tables:
-            if len(self._guided_tables) >= self.MAX_GUIDED_GRAMMARS:
+            if (len(self._guided_tables) >= self.MAX_GUIDED_GRAMMARS
+                    or self._guided_stack_bytes(tables)
+                    > self.GUIDED_TABLE_MAX_BYTES):
                 self._evict_guided_unused()
             if len(self._guided_tables) >= self.MAX_GUIDED_GRAMMARS:
                 raise ValueError(
                     "too many distinct guided grammars in flight")
+            if self._guided_stack_bytes(tables) \
+                    > self.GUIDED_TABLE_MAX_BYTES:
+                raise ValueError(
+                    f"guided grammar tables would exceed "
+                    f"{self.GUIDED_TABLE_MAX_BYTES >> 20} MiB on device")
             self._guided_tables[key] = tables
             self._guided_slots[key] = len(self._guided_slots) + 1
             self._guided_stack = None      # restack with the new grammar
         return self._guided_tables[key]
+
+    def _guided_stack_bytes(self, extra=None) -> int:
+        """Projected device bytes of the stacked tables if `extra` joins
+        the cache (pow2 padding on both axes included)."""
+        V = self.model_cfg.vocab_size
+        all_tables = list(self._guided_tables.values())
+        if extra is not None:
+            all_tables.append(extra)
+        s_max = max([t.num_states for t in all_tables] or [1])
+        s_pad = _next_pow2(s_max, 1, 1 << 15)
+        g_pad = _next_pow2(len(all_tables) + 1, 1,
+                           2 * self.MAX_GUIDED_GRAMMARS)
+        return g_pad * s_pad * (2 * V + (V + 7) // 8 + 1)
 
     def _evict_guided_unused(self) -> None:
         """Drop cached grammars no active sequence references, and
@@ -1066,6 +1174,7 @@ class TpuEngine:
             # preemption replays can't desync the grammar)
             seq.guided_state = int(
                 seq.guided.next_state[seq.guided_state, token])
+        seq.out_counter[token] = seq.out_counter.get(token, 0) + 1
         seq.next_token = token
         seq.generated += 1
         finish = None

@@ -14,6 +14,8 @@ negligible probability for real models). Greedy uses a full argmax.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -24,10 +26,14 @@ MAX_CANDIDATES = 64
 
 def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
                          steps: jax.Array, temperature: jax.Array,
-                         top_p: jax.Array, top_k: jax.Array) -> jax.Array:
+                         top_p: jax.Array, top_k: jax.Array,
+                         min_p: Optional[jax.Array] = None) -> jax.Array:
     """logits: (B, V) fp32; seeds/steps: (B,) u32/i32; temperature/top_p:
-    (B,) f32; top_k: (B,) i32 (0 = disabled). temperature <= 0 ⇒ greedy.
-    Returns (B,) i32 tokens. Traceable (used inside fused decode loops)."""
+    (B,) f32; top_k: (B,) i32 (0 = disabled); min_p: (B,) f32 (0 =
+    disabled) — drops candidates whose probability is below
+    min_p × max-probability (after temperature). temperature <= 0 ⇒
+    greedy. Returns (B,) i32 tokens. Traceable (used inside fused decode
+    loops)."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -46,6 +52,10 @@ def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
     probs = jax.nn.softmax(masked / t[:, None], axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = (cum - probs) <= top_p[:, None]                 # always keeps [0]
+    if min_p is not None:
+        # candidates are sorted desc, so probs[:, :1] is the max; index 0
+        # always survives (p >= min_p * p for min_p <= 1)
+        keep &= probs >= jnp.clip(min_p, 0.0, 1.0)[:, None] * probs[:, :1]
     masked = jnp.where(keep, masked, _NEG_INF)
 
     def sample_one(seed, step, lg, tt):
@@ -61,6 +71,27 @@ def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
 sample_tokens = jax.jit(sample_tokens_traced)
 
 
+def apply_penalties(logits: jax.Array, prompt_counts: jax.Array,
+                    out_counts: jax.Array, repetition: jax.Array,
+                    frequency: jax.Array, presence: jax.Array
+                    ) -> jax.Array:
+    """OpenAI/HF sampling penalties, traceable (fused decode loops).
+
+    logits: (B, V) f32. prompt_counts/out_counts: (B, V) — token
+    occurrence counts in the prompt / generated output. Semantics match
+    vLLM: repetition_penalty (HF) applies to prompt+output tokens
+    (divide positive logits, multiply negative); frequency/presence
+    (OpenAI) apply to OUTPUT tokens only, additively."""
+    seen = (prompt_counts + out_counts) > 0
+    rep = repetition[:, None]
+    rep_adj = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen & (rep != 1.0), rep_adj, logits)
+    logits = logits - frequency[:, None] * out_counts.astype(logits.dtype)
+    logits = logits - presence[:, None] * (out_counts > 0).astype(
+        logits.dtype)
+    return logits
+
+
 def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
     """(B,) log-probability of each row's sampled token (traceable) —
     the ONE definition both prefill sampling and the fused decode loop
@@ -70,12 +101,12 @@ def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
 
 
 def _sample_tokens_lp_traced(logits, seeds, steps, temperature, top_p,
-                             top_k):
+                             top_k, min_p=None):
     """sample_tokens + chosen-token logprob, PACKED (2, B) f32 (token ids
     exact in f32; one host transfer instead of two — the tunnel charges
     per sync, not per byte)."""
     sampled = sample_tokens_traced(logits, seeds, steps, temperature,
-                                   top_p, top_k)
+                                   top_p, top_k, min_p)
     return jnp.stack([sampled.astype(jnp.float32),
                       chosen_logprob(logits, sampled)])
 

@@ -119,13 +119,14 @@ class _RegexParser:
         return _Frag(frags[0].start, frags[-1].outs)
 
     def _rep(self) -> _Frag:
-        atom = self._atom
-        f = atom()
+        a0 = self.i
+        f = self._atom()
+        a1 = self.i
         while self.i < len(self.p) and self.p[self.i] in "*+?{":
             c = self.p[self.i]
             if c == "{":
                 m, n = self._bounds()
-                f = self._repeat(f, m, n)
+                f = self._repeat(self.p[a0:a1], m, n)
                 continue
             self.i += 1
             if c == "*":
@@ -146,24 +147,61 @@ class _RegexParser:
                 f = _Frag(s, f.outs + [s])
         return f
 
-    def _bounds(self) -> tuple[int, int]:
-        j = self.p.index("}", self.i)
+    def _bounds(self) -> tuple[int, Optional[int]]:
+        """{m}, {m,}, {m,n}. Returns (m, n) with n=None for open."""
+        j = self.p.find("}", self.i)
+        if j < 0:
+            raise GrammarError("unclosed {m,n} bounds")
         body = self.p[self.i + 1:j]
         self.i = j + 1
-        if "," in body:
-            lo, hi = body.split(",", 1)
-            return int(lo or 0), int(hi) if hi else int(lo or 0) + 16
-        return int(body), int(body)
+        try:
+            if "," in body:
+                lo, hi = body.split(",", 1)
+                return int(lo or 0), (int(hi) if hi.strip() else None)
+            return int(body), int(body)
+        except ValueError:
+            raise GrammarError(f"bad repetition bounds {{{body}}}")
 
-    def _repeat(self, f: _Frag, m: int, n: int) -> _Frag:
-        if n < m or n == 0:
+    def _clone(self, src: str) -> _Frag:
+        """Re-parse an atom's source span into a fresh fragment (NFA
+        fragments are single-use, so {m,n} expansion re-parses)."""
+        save_p, save_i = self.p, self.i
+        self.p, self.i = src, 0
+        try:
+            f = self._alt()
+            if self.i != len(src):
+                raise GrammarError(f"bad atom {src!r}")
+            return f
+        finally:
+            self.p, self.i = save_p, save_i
+
+    def _repeat(self, src: str, m: int, n: Optional[int]) -> _Frag:
+        if n is not None and (n < m or n == 0):
             raise GrammarError(f"bad repetition bounds {{{m},{n}}}")
-        # expand by re-parsing is impossible (fragment already built), so
-        # clone via snapshotting is overkill — require the repeated atom
-        # pattern and rebuild. Simpler: capture the atom's source span.
-        raise GrammarError(
-            "{m,n} repetition is supported only via expansion; "
-            "use explicit alternation or * / + / ?")
+        if (n or m) > 256:
+            raise GrammarError("repetition bound too large (max 256)")
+        frags = [self._clone(src) for _ in range(m)]
+        if n is None:
+            # {m,} = m copies + one starred copy
+            star_body = self._clone(src)
+            s = self.nfa.new_state()
+            self.nfa.eps[s].append(star_body.start)
+            for o in star_body.outs:
+                self.nfa.eps[o].append(s)
+            frags.append(_Frag(s, [s]))
+        else:
+            for _ in range(n - m):
+                opt = self._clone(src)
+                s = self.nfa.new_state()
+                self.nfa.eps[s].append(opt.start)
+                frags.append(_Frag(s, opt.outs + [s]))
+        if not frags:       # {0} / {0,0} degenerate: empty match
+            s = self.nfa.new_state()
+            return _Frag(s, [s])
+        for a, b in zip(frags, frags[1:]):
+            for o in a.outs:
+                self.nfa.eps[o].append(b.start)
+        return _Frag(frags[0].start, frags[-1].outs)
 
     def _atom(self) -> _Frag:
         if self.i >= len(self.p):
@@ -182,6 +220,8 @@ class _RegexParser:
             self.i += 1
             return self._byte_frag(frozenset(range(256)) - {10, 13})
         if c == "\\":
+            if self.i + 1 >= len(self.p):
+                raise GrammarError("dangling backslash at end of regex")
             self.i += 2
             return self._byte_frag(_escape(self.p[self.i - 1]))
         if c in "*+?{":
@@ -197,6 +237,8 @@ class _RegexParser:
         chars: set[int] = set()
         while j < len(self.p) and self.p[j] != "]":
             if self.p[j] == "\\":
+                if j + 1 >= len(self.p):
+                    raise GrammarError("dangling backslash in class")
                 chars |= _escape(self.p[j + 1])
                 j += 2
                 continue
@@ -511,13 +553,34 @@ def token_tables(dfa: ByteDfa,
                         eos_ok=eos_ok, accepting=dfa.accepting.copy())
 
 
+def _gpt2_char_to_byte() -> dict[str, int]:
+    """The standard byte-level-BPE printable remap (GPT-2/Llama-3 vocabs
+    store raw bytes as mapped unicode chars, e.g. space → 'Ġ'),
+    inverted: char → original byte."""
+    bs = (list(range(33, 127)) + list(range(161, 173))
+          + list(range(174, 256)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for c, b in zip(cs, bs)}
+
+
 def token_bytes_of(tokenizer, vocab_size: int) -> list[Optional[bytes]]:
     """Per-token-id output bytes for a serving tokenizer.
 
-    Exact for ByteTokenizer (id == byte). For HF tokenizers the mapping
-    handles the common vocab encodings: sentencepiece's ``▁`` word
-    boundary, byte-fallback ``<0xAB>`` tokens, and GPT-2-style byte-level
-    BPE (via the tokenizer's own single-token decode as fallback).
+    Exact for ByteTokenizer (id == byte). For HF tokenizers:
+    - byte-level BPE vocabs (GPT-2/Llama-3 style, detected by 'Ġ'
+      tokens) decode EXACTLY via the inverse printable remap — tokens
+      carrying partial UTF-8 sequences keep their raw bytes (a decode()
+      fallback would smear them into U+FFFD and desync the DFA from the
+      actual output stream);
+    - sentencepiece vocabs map '▁'→space and '<0xAB>' byte-fallback
+      tokens to their byte; other tokens are valid unicode and encode
+      directly.
     Special tokens map to None (never emitted under guidance)."""
     from dynamo_tpu.llm.tokenizer import ByteTokenizer
 
@@ -530,23 +593,26 @@ def token_bytes_of(tokenizer, vocab_size: int) -> list[Optional[bytes]]:
         raise GrammarError(
             f"guided decoding unsupported for {type(tokenizer).__name__}")
     specials = set(hf.all_special_ids or [])
+    toks = [hf.convert_ids_to_tokens(i) for i in range(vocab_size)]
+    inv = _gpt2_char_to_byte()
+    byte_level = any(isinstance(t, str) and ("Ġ" in t or "Ċ" in t)
+                     for t in toks if t)
     out = []
-    for i in range(vocab_size):
-        if i in specials:
+    for i, t in enumerate(toks):
+        if i in specials or t is None or not isinstance(t, str):
             out.append(None)
             continue
-        t = hf.convert_ids_to_tokens(i)
-        if t is None:
-            out.append(None)
-        elif isinstance(t, str) and t.startswith("<0x") and \
-                t.endswith(">") and len(t) == 6:
+        if t.startswith("<0x") and t.endswith(">") and len(t) == 6:
             out.append(bytes([int(t[3:5], 16)]))      # byte fallback
-        elif isinstance(t, str) and "▁" in t:     # sentencepiece ▁
+        elif byte_level:
+            try:
+                out.append(bytes(inv[c] for c in t))
+            except KeyError:
+                out.append(None)    # added token outside the byte map
+        elif "▁" in t:                             # sentencepiece ▁
             out.append(t.replace("▁", " ").encode())
         else:
-            out.append(hf.decode([i],
-                                 clean_up_tokenization_spaces=False)
-                       .encode())
+            out.append(t.encode())
     return out
 
 

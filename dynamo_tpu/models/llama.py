@@ -424,13 +424,25 @@ def decode_multi_step_guided(params: dict, k_cache, v_cache,
                              page_tables: jax.Array, valid: jax.Array,
                              seeds: jax.Array, steps0: jax.Array,
                              temperature: jax.Array, top_p: jax.Array,
-                             top_k: jax.Array, g_bits: jax.Array,
+                             top_k: jax.Array, min_p: jax.Array,
+                             rep_pen: jax.Array, freq_pen: jax.Array,
+                             pres_pen: jax.Array,
+                             prompt_counts: jax.Array,
+                             out_counts: jax.Array, g_bits: jax.Array,
                              g_next: jax.Array, g_eos_ok: jax.Array,
                              g_ids: jax.Array, g_states: jax.Array,
                              stop_ids: jax.Array, cfg: LlamaConfig,
                              num_steps: int):
-    """`decode_multi_step` with per-lane grammar constraints enforced ON
-    DEVICE, so guided lanes keep the fused one-sync-per-burst contract.
+    """The CONSTRAINED decode burst: `decode_multi_step` plus everything
+    the plain hot path doesn't pay for — grammar masks, min_p, and the
+    OpenAI/HF sampling penalties — enforced ON DEVICE so constrained
+    lanes keep the fused one-sync-per-burst contract. The engine routes
+    a batch here when ANY lane needs any of it (slot 0 is the trivial
+    all-allowed grammar, penalty values of 1/0 are no-ops).
+
+    min_p/rep_pen/freq_pen/pres_pen: (B,); prompt_counts/out_counts:
+    (B, V) token histograms (out_counts advances on device as tokens
+    sample, so within-burst repeats are penalized too).
 
     g_bits: (G, S, ceil(V/8)) uint8 packed allowed-token masks;
     g_next: (G, S, V) int16 DFA transition; g_eos_ok: (G, S) bool —
@@ -444,36 +456,43 @@ def decode_multi_step_guided(params: dict, k_cache, v_cache,
     the tables; the engine recomputes authoritative states host-side
     from the emitted tokens)."""
     from dynamo_tpu.engine.sampling import (
+        apply_penalties,
         chosen_logprob,
         sample_tokens_traced,
     )
 
     V = cfg.vocab_size
+    B = tokens.shape[0]
     byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
     bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
     is_stop = (jnp.arange(V, dtype=jnp.int32)[None, None, :]
                == stop_ids[:, :, None]).any(axis=1)       # (B, V)
 
     def body(i, carry):
-        toks, st, kc, vc, out = carry
+        toks, st, counts, kc, vc, out = carry
         logits, kc, vc = _decode_once(
             params, kc, vc, toks, positions + i, page_tables, valid, cfg)
+        logits = apply_penalties(logits, prompt_counts, counts, rep_pen,
+                                 freq_pen, pres_pen)
         rows = g_bits[g_ids, st]                       # (B, ceil(V/8))
         allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
         allow = (allowed > 0) | (g_eos_ok[g_ids, st][:, None] & is_stop)
         logits = jnp.where(allow, logits, -1e30)
         sampled = sample_tokens_traced(
-            logits, seeds, steps0 + i, temperature, top_p, top_k)
+            logits, seeds, steps0 + i, temperature, top_p, top_k, min_p)
         chosen = chosen_logprob(logits, sampled)
         st = g_next[g_ids, st, sampled].astype(jnp.int32)
+        counts = counts.at[jnp.arange(B), sampled].add(
+            valid.astype(counts.dtype))
         out = out.at[0, i].set(sampled.astype(jnp.float32))
         out = out.at[1, i].set(chosen)
-        return sampled, st, kc, vc, out
+        return sampled, st, counts, kc, vc, out
 
     out0 = jnp.zeros((2, num_steps, tokens.shape[0]), dtype=jnp.float32)
-    _, _, k_cache, v_cache, out = lax.fori_loop(
+    _, _, _, k_cache, v_cache, out = lax.fori_loop(
         0, num_steps, body,
-        (tokens, g_states.astype(jnp.int32), k_cache, v_cache, out0))
+        (tokens, g_states.astype(jnp.int32), out_counts, k_cache,
+         v_cache, out0))
     return out, k_cache, v_cache
 
 

@@ -209,3 +209,50 @@ async def test_guided_deterministic_and_cached():
         assert len(eng._guided_tables) == 1   # compiled once
     finally:
         await eng.close()
+
+
+def test_bounded_repetition():
+    dfa = compile_regex(r"\d{4}-\d{2}-\d{2}")      # the classic date
+    assert match_bytes(dfa, b"2026-07-30")
+    assert not match_bytes(dfa, b"226-07-30")
+    assert not match_bytes(dfa, b"2026-7-30")
+    dfa = compile_regex(r"a{2,4}")
+    for s, want in [("a", False), ("aa", True), ("aaaa", True),
+                    ("aaaaa", False)]:
+        assert match_bytes(dfa, s.encode()) == want, s
+    dfa = compile_regex(r"(ab){2,}")
+    assert match_bytes(dfa, b"ababab") and not match_bytes(dfa, b"ab")
+
+
+def test_dangling_backslash_is_grammar_error():
+    import pytest
+
+    with pytest.raises(GrammarError):
+        compile_regex("abc\\")
+    with pytest.raises(GrammarError):
+        compile_regex("[ab\\")
+
+
+def test_byte_level_bpe_token_bytes():
+    # GPT-2/Llama-3 style byte-level vocab: 'Ġ' is space, partial UTF-8
+    # tokens keep their RAW bytes (decode() would smear them to U+FFFD)
+    from dynamo_tpu.llm.guided import _gpt2_char_to_byte, token_bytes_of
+
+    inv = _gpt2_char_to_byte()
+    assert inv["Ġ"] == 0x20 and inv["Ċ"] == 0x0A
+    byte_of = {v: k for k, v in inv.items()}
+
+    class FakeHf:
+        all_special_ids = [0]
+        _vocab = ["<s>", "Ġhello", byte_of[0xC3] + byte_of[0xA9]]
+
+        def convert_ids_to_tokens(self, i):
+            return self._vocab[i]
+
+    class FakeTok:
+        _tok = FakeHf()
+
+    tb = token_bytes_of(FakeTok(), 3)
+    assert tb[0] is None                   # special
+    assert tb[1] == b" hello"
+    assert tb[2] == b"\xc3\xa9"            # raw UTF-8 bytes preserved

@@ -81,12 +81,29 @@ async def run_engine_bench(cfg, quantize=QUANTIZE):
     for wave, base in ((2, 30), (4, 40), (8, 50), (BATCH, 60)):
         await asyncio.gather(*(one(base + i) for i in range(wave)))
 
+    # TTFT probe (unloaded, post-warmup): wall from submit to the first
+    # streamed token of a single request
+    async def ttft_ms(i):
+        req = {"token_ids": [(7 * i + j) % 31999 + 1 for j in range(ISL)],
+               "model": "bench", "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 4}}
+        t0 = time.perf_counter()
+        async for o in eng.generate(req, Context()):
+            if o.get("token_ids"):
+                return (time.perf_counter() - t0) * 1000.0
+            if o.get("finish_reason") == "error":
+                raise RuntimeError(f"ttft probe failed: {o}")
+        raise RuntimeError("ttft probe stream ended without tokens")
+
+    ttfts = [await ttft_ms(900 + k) for k in range(3)]
+    ttft = sorted(ttfts)[len(ttfts) // 2]
+
     t0 = time.perf_counter()
     counts = await asyncio.gather(*(one(i + 100) for i in range(N_REQS)))
     dt = time.perf_counter() - t0
     params = eng.params
     await eng.close()
-    return sum(counts) / dt, dt, params
+    return sum(counts) / dt, dt, params, ttft
 
 
 def run_device_loop(cfg, params):
@@ -176,7 +193,8 @@ def main():
     # broken round
     for attempt in (1, 2):
         try:
-            tok_s, wall, params = asyncio.run(run_engine_bench(cfg))
+            tok_s, wall, params, ttft_ms = asyncio.run(
+                run_engine_bench(cfg))
             break
         except Exception:
             if attempt == 2:
@@ -203,6 +221,7 @@ def main():
             100.0 * hbm / loop_step_s / 1e9 / V5E_HBM_GBPS, 1),
         "isl": ISL, "osl": OSL, "n_requests": N_REQS, "batch": BATCH,
         "quantize": QUANTIZE,
+        "ttft_ms_unloaded_p50": round(ttft_ms, 1),
         **kv_stats,
     }))
 

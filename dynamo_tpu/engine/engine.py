@@ -99,6 +99,14 @@ class TpuEngineConfig:
     # feeds it to the next step; the host syncs once per burst. Critical on
     # TPU where a device→host sync stalls the pipeline.
     decode_steps_per_sync: int = 8
+    # Double-buffer plain decode bursts: when the batch is full (no
+    # admission possible) burst N+1 is dispatched — its input tokens
+    # sliced ON DEVICE from burst N's packed output — before burst N's
+    # results are pulled to the host, hiding the device→host sync
+    # (~95 ms on a tunneled chip) behind the next burst's compute.
+    # Lanes that finish mid-pipeline have their overshoot discarded and
+    # their pages released only after the in-flight burst lands.
+    pipeline_bursts: bool = True
     # Optional jax.sharding.Mesh ("dp","tp" axes): params/cache are placed
     # with the megatron-pattern specs (engine/sharding.py) and every jitted
     # step runs SPMD over it. One engine = one rank's (sub)mesh; dp ranks
@@ -298,6 +306,13 @@ class TpuEngine:
         # (the pre-step arrays die mid-call), so concurrent readers
         # (kv_pull) must not touch k_cache/v_cache while a step runs.
         self._device_lock = asyncio.Lock()
+        # decode-burst pipeline state (config.pipeline_bursts): the
+        # in-flight burst awaiting its host sync, and — while one is in
+        # flight — a redirect for page releases (freeing pages a running
+        # burst still writes to would let _admit hand them to a new
+        # sequence and corrupt it)
+        self._inflight: Optional[dict] = None
+        self._defer_releases: Optional[list] = None
         # disagg: finished prefill-only sequences whose pages are pinned
         # until the decode worker pulls them (transfer_id -> (pages, len,
         # deadline)); reaped by the scheduler loop after transfer_ttl.
@@ -312,6 +327,8 @@ class TpuEngine:
         requests overflow max_pages_per_seq mid-decode."""
         cfg = self.config
         la = cfg.decode_steps_per_sync
+        if cfg.pipeline_bursts:
+            la = 2 * cfg.decode_steps_per_sync   # one burst in flight
         if cfg.draft_model is not None:
             la = max(la, cfg.spec_iters_per_sync * (cfg.spec_gamma + 1))
         return la
@@ -461,6 +478,7 @@ class TpuEngine:
         self._wake.set()
         if self._loop_task is not None:
             self._loop_task.cancel()
+        self._drain_inflight_sync()
         # unblock any generate() caller still awaiting its queue
         for s in self._running + self._waiting:
             s.queue.put_nowait(EngineOutput(
@@ -517,7 +535,23 @@ class TpuEngine:
                 logger.exception("engine scheduler iteration failed")
                 self._fail_all()
 
+    def _drain_inflight_sync(self) -> None:
+        """Tear down the decode-burst pipeline: BLOCK until the in-flight
+        burst's device writes land (releasing its lanes' pages earlier
+        would let a new sequence be corrupted by the still-running
+        burst), then free the deferred pages. Error/shutdown paths only."""
+        inf, self._inflight = self._inflight, None
+        if inf is None:
+            return
+        try:
+            np.asarray(inf["packed"])
+        except Exception:
+            pass  # the burst itself failed; nothing is writing anymore
+        for pages in inf["deferred"]:
+            self.pool.release_sequence(pages)
+
     def _fail_all(self) -> None:
+        self._drain_inflight_sync()
         for s in self._running + self._waiting:
             s.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=FINISH_ERROR,
@@ -697,6 +731,8 @@ class TpuEngine:
     # -- decode -------------------------------------------------------------
 
     async def _decode_iter(self) -> bool:
+        if self._inflight is not None:
+            return await self._pipeline_consume()
         runnable = [s for s in self._running if s.prefilled]
         if not runnable:
             return False
@@ -852,6 +888,33 @@ class TpuEngine:
                         if 0 <= t < V:
                             out_counts[i, t] = c
 
+        if cfg.pipeline_bursts and not use_constrained:
+            # plain fused burst, double-buffered: dispatch WITHOUT
+            # syncing, then consume (which may speculate the next burst
+            # before pulling this one's results). Dispatch runs in a
+            # thread: a first-call XLA trace/compile would otherwise
+            # freeze the event loop for seconds.
+            def dispatch():
+                return decode_multi_step(
+                    self.params, self.k_cache, self.v_cache,
+                    jax.numpy.asarray(tokens),
+                    jax.numpy.asarray(positions),
+                    jax.numpy.asarray(page_tables),
+                    jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                    jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    jax.numpy.asarray(top_ps),
+                    jax.numpy.asarray(top_ks), mcfg, k_steps)
+
+            async with self._device_lock:
+                packed_dev, self.k_cache, self.v_cache = \
+                    await asyncio.to_thread(dispatch)
+            self._inflight = {
+                "k": k_steps, "batch": batch, "packed": packed_dev,
+                "positions": positions, "valid": valid, "seeds": seeds,
+                "steps": steps, "temps": temps, "top_ps": top_ps,
+                "top_ks": top_ks, "deferred": []}
+            return await self._pipeline_consume()
+
         def run_burst():
             if use_constrained:
                 sampled, kc, vc = decode_multi_step_guided(
@@ -884,6 +947,16 @@ class TpuEngine:
         async with self._device_lock:
             packed, self.k_cache, self.v_cache = \
                 await asyncio.to_thread(run_burst)
+        self._emit_burst(batch, packed, k_steps)
+        return True
+
+    def _emit_burst(self, batch: list[_Seq], packed: np.ndarray,
+                    k_steps: int) -> None:
+        """Emit a consumed burst's tokens: packed (2, K, B) — ids f32 +
+        chosen logprobs. Overshoot past a lane's finish is discarded;
+        each consumed input token's block registration happens as its KV
+        becomes attributable (shared by the sync and pipelined paths so
+        their stop/overshoot semantics can never diverge)."""
         sampled = packed[0].astype(np.int32)     # (K, B)
         logprobs = packed[1]                     # (K, B)
         for i, s in enumerate(batch):
@@ -898,7 +971,6 @@ class TpuEngine:
                         block.local_hash, block.parent_seq_hash)
                 self._emit_token(s, int(sampled[k, i]),
                                  float(logprobs[k, i]))
-        return True
 
     def _sp_bulk_prefill(self, pending: list[_Seq],
                          offsets: dict[int, int]) -> None:
@@ -1164,6 +1236,90 @@ class TpuEngine:
         for s in lanes:
             s.draft_pos = s.pos
 
+    async def _pipeline_consume(self) -> bool:
+        """Land the in-flight decode burst: optionally dispatch the NEXT
+        burst first (inputs sliced on device from the in-flight packed
+        output — speculation is sound because the fused loop already
+        feeds sampled tokens forward on device; the host would compute
+        identical inputs), then sync, emit, and release pages deferred
+        from the previous generation."""
+        cfg, mcfg = self.config, self.model_cfg
+        inf = self._inflight
+        k = inf["k"]
+        batch = inf["batch"]
+        nxt = None
+        # speculate only when nothing can change the batch: slots full
+        # (no admission), every lane alive/uncancelled/plain, no draft
+        # engine (it would want a spec burst instead)
+        can_spec = (len(self._running) >= cfg.max_batch_size
+                    and self.draft_params is None
+                    and all(s in self._running and not s.ctx.is_cancelled()
+                            and not s.needs_constrained for s in batch)
+                    # every lane will hit max_tokens within the burst
+                    # being consumed ⇒ the speculative burst would be
+                    # 100% overshoot AND the next wave's prefill would
+                    # queue behind its wasted device time
+                    and any(s.max_tokens - s.generated > k
+                            for s in batch))
+        if can_spec:
+            ok = True
+            for s in batch:
+                need = (s.pos + 2 * k - 1) // mcfg.page_size + 1
+                if need > mcfg.max_pages_per_seq:
+                    ok = False
+                    break
+                while len(s.pages) < need:
+                    pid = self.pool.allocate_page()
+                    if pid is None:
+                        ok = False   # pages stay attached; no leak
+                        break
+                    s.pages.append(pid)
+                if not ok:
+                    break
+            if ok:
+                b = cfg.max_batch_size
+                page_tables2 = np.zeros((b, mcfg.max_pages_per_seq),
+                                        dtype=np.int32)
+                for i, s in enumerate(batch):
+                    page_tables2[i, :len(s.pages)] = s.pages
+
+                def dispatch2():
+                    tokens2 = inf["packed"][0, k - 1].astype(jnp.int32)
+                    return decode_multi_step(
+                        self.params, self.k_cache, self.v_cache,
+                        tokens2,
+                        jax.numpy.asarray(inf["positions"] + k),
+                        jax.numpy.asarray(page_tables2),
+                        jax.numpy.asarray(inf["valid"]),
+                        jax.numpy.asarray(inf["seeds"]),
+                        jax.numpy.asarray(inf["steps"] + k),
+                        jax.numpy.asarray(inf["temps"]),
+                        jax.numpy.asarray(inf["top_ps"]),
+                        jax.numpy.asarray(inf["top_ks"]),
+                        mcfg, k)
+
+                async with self._device_lock:
+                    packed2, self.k_cache, self.v_cache = \
+                        await asyncio.to_thread(dispatch2)
+                nxt = {"k": k, "batch": batch, "packed": packed2,
+                       "positions": inf["positions"] + k,
+                       "valid": inf["valid"], "seeds": inf["seeds"],
+                       "steps": inf["steps"] + k, "temps": inf["temps"],
+                       "top_ps": inf["top_ps"],
+                       "top_ks": inf["top_ks"], "deferred": []}
+        packed = await asyncio.to_thread(np.asarray, inf["packed"])
+        # while the speculative burst runs, finished lanes' pages must
+        # not return to the pool (the burst still writes to them)
+        self._defer_releases = nxt["deferred"] if nxt is not None else None
+        try:
+            self._emit_burst(batch, packed, k)
+        finally:
+            self._defer_releases = None
+        for pages in inf["deferred"]:
+            self.pool.release_sequence(pages)
+        self._inflight = nxt
+        return True
+
     # -- lifecycle helpers --------------------------------------------------
 
     def _emit_token(self, seq: _Seq, token: int,
@@ -1197,7 +1353,10 @@ class TpuEngine:
             # decode-lookahead pages would break the importer's shapes.
             ps = self.model_cfg.page_size
             n_pages = (seq.pos + ps - 1) // ps
-            self.pool.release_sequence(seq.pages[n_pages:])
+            if self._defer_releases is not None:
+                self._defer_releases.append(list(seq.pages[n_pages:]))
+            else:
+                self.pool.release_sequence(seq.pages[n_pages:])
             tid = uuid.uuid4().hex
             self._transfers[tid] = (
                 seq.pages[:n_pages], seq.pos,
@@ -1219,7 +1378,11 @@ class TpuEngine:
         if seq in self._waiting:
             self._waiting.remove(seq)
         if release_pages:
-            self.pool.release_sequence(seq.pages)
+            if self._defer_releases is not None:
+                # an in-flight speculative burst still writes these pages
+                self._defer_releases.append(list(seq.pages))
+            else:
+                self.pool.release_sequence(seq.pages)
         seq.pages = []
         if emit:
             seq.queue.put_nowait(EngineOutput(

@@ -161,13 +161,19 @@ def _ring_zigzag_local(q, k, v, axis_name: str):
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m, l, acc
 
-    m0 = jnp.full((b, kvh, groups, tq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, kvh, groups, tq), jnp.float32)
-    acc0 = jnp.zeros((b, tq, kvh, groups, d), jnp.float32)
-    m0, l0, acc0 = lax.pcast((m0, l0, acc0), (axis_name,), to='varying')
+    m0, l0, acc0 = _init_carry(q5)
     _, _, _, l, acc = lax.fori_loop(0, sp, body, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
     return out.reshape(b, tq, h, d).astype(q.dtype)
+
+
+def _init_carry(q5):
+    """Online-softmax init (max, denom, acc) DERIVED from q5 so the
+    arrays inherit q5's varying-axes set — a plain jnp.zeros carry is
+    'unvarying' and shard_map's fori_loop typing rejects it; deriving
+    works for 1-D rings and 2-D (sp, tp) meshes alike."""
+    zero_stat = (q5[..., 0] * 0.0).transpose(0, 2, 3, 1)  # (B,KVH,G,Tq)
+    return zero_stat + _NEG_INF, zero_stat, q5 * 0.0
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
@@ -207,13 +213,9 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m, l, acc
 
-    m0 = jnp.full((b, kvh, groups, tq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, kvh, groups, tq), jnp.float32)
-    acc0 = jnp.zeros((b, tq, kvh, groups, d), jnp.float32)
-    # the loop output varies over the ring axis (it depends on axis_index),
-    # so the constant init carry must be marked varying too or shard_map's
-    # carry-type check rejects the fori_loop
-    m0, l0, acc0 = lax.pcast((m0, l0, acc0), (axis_name,), to='varying')
+    # init derived from q5 so the carry's varying-axes typing matches the
+    # loop outputs on any mesh (see _init_carry)
+    m0, l0, acc0 = _init_carry(q5)
     _, _, _, l, acc = lax.fori_loop(0, sp, body, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
     return out.reshape(b, tq, h, d).astype(q.dtype)

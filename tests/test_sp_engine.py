@@ -128,9 +128,7 @@ def test_sp_tp_2d_mesh_matches_unsharded(cpu_mesh_devices):
     shard_map) must match the unsharded forward — weights genuinely
     sharded over tp, sequence over sp."""
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-
-    from dynamo_tpu.engine.sharding import param_specs
+    from dynamo_tpu.engine.sharding import shard_params
     from dynamo_tpu.models.llama_sp import sp_prefill
 
     cfg = LlamaConfig.tiny(max_pages_per_seq=32)
@@ -142,10 +140,7 @@ def test_sp_tp_2d_mesh_matches_unsharded(cpu_mesh_devices):
 
     mesh2 = Mesh(np.asarray(cpu_mesh_devices[:4]).reshape(2, 2),
                  axis_names=("sp", "tp"))
-    sharded = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh2, s)),
-        params, param_specs(),
-        is_leaf=lambda x: not isinstance(x, dict))
+    sharded = shard_params(params, mesh2)
     logits, k_all, v_all = sp_prefill(sharded, tokens, cfg, mesh2,
                                       tp_axis="tp")
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
@@ -160,9 +155,7 @@ def test_sp_tp_2d_mesh_matches_unsharded(cpu_mesh_devices):
 
 def test_sp_tp_zigzag_2d(cpu_mesh_devices):
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding
-
-    from dynamo_tpu.engine.sharding import param_specs
+    from dynamo_tpu.engine.sharding import shard_params
     from dynamo_tpu.models.llama_sp import sp_prefill
 
     cfg = LlamaConfig.tiny(max_pages_per_seq=32)
@@ -172,10 +165,7 @@ def test_sp_tp_zigzag_2d(cpu_mesh_devices):
     ref, _, _ = sp_prefill(params, tokens, cfg, mesh1)
     mesh2 = Mesh(np.asarray(cpu_mesh_devices[:4]).reshape(2, 2),
                  axis_names=("sp", "tp"))
-    sharded = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh2, s)),
-        params, param_specs(),
-        is_leaf=lambda x: not isinstance(x, dict))
+    sharded = shard_params(params, mesh2)
     got, _, _ = sp_prefill(sharded, tokens, cfg, mesh2, layout="zigzag",
                            tp_axis="tp")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),

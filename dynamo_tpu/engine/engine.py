@@ -214,6 +214,13 @@ class TpuEngine:
         cfg = self.config
         self.model_cfg = cfg.model
         mcfg = self.model_cfg
+        # captured BEFORE the locals are rebound below: quantization may
+        # only donate buffers the ENGINE created — caller-provided arrays
+        # can be aliased elsewhere (shard_params' device_put is a no-op
+        # when the sharding already matches), and donating them destroys
+        # the caller's objects
+        owned_params = params is None
+        owned_draft = draft_params is None
         if cfg.mesh is None:
             if params is None:
                 params = init_params(jax.random.PRNGKey(cfg.rng_seed), mcfg)
@@ -283,9 +290,15 @@ class TpuEngine:
                 raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
             from dynamo_tpu.engine.quant import quantize_params_jit
 
-            self.params = quantize_params_jit(self.params)
+            # donation frees the bf16 buffers, but ONLY when the engine
+            # created (or sharded-copied) them — donating caller-provided
+            # device arrays would destroy the caller's objects (e.g. a
+            # second engine built from the same params)
+            self.params = quantize_params_jit(self.params,
+                                              donate=owned_params)
             if self.draft_params is not None:
-                self.draft_params = quantize_params_jit(self.draft_params)
+                self.draft_params = quantize_params_jit(
+                    self.draft_params, donate=owned_draft)
         self._sp_params = None
         if cfg.sp_mesh is not None and cfg.sp_threshold > 0:
             if cfg.mesh is not None:

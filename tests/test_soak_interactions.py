@@ -1,0 +1,145 @@
+"""Feature-interaction soak: every engine subsystem active at once.
+
+One engine with a draft model (speculative decoding), KVBM host tier,
+sp ring prefill, pipelined bursts, int8 weights — serving a concurrent
+mix of greedy / seeded-stochastic / nucleus / guided / penalized /
+long-prompt / repeated-prompt requests. The properties that must
+survive arbitrary batch interleavings:
+
+- every request completes with its exact token budget or a stop finish
+- guided lanes stay inside their grammar
+- per-request output is DETERMINISTIC across two full runs (sampling is
+  (seed, step)-keyed per sequence, so batch composition can't leak in)
+- no page leaks after drain
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
+from dynamo_tpu.llm.guided import compile_regex
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+CFG = LlamaConfig.tiny(max_pages_per_seq=32)      # context 128
+PARAMS = init_params(jax.random.PRNGKey(0), CFG)
+DRAFT = init_params(jax.random.PRNGKey(99), CFG)
+TOKEN_BYTES = [bytes([i]) for i in range(256)]
+
+
+def build_engine(devices):
+    eng = TpuEngine(
+        TpuEngineConfig(
+            model=CFG, num_pages=256, max_batch_size=4,
+            prefill_chunk=64, min_prefill_bucket=8,
+            default_max_tokens=12, decode_steps_per_sync=4,
+            quantize="int8", draft_model=CFG, spec_gamma=2,
+            spec_iters_per_sync=2, pipeline_bursts=True,
+            sp_mesh=Mesh(np.asarray(devices[:4]), axis_names=("sp",)),
+            sp_threshold=48, sp_layout="zigzag"),
+        params=PARAMS, draft_params=DRAFT,
+        token_bytes=TOKEN_BYTES, eos_token_id=0)
+    KvbmManager(eng, KvbmConfig(host_blocks=128))
+    return eng
+
+
+def requests():
+    long_prompt = [(i * 7) % 250 + 1 for i in range(70)]
+    reqs = []
+    for rep in range(2):                     # repeats → prefix-cache hits
+        reqs += [
+            # plain greedy
+            {"token_ids": [3, 4, 5, 6], "model": "m",
+             "sampling": {"temperature": 0.0},
+             "stop": {"max_tokens": 10}},
+            # seeded stochastic (spec-compatible)
+            {"token_ids": [9, 8, 7], "model": "m",
+             "sampling": {"temperature": 0.8, "seed": 21 + rep},
+             "stop": {"max_tokens": 8}},
+            # nucleus (forces spec fallback)
+            {"token_ids": [11, 12], "model": "m",
+             "sampling": {"temperature": 0.9, "top_p": 0.5,
+                          "seed": 5},
+             "stop": {"max_tokens": 8}},
+            # guided choice (constrained burst)
+            {"token_ids": [20, 21], "model": "m",
+             "sampling": {"temperature": 0.0,
+                          "guided": {"choice": ["hi", "hey"]}},
+             "stop": {"max_tokens": 8, "stop_token_ids": [0]}},
+            # guided regex + presence penalty (constrained, composed)
+            {"token_ids": [30], "model": "m",
+             "sampling": {"temperature": 0.0,
+                          "presence_penalty": 500.0,
+                          "guided": {"regex": "[a-z]+"}},
+             "stop": {"max_tokens": 6, "stop_token_ids": [0]}},
+            # long novel prompt (sp ring bulk prefill; zigzag unit 128
+            # > prompt, so t_sp falls back to chunked — still exercises
+            # the gate) plus repetition penalty
+            {"token_ids": list(long_prompt), "model": "m",
+             "sampling": {"temperature": 0.0,
+                          "repetition_penalty": 2.0},
+             "stop": {"max_tokens": 10}},
+        ]
+    return reqs
+
+
+async def run_all(eng):
+    async def one(req):
+        toks, finishes = [], []
+        async for o in eng.generate(dict(req), Context()):
+            toks += o.get("token_ids", [])
+            if o.get("finish_reason"):
+                finishes.append(o["finish_reason"])
+        return toks, finishes[-1] if finishes else None
+
+    return await asyncio.gather(*(one(r) for r in requests()))
+
+
+async def test_everything_at_once_twice(cpu_mesh_devices):
+    eng1 = build_engine(cpu_mesh_devices)
+    try:
+        out1 = await run_all(eng1)
+        assert eng1._inflight is None
+        assert eng1.pool.active_pages == 0      # no leaks after drain
+        spec_stats = eng1._spec_stats.to_dict()
+    finally:
+        await eng1.close()
+
+    # basic shape/finish properties
+    choice_dfa = compile_regex("(hi)|(hey)")
+    for (toks, finish), req in zip(out1, requests()):
+        assert finish in ("length", "stop"), (finish, req)
+        guided = req["sampling"].get("guided")
+        if guided and "choice" in guided:
+            body = bytes(t for t in toks if t != 0)
+            s = 0
+            for b in body:
+                s = int(choice_dfa.next[s, b])
+                assert s != -1, body
+        if guided and "regex" in guided:
+            body = bytes(t for t in toks if t != 0)
+            assert all(97 <= b <= 122 for b in body), body
+            # presence penalty: no repeats among the letters
+            assert len(set(body)) == len(body), body
+        if not guided and finish == "length":
+            assert len(toks) == req["stop"]["max_tokens"], (toks, req)
+
+    # spec gating is BATCH-level: with nucleus/guided lanes always in
+    # flight in this mix, spec bursts correctly never engage (per-lane
+    # spec gating is a round-3 idea); the stats surface just must exist
+    assert spec_stats["num_draft_tokens"] >= 0
+
+    # full determinism across a fresh engine run
+    eng2 = build_engine(cpu_mesh_devices)
+    try:
+        out2 = await run_all(eng2)
+    finally:
+        await eng2.close()
+    assert [t for t, _ in out2] == [t for t, _ in out1]

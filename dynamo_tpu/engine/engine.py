@@ -772,16 +772,14 @@ class TpuEngine:
         # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
         # compilation for the engine's lifetime. Underfull lanes/steps waste
         # a little compute; recompiles (tens of seconds) waste far more.
-        # Spec bursts only serve sampling configs the rejection test
-        # covers (no nucleus/top-k filtering) — mixed batches fall back.
-        # checked over ALL runnable lanes (not just the first batch-width):
-        # preemption inside the page-allocation loop below can promote a
-        # later lane into the batch, and a nucleus/top-k or guided lane
-        # must never ride a spec burst
+        # Spec bursts serve greedy/temperature/top-p/top-k lanes (the
+        # rejection test runs on each lane's FILTERED distribution);
+        # min_p/penalty/guided lanes still need the constrained burst.
+        # Checked over ALL runnable lanes (not just the first
+        # batch-width): preemption inside the page-allocation loop below
+        # can promote a later lane into the batch
         use_spec = self.draft_params is not None and all(
-            s.req.sampling.top_p >= 1.0 and s.req.sampling.top_k == 0
-            and not s.needs_constrained
-            for s in runnable)
+            not s.needs_constrained for s in runnable)
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
         # every runnable seq needs pages covering pos .. pos+k_steps-1
@@ -852,6 +850,7 @@ class TpuEngine:
                     jax.numpy.asarray(page_tables),
                     jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
                     mcfg, cfg.draft_model, cfg.spec_gamma,
                     cfg.spec_iters_per_sync)
                 return np.asarray(packed), kc, vc, dk, dv  # ONE host sync

@@ -24,19 +24,14 @@ _NEG_INF = -1e30
 MAX_CANDIDATES = 64
 
 
-def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
-                         steps: jax.Array, temperature: jax.Array,
-                         top_p: jax.Array, top_k: jax.Array,
-                         min_p: Optional[jax.Array] = None) -> jax.Array:
-    """logits: (B, V) fp32; seeds/steps: (B,) u32/i32; temperature/top_p:
-    (B,) f32; top_k: (B,) i32 (0 = disabled); min_p: (B,) f32 (0 =
-    disabled) — drops candidates whose probability is below
-    min_p × max-probability (after temperature). temperature <= 0 ⇒
-    greedy. Returns (B,) i32 tokens. Traceable (used inside fused decode
-    loops)."""
+def _candidate_mask(logits: jax.Array, temperature: jax.Array,
+                    top_p: jax.Array, top_k: jax.Array,
+                    min_p: Optional[jax.Array] = None):
+    """THE filter definition (top-k → top-p → min_p over the sorted
+    candidate set): returns (masked_cand_logits (B, C), cand_idx (B, C),
+    t (B,)). Shared by the sampler and by speculative decoding's
+    filtered-distribution rejection test so the two can never diverge."""
     b, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-
     c = min(MAX_CANDIDATES, v)
     cand_logits, cand_idx = lax.top_k(logits, c)           # (B, C) sorted desc
 
@@ -56,7 +51,44 @@ def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
         # candidates are sorted desc, so probs[:, :1] is the max; index 0
         # always survives (p >= min_p * p for min_p <= 1)
         keep &= probs >= jnp.clip(min_p, 0.0, 1.0)[:, None] * probs[:, :1]
-    masked = jnp.where(keep, masked, _NEG_INF)
+    return jnp.where(keep, masked, _NEG_INF), cand_idx, t
+
+
+def filtered_probs(logits: jax.Array, temperature: jax.Array,
+                   top_p: jax.Array, top_k: jax.Array,
+                   min_p: Optional[jax.Array] = None) -> jax.Array:
+    """(B, V) probabilities of the ACTUAL sampling distribution: the
+    temperature-scaled softmax restricted to the kept candidate set
+    (zeros elsewhere); temperature==0 rows are the one-hot argmax.
+    This is what speculative decoding's ratio test must use — filtering
+    target and draft identically preserves Leviathan correctness, and
+    the greedy case needs no special-casing (one-hot dists make the
+    test exact argmax equality)."""
+    b, v = logits.shape
+    masked, cand_idx, t = _candidate_mask(logits, temperature, top_p,
+                                          top_k, min_p)
+    cand_p = jax.nn.softmax(masked / t[:, None], axis=-1)  # (B, C)
+    hard = jax.nn.one_hot(jnp.argmax(masked, axis=-1), masked.shape[-1],
+                          dtype=jnp.float32)
+    cand_p = jnp.where((temperature > 0)[:, None], cand_p, hard)
+    full = jnp.zeros((b, v), jnp.float32)
+    return full.at[jnp.arange(b)[:, None], cand_idx].add(cand_p)
+
+
+def sample_tokens_traced(logits: jax.Array, seeds: jax.Array,
+                         steps: jax.Array, temperature: jax.Array,
+                         top_p: jax.Array, top_k: jax.Array,
+                         min_p: Optional[jax.Array] = None) -> jax.Array:
+    """logits: (B, V) fp32; seeds/steps: (B,) u32/i32; temperature/top_p:
+    (B,) f32; top_k: (B,) i32 (0 = disabled); min_p: (B,) f32 (0 =
+    disabled) — drops candidates whose probability is below
+    min_p × max-probability (after temperature). temperature <= 0 ⇒
+    greedy. Returns (B,) i32 tokens. Traceable (used inside fused decode
+    loops)."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    masked, cand_idx, t = _candidate_mask(logits, temperature, top_p,
+                                          top_k, min_p)
 
     def sample_one(seed, step, lg, tt):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), step)

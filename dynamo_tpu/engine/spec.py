@@ -19,11 +19,13 @@ implementation, TPU-first:
   Leviathan et al. rejection sampling —
     greedy lanes  (temperature == 0): accept while target argmax == draft
     stochastic lanes: accept draft token c with prob min(1, p_t(c)/p_d(c))
-      over the temperature-scaled full softmax; on rejection, resample
-      from the residual max(p_t - p_d, 0). The engine gates the spec path
-      to batches with top_p == 1 and top_k == 0 (the ratio test over
-      filtered distributions is not implemented — lanes with nucleus/top-k
-      sampling take the normal fused decode path instead).
+      over the lane's ACTUAL sampling distribution — the temperature-
+      scaled softmax restricted by its top-p/top-k filter
+      (sampling.filtered_probs; filtering target and draft identically
+      preserves Leviathan correctness). Greedy lanes are the one-hot
+      special case of the same test (exact argmax equality), so one code
+      path serves every sampling config except min_p/penalties/guided
+      (those lanes route to the constrained fused burst instead).
 
 Output is PACKED into one f32 array (3, num_iters, gamma+1, B):
 row 0 token ids, row 1 chosen-token target logprobs, row 2 the per-lane
@@ -52,19 +54,20 @@ from dynamo_tpu.models.llama import (
 _DRAFT_SEED_SALT = jnp.uint32(0x9E3779B9)
 
 
-def _softmax_t(logits: jax.Array, temperature: jax.Array) -> jax.Array:
-    """Temperature-scaled softmax; temperature==0 lanes get a one-hot
-    argmax distribution (greedy as the T→0 limit, exact).
+def _lane_probs(logits: jax.Array, temperature: jax.Array,
+                top_p: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Per-lane ACTUAL sampling distribution for (B, V) or (B, G, V)
+    logits (sampling.filtered_probs, vectorized over the middle dim)."""
+    from dynamo_tpu.engine.sampling import filtered_probs
 
-    logits: (B, ..., V); temperature: (B,) broadcast over the middle dims.
-    """
-    shape = (temperature.shape[0],) + (1,) * (logits.ndim - 1)
-    tcol = temperature.reshape(shape)
-    t = jnp.where(tcol > 0, tcol, 1.0)
-    p = jax.nn.softmax(logits.astype(jnp.float32) / t, axis=-1)
-    hard = jax.nn.one_hot(jnp.argmax(logits, axis=-1), logits.shape[-1],
-                          dtype=jnp.float32)
-    return jnp.where(tcol > 0, p, hard)
+    if logits.ndim == 2:
+        return filtered_probs(logits, temperature, top_p, top_k)
+    b, g, v = logits.shape
+    flat = filtered_probs(
+        logits.reshape(b * g, v),
+        jnp.repeat(temperature, g), jnp.repeat(top_p, g),
+        jnp.repeat(top_k, g))
+    return flat.reshape(b, g, v)
 
 
 def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
@@ -81,7 +84,8 @@ def spec_decode_multi_step(
         dk_cache: tuple, dv_cache: tuple,
         tokens: jax.Array, positions: jax.Array, page_tables: jax.Array,
         valid: jax.Array, seeds: jax.Array, steps0: jax.Array,
-        temperature: jax.Array, cfg: LlamaConfig, draft_cfg: LlamaConfig,
+        temperature: jax.Array, top_p: jax.Array, top_k: jax.Array,
+        cfg: LlamaConfig, draft_cfg: LlamaConfig,
         gamma: int, num_iters: int):
     """`num_iters` fused draft→verify→accept iterations, ONE host sync.
 
@@ -115,7 +119,7 @@ def spec_decode_multi_step(
                 draft_cfg)
             if j == gamma:
                 break
-            dp = _softmax_t(dlogits, temperature)          # (B, V)
+            dp = _lane_probs(dlogits, temperature, top_p, top_k)
             key = jax.vmap(
                 lambda s, st: jax.random.fold_in(
                     jax.random.fold_in(jax.random.PRNGKey(s), st),
@@ -123,7 +127,7 @@ def spec_decode_multi_step(
             )(draft_seeds, steps)
             stoch = jax.vmap(_categorical)(key, dp)
             dtok = jnp.where(temperature > 0, stoch,
-                             jnp.argmax(dlogits, axis=-1)).astype(jnp.int32)
+                             jnp.argmax(dp, axis=-1)).astype(jnp.int32)
             d_tokens.append(dtok)
             d_probs.append(dp)
         verify_toks = jnp.stack(d_tokens, axis=1)          # (B, G1)
@@ -134,7 +138,7 @@ def spec_decode_multi_step(
         x, kc, vc = paged_forward(params, kc, vc, verify_toks, page_tables,
                                   pos, seq_lens, cfg, False)
         logits = qm(x, params["lm_head"]).astype(jnp.float32)  # (B, G1, V)
-        target_p = _softmax_t(logits, temperature)         # (B, G1, V)
+        target_p = _lane_probs(logits, temperature, top_p, top_k)
 
         # -- acceptance ----------------------------------------------------
         cand = verify_toks[:, 1:]                          # (B, gamma)
@@ -147,16 +151,14 @@ def spec_decode_multi_step(
                 jnp.uint32(0x5EC0))
         )(seeds.astype(jnp.uint32), steps)
         u = jax.vmap(lambda k: jax.random.uniform(k, (gamma,)))(ukey)
-        ratio_ok = u * jnp.maximum(p_d, 1e-30) < p_t       # (B, gamma)
-        greedy_ok = jnp.argmax(logits[:, :gamma], axis=-1) == cand
-        ok = jnp.where((temperature > 0)[:, None], ratio_ok, greedy_ok)
+        # one test for every lane: greedy dists are one-hots, so the
+        # ratio test degenerates to exact argmax equality there
+        ok = u * jnp.maximum(p_d, 1e-30) < p_t             # (B, gamma)
         n_acc = jnp.argmin(
             jnp.concatenate([ok, jnp.zeros((B, 1), bool)], axis=1)
             .astype(jnp.int32), axis=1)                    # leading trues
 
         # -- extra token: residual sample (reject) or bonus (all accept) ---
-        l_at_n = jnp.take_along_axis(
-            logits, n_acc[:, None, None], axis=1)[:, 0]    # (B, V)
         pt_at_n = jnp.take_along_axis(
             target_p, n_acc[:, None, None], axis=1)[:, 0]
         pd_at_n = jnp.take_along_axis(
@@ -176,7 +178,7 @@ def spec_decode_multi_step(
         )(seeds.astype(jnp.uint32), steps + n_acc)
         stoch_x = jax.vmap(_categorical)(xkey, dist)
         extra = jnp.where(temperature > 0, stoch_x,
-                          jnp.argmax(l_at_n, axis=-1)).astype(jnp.int32)
+                          jnp.argmax(dist, axis=-1)).astype(jnp.int32)
 
         # -- emit ----------------------------------------------------------
         emitted = jnp.where(

@@ -71,12 +71,33 @@ async def test_stochastic_spec_self_draft_high_acceptance():
     assert stats.acceptance_rate > 0.8, stats.to_dict()
 
 
-async def test_nucleus_lane_falls_back_to_normal_decode():
-    draft_params = init_params(jax.random.PRNGKey(99), CFG)
-    toks, stats = await run_engine(draft_params=draft_params, draft_cfg=CFG,
+async def test_nucleus_lane_rides_spec_bursts():
+    # the rejection test runs on the FILTERED distribution, so nucleus
+    # lanes no longer fall back; with draft == target the filtered dists
+    # are identical and acceptance stays high
+    target_params = init_params(jax.random.PRNGKey(0), CFG)
+    toks, stats = await run_engine(draft_params=target_params,
+                                   draft_cfg=CFG,
                                    temperature=0.8, top_p=0.5)
     assert len(toks) == 24
-    assert stats.num_draft_tokens == 0  # spec path never engaged
+    assert stats.num_draft_tokens > 0
+    assert stats.acceptance_rate > 0.8, stats.to_dict()
+
+
+async def test_min_p_lane_falls_back_to_constrained():
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2, default_max_tokens=8,
+        draft_model=CFG, spec_gamma=2, spec_iters_per_sync=2),
+        draft_params=draft_params)
+    req = {"token_ids": list(PROMPT), "model": "m",
+           "sampling": {"temperature": 0.8, "min_p": 0.2, "seed": 3},
+           "stop": {"max_tokens": 8}}
+    toks = [t async for o in eng.generate(req, Context())
+            for t in o.get("token_ids", [])]
+    assert len(toks) == 8
+    assert eng._spec_stats.num_draft_tokens == 0  # constrained path
+    await eng.close()
 
 
 async def test_spec_output_deterministic():

@@ -139,8 +139,12 @@ class TpuEngineConfig:
     # spec_gamma tokens per iteration, the target verifies them in ONE
     # forward. Must share the target's page geometry (page_size,
     # max_pages_per_seq) — draft caches are indexed by the same page
-    # tables. Spec bursts serve batches whose lanes all have top_p == 1
-    # and top_k == 0; other batches take the normal fused decode path.
+    # tables. Spec bursts serve ALL sampling configs (greedy and
+    # temperature/top-p/top-k lanes, via per-lane Leviathan rejection
+    # sampling over each lane's actual filtered distribution); only
+    # batches with a lane needing the constrained burst (guided grammar,
+    # min_p, or penalties — _Seq.needs_constrained) fall back to the
+    # normal fused decode path.
     draft_model: Optional[LlamaConfig] = None
     spec_gamma: int = 4
     spec_iters_per_sync: int = 8
@@ -324,6 +328,12 @@ class TpuEngine:
         self._guided_eos = eos_token_id
         self._guided_tables: dict[str, Any] = {}
         self._guided_slots: dict[str, int] = {}
+        # spec-key -> refcount for requests between compile and their
+        # _waiting.append: eviction must treat these as live or a
+        # concurrent compile at the grammar cap could drop a grammar a
+        # request is about to use (the later slot lookup would then
+        # KeyError inside the scheduler loop)
+        self._guided_pending: dict[str, int] = {}
         self._guided_stack = None          # (bits_dev, next_dev)
         self.metrics_sink = metrics_sink
         self._waiting: list[_Seq] = []
@@ -384,6 +394,7 @@ class TpuEngine:
                 extra={"error": "empty prompt"}).to_dict()
             return
         guided_tables = None
+        guided_key = None
         if req.sampling.guided:
             if len(req.stop.stop_token_ids or []) > self.GUIDED_STOP_WIDTH:
                 yield EngineOutput(
@@ -392,83 +403,100 @@ class TpuEngine:
                                     f"{self.GUIDED_STOP_WIDTH} stop "
                                     f"token ids"}).to_dict()
                 return
-            try:
-                guided_tables = await self._compile_guided(
-                    req.sampling.guided, req)
-            except Exception as e:
+            guided_key = self._guided_key(req.sampling.guided)
+            # hold a pending ref across the compile await so a concurrent
+            # compile's eviction can't drop this grammar before the seq
+            # reaches _waiting (released in the finally below — which also
+            # covers CancelledError, a BaseException, at any await)
+            self._guided_pending[guided_key] = \
+                self._guided_pending.get(guided_key, 0) + 1
+        try:
+            if guided_key is not None:
+                try:
+                    guided_tables = await self._compile_guided(
+                        req.sampling.guided, req)
+                except Exception as e:
+                    yield EngineOutput(
+                        token_ids=[], finish_reason=FINISH_ERROR,
+                        extra={"error": f"guided decoding: {e}"}).to_dict()
+                    return
+            if req.extra.get("embed"):
+                max_ctx = mcfg.page_size * mcfg.max_pages_per_seq
+                if len(req.token_ids) > max_ctx:
+                    # must reject BEFORE the dense T^2 forward: an unbounded
+                    # prompt would compile/allocate under the device lock
+                    yield EngineOutput(
+                        token_ids=[], finish_reason=FINISH_ERROR,
+                        extra={"error": f"embed input ({len(req.token_ids)} "
+                                        f"tokens) exceeds context {max_ctx}"}
+                    ).to_dict()
+                    return
+                yield await self._embed_one(req)
+                return
+            # decode bursts may overshoot by up to one burst's lookahead
+            lookahead = self._burst_lookahead
+            max_len = mcfg.page_size * mcfg.max_pages_per_seq - lookahead
+            need_pages = (len(req.token_ids) + req.stop.max_tokens
+                          + lookahead
+                          + mcfg.page_size - 1) // mcfg.page_size
+            if len(req.token_ids) + req.stop.max_tokens > max_len \
+                    or need_pages > self.pool.capacity:
                 yield EngineOutput(
                     token_ids=[], finish_reason=FINISH_ERROR,
-                    extra={"error": f"guided decoding: {e}"}).to_dict()
+                    extra={"error": f"prompt+max_tokens exceeds capacity "
+                                    f"(context {max_len}, "
+                                    f"pages {self.pool.capacity})"}).to_dict()
                 return
-        if req.extra.get("embed"):
-            max_ctx = mcfg.page_size * mcfg.max_pages_per_seq
-            if len(req.token_ids) > max_ctx:
-                # must reject BEFORE the dense T^2 forward: an unbounded
-                # prompt would compile/allocate under the device lock
-                yield EngineOutput(
-                    token_ids=[], finish_reason=FINISH_ERROR,
-                    extra={"error": f"embed input ({len(req.token_ids)} "
-                                    f"tokens) exceeds context {max_ctx}"}
-                ).to_dict()
-                return
-            yield await self._embed_one(req)
-            return
-        # decode bursts may overshoot by up to one burst's lookahead
-        lookahead = self._burst_lookahead
-        max_len = mcfg.page_size * mcfg.max_pages_per_seq - lookahead
-        need_pages = (len(req.token_ids) + req.stop.max_tokens
-                      + lookahead
-                      + mcfg.page_size - 1) // mcfg.page_size
-        if len(req.token_ids) + req.stop.max_tokens > max_len \
-                or need_pages > self.pool.capacity:
-            yield EngineOutput(
-                token_ids=[], finish_reason=FINISH_ERROR,
-                extra={"error": f"prompt+max_tokens exceeds capacity "
-                                f"(context {max_len}, "
-                                f"pages {self.pool.capacity})"}).to_dict()
-            return
-        ktp = req.kv_transfer_params or {}
-        import_kv = None
-        if ktp.get("kv_data") is not None:
-            data = ktp["kv_data"]
-            plen = int(ktp["prefill_len"])
-            n_pages = (plen + mcfg.page_size - 1) // mcfg.page_size
-            want = (2, mcfg.num_layers, mcfg.num_kv_heads, n_pages,
-                    mcfg.page_size, mcfg.head_dim)
-            if not (0 < plen < len(req.token_ids)) \
-                    or tuple(data.shape) != want:
-                # a malformed import must fail THIS request, not reach
-                # prefill_all where an exception would _fail_all everyone
-                yield EngineOutput(
-                    token_ids=[], finish_reason=FINISH_ERROR,
-                    extra={"error": f"bad kv import: prefill_len={plen}, "
-                                    f"shape={tuple(data.shape)} != {want}"}
-                ).to_dict()
-                return
-            import_kv = (data, plen)
-        seq = _Seq(
-            req=req, ctx=context, queue=asyncio.Queue(),
-            token_seq=TokenBlockSequence(mcfg.page_size),
-            prompt=list(req.token_ids),
-            prompt_hashes=TokenBlockSequence(
-                mcfg.page_size, req.token_ids).seq_hashes(),
-            import_kv=import_kv,
-            guided=guided_tables,
-            seed=(req.sampling.seed if req.sampling.seed is not None
-                  else int(self._rng.randint(0, 2**31 - 1))),
-            arrival=self._arrivals,
-        )
-        self._arrivals += 1
-        self._ensure_loop()
-        self._waiting.append(seq)
-        self._wake.set()
-        while True:
-            out = await seq.queue.get()
-            if out is None:
-                return
-            yield out
-            if out.get("finish_reason"):
-                return
+            ktp = req.kv_transfer_params or {}
+            import_kv = None
+            if ktp.get("kv_data") is not None:
+                data = ktp["kv_data"]
+                plen = int(ktp["prefill_len"])
+                n_pages = (plen + mcfg.page_size - 1) // mcfg.page_size
+                want = (2, mcfg.num_layers, mcfg.num_kv_heads, n_pages,
+                        mcfg.page_size, mcfg.head_dim)
+                if not (0 < plen < len(req.token_ids)) \
+                        or tuple(data.shape) != want:
+                    # a malformed import must fail THIS request, not reach
+                    # prefill_all where an exception would _fail_all everyone
+                    yield EngineOutput(
+                        token_ids=[], finish_reason=FINISH_ERROR,
+                        extra={"error": f"bad kv import: prefill_len={plen}, "
+                                        f"shape={tuple(data.shape)} != {want}"}
+                    ).to_dict()
+                    return
+                import_kv = (data, plen)
+            seq = _Seq(
+                req=req, ctx=context, queue=asyncio.Queue(),
+                token_seq=TokenBlockSequence(mcfg.page_size),
+                prompt=list(req.token_ids),
+                prompt_hashes=TokenBlockSequence(
+                    mcfg.page_size, req.token_ids).seq_hashes(),
+                import_kv=import_kv,
+                guided=guided_tables,
+                seed=(req.sampling.seed if req.sampling.seed is not None
+                      else int(self._rng.randint(0, 2**31 - 1))),
+                arrival=self._arrivals,
+            )
+            self._arrivals += 1
+            self._ensure_loop()
+            self._waiting.append(seq)
+            self._wake.set()
+            while True:
+                out = await seq.queue.get()
+                if out is None:
+                    return
+                yield out
+                if out.get("finish_reason"):
+                    return
+        finally:
+            # the pending ref pins the grammar for the request's
+            # whole life (covers CancelledError at any await and
+            # every early return; once the seq is in _waiting the
+            # active-set scan covers it too, so the extra pin is
+            # merely redundant, never wrong)
+            if guided_key is not None:
+                self._guided_unpend(guided_key)
 
     async def _embed_one(self, req) -> dict:
         """Mean-pooled prompt embedding (llama.embed_batch): a dense
@@ -810,6 +838,23 @@ class TpuEngine:
             return False
         b = cfg.max_batch_size
         batch = runnable[:b]
+        # Ensure every guided lane's grammar is registered BEFORE any
+        # lane arrays are sized or the device stack is fetched: the
+        # _guided_slot_of backstop can evict+renumber other slots, so
+        # registration must fully settle first. A lane whose grammar
+        # can't be re-admitted (table byte cap) fails alone, never the
+        # batch.
+        for s in [x for x in batch if x.guided is not None]:
+            try:
+                self._guided_slot_of(s)
+            except ValueError as e:
+                s.queue.put_nowait(EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": f"guided decoding: {e}"}).to_dict())
+                self._finish(s, FINISH_ERROR, emit=False)
+                batch.remove(s)
+        if not batch:
+            return True          # progressed: lanes finished with errors
         max_pages = mcfg.max_pages_per_seq
         tokens = np.zeros(b, dtype=np.int32)
         positions = np.zeros(b, dtype=np.int32)
@@ -887,6 +932,10 @@ class TpuEngine:
             from dynamo_tpu.models.llama import decode_multi_step_guided
 
             V = mcfg.vocab_size
+            # slots are stable here: every batch grammar was registered
+            # (and any backstop renumbering settled) at the top of
+            # _decode_iter, before any lane arrays were built
+            slot_of = {id(s): self._guided_slot_of(s) for s in batch}
             g_bits, g_next, g_eos_ok = self._guided_device_stack()
             g_ids = np.zeros(b, dtype=np.int32)
             g_states = np.zeros(b, dtype=np.int32)
@@ -899,7 +948,7 @@ class TpuEngine:
             prompt_counts = np.zeros((b, V), dtype=np.int32)
             out_counts = np.zeros((b, V), dtype=np.int32)
             for i, s in enumerate(batch):
-                g_ids[i] = self._guided_slot_of(s)
+                g_ids[i] = slot_of[id(s)]
                 g_states[i] = s.guided_state
                 for j, t in enumerate(self._guided_stop_ids(s)):
                     stop_ids[i, j] = t
@@ -1128,8 +1177,6 @@ class TpuEngine:
         it runs in a thread and is cached by the spec's canonical JSON.
         Tables are EOS-agnostic (stop tokens overlay per lane), so the
         spec alone is a sound cache key."""
-        import json as _json
-
         if callable(self._guided_vocab):
             # lazy: the O(vocab) token-bytes map is only built when the
             # first guided request arrives, not at engine startup
@@ -1139,7 +1186,7 @@ class TpuEngine:
             raise ValueError(
                 "engine has no tokenizer vocabulary (token_bytes) — "
                 "guided decoding unavailable")
-        key = _json.dumps(spec, sort_keys=True)
+        key = self._guided_key(spec)
         tables = self._guided_tables.get(key)
         if tables is not None:
             return tables
@@ -1181,15 +1228,33 @@ class TpuEngine:
                            2 * self.MAX_GUIDED_GRAMMARS)
         return g_pad * s_pad * (2 * V + (V + 7) // 8 + 1)
 
-    def _evict_guided_unused(self) -> None:
-        """Drop cached grammars no active sequence references, and
-        renumber slots compactly (the device stack is rebuilt)."""
+    @staticmethod
+    def _guided_key(spec: dict) -> str:
+        """Canonical cache key for a guided spec. The pending-ref,
+        eviction, and slot machinery all key on this — every lookup must
+        go through here so they can never disagree."""
         import json as _json
 
+        return _json.dumps(spec, sort_keys=True)
+
+    def _guided_unpend(self, key: str) -> None:
+        """Release one pending ref taken in generate()."""
+        n = self._guided_pending.get(key, 0) - 1
+        if n <= 0:
+            self._guided_pending.pop(key, None)
+        else:
+            self._guided_pending[key] = n
+
+    def _evict_guided_unused(self) -> None:
+        """Drop cached grammars no active sequence references, and
+        renumber slots compactly (the device stack is rebuilt). Grammars
+        with a pending ref (request between compile and _waiting.append)
+        count as active."""
         active = {
-            _json.dumps(s.req.sampling.guided, sort_keys=True)
+            self._guided_key(s.req.sampling.guided)
             for s in self._running + self._waiting
             if s.guided is not None}
+        active |= set(self._guided_pending)
         self._guided_tables = {k: v for k, v in
                                self._guided_tables.items() if k in active}
         self._guided_slots = {k: i + 1 for i, k in
@@ -1227,12 +1292,35 @@ class TpuEngine:
         return self._guided_stack
 
     def _guided_slot_of(self, seq: _Seq) -> int:
-        import json as _json
-
         if seq.guided is None:
             return 0
-        return self._guided_slots[_json.dumps(seq.req.sampling.guided,
-                                              sort_keys=True)]
+        key = self._guided_key(seq.req.sampling.guided)
+        slot = self._guided_slots.get(key)
+        if slot is None:
+            # backstop: the grammar was evicted between this seq's
+            # compile and now (shouldn't happen with pending refs, but a
+            # KeyError here would reach the scheduler catch-all and
+            # _fail_all every in-flight request). The seq still holds its
+            # compiled tables — re-register them. Evicting unused first
+            # keeps the cache inside the admission caps: active distinct
+            # specs can never exceed MAX_GUIDED_GRAMMARS (each passed
+            # admission while its peers were active), so after eviction
+            # the insert fits the count cap; the byte cap depends on the
+            # cache's current size mix and must be re-checked (callers
+            # fail only the offending lane on ValueError).
+            self._evict_guided_unused()
+            if self._guided_stack_bytes(seq.guided) \
+                    > self.GUIDED_TABLE_MAX_BYTES:
+                raise ValueError(
+                    f"guided grammar tables would exceed "
+                    f"{self.GUIDED_TABLE_MAX_BYTES >> 20} MiB on device "
+                    f"(re-registration after eviction)")
+            self._guided_tables[key] = seq.guided
+            self._guided_slots[key] = slot = len(self._guided_slots) + 1
+            self._guided_stack = None
+            logger.warning("guided grammar re-registered after eviction "
+                           "(slot %d)", slot)
+        return slot
 
     def _guided_stop_ids(self, seq: _Seq) -> list[int]:
         ids = list(seq.req.stop.stop_token_ids or [])[

@@ -58,13 +58,24 @@ class _Frag:
 
 
 class _Nfa:
-    """ε-NFA: states have byte-set transitions + ε edges."""
+    """ε-NFA: states have byte-set transitions + ε edges.
+
+    MAX_STATES bounds TOTAL construction work: per-bound caps alone
+    don't, because stacked/nested {m,n} compose multiplicatively
+    (a{256}{256} would clone 65k sub-NFAs) and guided_regex is
+    user-supplied via the API — the compile thread must never hang."""
+
+    MAX_STATES = 100_000
 
     def __init__(self) -> None:
         self.eps: list[list[int]] = []
         self.edges: list[list[tuple[frozenset, int]]] = []
 
     def new_state(self) -> int:
+        if len(self.eps) >= self.MAX_STATES:
+            raise GrammarError(
+                f"regex too large (more than {self.MAX_STATES} NFA "
+                f"states; reduce nested/stacked repetition bounds)")
         self.eps.append([])
         self.edges.append([])
         return len(self.eps) - 1
@@ -121,12 +132,14 @@ class _RegexParser:
     def _rep(self) -> _Frag:
         a0 = self.i
         f = self._atom()
-        a1 = self.i
         while self.i < len(self.p) and self.p[self.i] in "*+?{":
             c = self.p[self.i]
             if c == "{":
+                # re-parse span covers everything applied so far (atom +
+                # any stacked quantifiers), so a*{2} means (a*){2}, not a{2}
+                span = self.p[a0:self.i]
                 m, n = self._bounds()
-                f = self._repeat(self.p[a0:a1], m, n)
+                f = self._repeat(span, m, n)
                 continue
             self.i += 1
             if c == "*":
@@ -176,7 +189,7 @@ class _RegexParser:
             self.p, self.i = save_p, save_i
 
     def _repeat(self, src: str, m: int, n: Optional[int]) -> _Frag:
-        if n is not None and (n < m or n == 0):
+        if m < 0 or (n is not None and n < m):
             raise GrammarError(f"bad repetition bounds {{{m},{n}}}")
         if (n or m) > 256:
             raise GrammarError("repetition bound too large (max 256)")
@@ -302,7 +315,14 @@ class ByteDfa:
     accepting: np.ndarray     # (S,) bool
 
 
-def compile_regex(pattern: str) -> ByteDfa:
+def compile_regex(pattern: str, deadline_s: float = 15.0) -> ByteDfa:
+    """deadline_s bounds CPU for the whole compile: guided_regex is
+    user-supplied via the API, and pathological (but state-cap-legal)
+    patterns make subset construction + minimization superlinear — a
+    wall-clock budget is the only bound that holds for every shape."""
+    import time as _time
+
+    t_end = _time.monotonic() + deadline_s
     nfa, start, accept = _RegexParser(pattern).parse()
 
     def closure(states: frozenset) -> frozenset:
@@ -321,6 +341,10 @@ def compile_regex(pattern: str) -> ByteDfa:
     rows: list[np.ndarray] = []
     i = 0
     while i < len(order):
+        if i % 64 == 0 and _time.monotonic() > t_end:
+            raise GrammarError(
+                f"regex compile exceeded {deadline_s:.0f}s "
+                f"(pattern too complex)")
         cur = order[i]
         i += 1
         row = np.full(256, DEAD, dtype=np.int32)
@@ -347,10 +371,11 @@ def compile_regex(pattern: str) -> ByteDfa:
             row[b] = sid
         rows.append(row)
     accepting = np.array([accept in s for s in order], dtype=bool)
-    return minimize(ByteDfa(next=np.stack(rows), accepting=accepting))
+    return minimize(ByteDfa(next=np.stack(rows), accepting=accepting),
+                    t_end=t_end)
 
 
-def minimize(dfa: ByteDfa) -> ByteDfa:
+def minimize(dfa: ByteDfa, t_end: Optional[float] = None) -> ByteDfa:
     """Moore partition refinement. The bounded-depth JSON expansion
     produces heavily redundant states (each depth re-states the scalar
     grammar); minimization typically shrinks it several-fold, which
@@ -358,7 +383,11 @@ def minimize(dfa: ByteDfa) -> ByteDfa:
     S = dfa.next.shape[0]
     # block id per state; dead (-1) maps to its own implicit block
     block = dfa.accepting.astype(np.int64).copy()
+    import time as _time
     while True:
+        if t_end is not None and _time.monotonic() > t_end:
+            raise GrammarError("regex compile exceeded deadline during "
+                               "minimization (pattern too complex)")
         # signature: (block, blocks of the 256 successors)
         succ_blocks = np.where(dfa.next >= 0,
                                block[np.clip(dfa.next, 0, S - 1)], -1)
@@ -458,7 +487,8 @@ def json_schema_regex(schema, max_depth: int = 4) -> str:
     if t == "string":
         return _JSON_STR
     if t == "integer":
-        return r"(-)?\d+"
+        # match _JSON_NUM's integer part: leading zeros are invalid JSON
+        return r"(-)?(0|[1-9]\d*)"
     if t == "number":
         return _JSON_NUM
     if t == "boolean":

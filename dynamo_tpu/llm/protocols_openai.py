@@ -65,6 +65,12 @@ def _guided_from(d: dict, nvext: dict) -> Optional[dict]:
             candidates.append({"regex": src["guided_regex"]})
         if src.get("guided_choice") is not None:
             candidates.append({"choice": list(src["guided_choice"])})
+        # serving a CFG request unconstrained would be a silent contract
+        # violation — reject until a grammar compiler exists
+        _require(src.get("guided_grammar") is None,
+                 "'guided_grammar' (context-free grammar) is not "
+                 "supported; use guided_regex, guided_json, or "
+                 "guided_choice")
     if not candidates:
         return None
     _require(len(candidates) == 1,
@@ -251,77 +257,6 @@ def chat_chunk(request_id: str, model: str, created: int,
     if usage is not None:
         out["usage"] = usage
     return out
-
-
-def chat_completion(request_id: str, model: str, created: int, text: str,
-                    finish_reason: str, usage: dict,
-                    tool_calls: Optional[list[dict]] = None,
-                    reasoning: str = "") -> dict:
-    message: dict[str, Any] = {"role": "assistant", "content": text}
-    if tool_calls:
-        # unary shape carries no streaming 'index' field
-        message["tool_calls"] = [
-            {k: v for k, v in tc.items() if k != "index"}
-            for tc in tool_calls]
-    if reasoning:
-        message["reasoning_content"] = reasoning
-    return {
-        "id": request_id, "object": "chat.completion", "created": created,
-        "model": model,
-        "choices": [{
-            "index": 0,
-            "message": message,
-            "finish_reason": finish_reason,
-        }],
-        "usage": usage,
-    }
-
-
-def completion_chunk(request_id: str, model: str, created: int, text: str,
-                     finish_reason: Optional[str] = None,
-                     usage: Optional[dict] = None,
-                     token_logprobs: Optional[list[float]] = None) -> dict:
-    logprobs = None
-    if token_logprobs is not None:
-        logprobs = {"token_logprobs": token_logprobs,
-                    "tokens": None, "top_logprobs": None,
-                    "text_offset": None}
-    out = {
-        "id": request_id, "object": "text_completion", "created": created,
-        "model": model,
-        "choices": [{"index": 0, "text": text,
-                     "finish_reason": finish_reason, "logprobs": logprobs}],
-    }
-    if usage is not None:
-        out["usage"] = usage
-    return out
-
-
-def completion_response(request_id: str, model: str, created: int, text: str,
-                        finish_reason: str, usage: dict,
-                        token_logprobs: Optional[list[float]] = None
-                        ) -> dict:
-    return completion_chunk(request_id, model, created, text,
-                            finish_reason, usage,
-                            token_logprobs=token_logprobs)
-
-
-def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
-    return {"prompt_tokens": prompt_tokens,
-            "completion_tokens": completion_tokens,
-            "total_tokens": prompt_tokens + completion_tokens}
-
-
-# ---------------------------------------------------------------------------
-# SSE codec (protocols/codec.rs)
-# ---------------------------------------------------------------------------
-
-SSE_DONE = b"data: [DONE]\n\n"
-
-
-def sse_encode(payload: dict) -> bytes:
-    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() \
-        + b"\n\n"
 
 
 async def _fold_chunks(chunks: AsyncIterator[dict], on_choice) -> tuple:

@@ -11,7 +11,7 @@ import json
 import numpy as np
 
 from dynamo_tpu.engine.attention import set_attention_impl
-from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig, _Seq
 from dynamo_tpu.llm.guided import (
     GrammarError,
     choice_regex,
@@ -121,6 +121,43 @@ def text_of(tokens):
     return bytes(body)
 
 
+async def test_eviction_spares_pending_and_slot_reregisters():
+    """A grammar with a pending ref (request between compile and its
+    _waiting.append) must survive _evict_guided_unused; and even if a
+    grammar is somehow dropped, _guided_slot_of re-registers from the
+    seq's own tables instead of raising into the scheduler catch-all."""
+    import json as _json
+
+    eng = make_engine()
+    spec = {"choice": ["abc", "xyz"]}
+    key = _json.dumps(spec, sort_keys=True)
+    tables = await eng._compile_guided(spec, None)
+    assert key in eng._guided_tables
+
+    # pending ref protects the grammar from eviction (no running seq)
+    eng._guided_pending[key] = 1
+    eng._evict_guided_unused()
+    assert key in eng._guided_tables
+    eng._guided_unpend(key)
+    assert key not in eng._guided_pending
+
+    # without refs and without a seq, eviction drops it
+    eng._evict_guided_unused()
+    assert key not in eng._guided_tables
+
+    # backstop: a seq holding evicted tables re-registers on slot lookup
+    from dynamo_tpu.protocols import PreprocessedRequest
+    req = PreprocessedRequest.from_dict({
+        "token_ids": [10], "model": "m",
+        "sampling": {"guided": spec},
+        "stop": {"max_tokens": 1}})
+    seq = _Seq(req=req, ctx=Context(), queue=None, token_seq=None,
+               prompt=[10], guided=tables)
+    slot = eng._guided_slot_of(seq)
+    assert slot >= 1 and key in eng._guided_tables
+    assert eng._guided_slot_of(seq) == slot
+
+
 async def test_choice_forces_exact_output():
     eng = make_engine()
     try:
@@ -222,6 +259,56 @@ def test_bounded_repetition():
         assert match_bytes(dfa, s.encode()) == want, s
     dfa = compile_regex(r"(ab){2,}")
     assert match_bytes(dfa, b"ababab") and not match_bytes(dfa, b"ab")
+
+
+def test_negative_repetition_bounds_rejected():
+    import pytest
+
+    for pat in (r"a{-1}", r"a{-2,-1}", r"a{-1,3}"):
+        with pytest.raises(GrammarError):
+            compile_regex(pat)
+
+
+def test_zero_repetition_is_empty_match():
+    dfa = compile_regex(r"a{0}b")
+    assert match_bytes(dfa, b"b")
+    assert not match_bytes(dfa, b"ab")
+    dfa = compile_regex(r"x(ab){0,0}y")
+    assert match_bytes(dfa, b"xy")
+    assert not match_bytes(dfa, b"xaby")
+
+
+def test_stacked_quantifier_applies_to_quantified_span():
+    # a*{2} must mean (a*){2} — i.e. any number of a's — not a{2}
+    dfa = compile_regex(r"a*{2}")
+    for s, want in [("", True), ("a", True), ("aa", True),
+                    ("aaaaa", True), ("b", False)]:
+        assert match_bytes(dfa, s.encode()) == want, s
+    # a{2}{3} = (a{2}){3} = exactly 6
+    dfa = compile_regex(r"a{2}{3}")
+    for s, want in [("a" * 6, True), ("a" * 5, False), ("a" * 7, False)]:
+        assert match_bytes(dfa, s.encode()) == want, s
+
+
+def test_pathological_regex_bounded():
+    import pytest
+
+    # multiplicative stacked bounds must fail fast (state cap), and a
+    # state-cap-legal but superlinear pattern must hit the deadline —
+    # guided_regex is user input; compile work has to be bounded
+    with pytest.raises(GrammarError):
+        compile_regex("a{256}{256}")
+    with pytest.raises(GrammarError):
+        compile_regex("a{40}{40}", deadline_s=0.5)
+
+
+def test_json_schema_integer_rejects_leading_zeros():
+    from dynamo_tpu.llm.guided import json_schema_regex
+
+    dfa = compile_regex(json_schema_regex({"type": "integer"}))
+    for s, want in [("0", True), ("7", True), ("42", True), ("-13", True),
+                    ("00", False), ("007", False), ("-01", False)]:
+        assert match_bytes(dfa, s.encode()) == want, s
 
 
 def test_dangling_backslash_is_grammar_error():

@@ -120,6 +120,21 @@ async def test_context_length_rejection():
              "messages": [{"role": "user", "content": "a b c d e f"}]}))
 
 
+async def test_guided_grammar_rejected_not_silently_dropped():
+    # a CFG request served unconstrained would violate the contract;
+    # until a grammar compiler exists it must be an explicit error
+    with pytest.raises(OpenAIError, match="guided_grammar"):
+        ChatCompletionRequest.from_dict({
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "guided_grammar": "root ::= 'a'"})
+    with pytest.raises(OpenAIError, match="guided_grammar"):
+        ChatCompletionRequest.from_dict({
+            "model": "m",
+            "messages": [{"role": "user", "content": "x"}],
+            "nvext": {"guided_grammar": "root ::= 'a'"}})
+
+
 async def test_sampling_options_mapping():
     req = ChatCompletionRequest.from_dict({
         "model": "m", "messages": [{"role": "user", "content": "x"}],

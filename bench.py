@@ -1,91 +1,112 @@
 """Round benchmark: end-to-end serving throughput of the owned TPU engine.
 
-Runs on whatever chip `jax.devices()` offers (the driver provides one real
-TPU). Workload: continuous-batched greedy decode, 32 requests × ISL 96 /
-OSL 64, 16-way concurrency, measured after a compile/warmup round.
-K=32 fused decode steps per host sync: the axon tunnel charges ~95 ms
-per device→host sync regardless of payload, so burst length is the
-dominant throughput lever in this environment (4 ms/step of real device
-compute at batch 16).
+Runs on whatever chip `jax.devices()` offers (the driver provides one
+real TPU). Four phases, one JSON line:
 
-Primary metric: output tokens/sec/chip through the FULL engine (scheduler,
-paging, prefix cache, sampling, streaming) — not a raw kernel number.
-`vs_baseline` divides by the round-1 fused-device-loop ceiling (606 tok/s,
-same model/batch/chip) so rounds are comparable. The extras report the
-roofline decomposition VERDICT r1 asked for:
-- effective_ms_per_step: whole-run wall per fused decode step — INCLUDES
-  prefill rounds and ramp-down rounds with partially full batches, so it
-  upper-bounds true decode step time
-- device_loop_tok_s / vs_device_loop: raw decode_multi_step loop measured
-  live in this run; the ratio folds scheduler+streaming overhead AND the
-  required prefill work into one number (conservative)
-- hbm_util_pct: (param bytes + per-step KV traffic) / step-time / 819 GB/s
-  (v5e HBM peak) — how close the decode step runs to memory-bound.
-  Ablation (2026-07-30): the weight-stream floor alone (matmuls only,
-  no attention/cache/sampling) measures 6.2 ms of the 8.3 ms step at
-  batch 16 — i.e. ~75% of the step is the irreducible weight read at
-  this batch; attention+paged-cache+sampling add 2.1 ms. Pushing
-  further means bigger batches (more tokens per weight read) or
-  quantized weights, not kernel tuning.
+- short  (top-level keys, r1/r2 continuity): ISL 96 / OSL 64, batch 16,
+  int8 — `value` and `vs_baseline` keep comparing against the round-1
+  fused-device-loop ceiling (606 tok/s) on the same workload.
+- long   (`long` sub-object): ISL 1024 / OSL 256, batch 32, int8 — the
+  representative workload VERDICT r2 asked for (the 70B recipe it
+  approximates is ISL 8192 / OSL 1024: long prompts, decode-bound
+  batch). Reports its own live device-loop ceiling at batch 32 and the
+  long-context HBM utilisation, plus a `cached` sub-run where prompts
+  share a 768-token prefix (system-prompt pattern; exercises the radix
+  prefix cache — reference KVBM/KV-routing's bread and butter).
+- ckpt   (`ckpt` sub-object): Llama-3-8B-architecture checkpoint served
+  through the REAL loader path (sharded safetensors index →
+  loader.load_llama_params_device: per-layer upload with device-side
+  transpose/cast/int8). No pretrained checkpoint exists in this image
+  (zero egress), so weights are synthetic noise — labeled as such —
+  but the load path, memory budget, transfer cost, and serving numbers
+  are exactly what a real 8B pays. Includes a seeded-rerun sanity
+  generation.
+- kv     (top-level `kv_*` keys): disagg KV-transfer GB/s, host bounce
+  vs device-resident gather.
 
-Prints ONE JSON line.
+Environment facts baked into the shape of this file: the axon tunnel
+charges ~95 ms per device→host sync and ~10 s per remote compile, so
+every phase warms every (batch-width, token-bucket) compile shape it
+can hit in separate waves BEFORE its timed window, and decode runs
+K=32 fused steps per sync. The tunnel's sync latency swings ±20%
+run-to-run: compare `vs_device_loop` (engine ÷ raw-loop, both measured
+live in the same run) across rounds, not absolute tok/s.
+
+Phases are fault-isolated: a phase that dies reports {"error": ...}
+instead of killing the round's numbers. DYN_BENCH_SKIP=long,ckpt skips
+phases; DYN_BENCH_CKPT_PRESET overrides the ckpt model size.
 """
 
 import asyncio
+import gc
 import json
+import os
 import time
 
 R1_DEVICE_LOOP_CEILING_TOK_S = 606.0  # round-1 ceiling: decode_multi_step K=16,B=16
 V5E_HBM_GBPS = 819.0
-
-ISL, OSL, N_REQS, BATCH, K_STEPS = 96, 64, 32, 16, 32
-# int8 weight-only (engine/quant.py): halves the decode weight-stream
-# floor, the dominant step cost at batch 16 (8.2→6.0 ms/step measured on
-# v5e). A standard serving config (the reference ships FP8/INT8 engine
-# recipes); bf16 comparison is reported in the extras.
 QUANTIZE = "int8"
 
+# short phase (r1/r2 continuity)
+ISL, OSL, N_REQS, BATCH, K_STEPS = 96, 64, 32, 16, 32
+# long phase
+L_ISL, L_OSL, L_BATCH, L_NREQ, L_SHARED = 1024, 256, 32, 64, 768
 
-def bench_cfg():
+CKPT_DIR = "/tmp/dynamo-bench-ckpt-8b"
+CKPT_PRESET = os.environ.get("DYN_BENCH_CKPT_PRESET", "llama3-8b")
+
+
+def _enable_compile_cache():
+    """Persistent XLA compile cache: repeat bench runs (driver + manual)
+    skip the ~10 s/shape (minutes at 8B) remote compiles. One shared
+    implementation with the worker CLI so the two never build separate
+    caches on one machine."""
+    from dynamo_tpu.cli_util import enable_compile_cache
+
+    enable_compile_cache()
+
+
+def bench_cfg(max_pages_per_seq=64, page_size=16):
     from dynamo_tpu.models.llama import LlamaConfig
 
     return LlamaConfig(
         vocab_size=32000, hidden_size=2048, intermediate_size=8192,
         num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
-        page_size=16, max_pages_per_seq=64)
+        page_size=page_size, max_pages_per_seq=max_pages_per_seq)
 
 
-async def run_engine_bench(cfg, quantize=QUANTIZE):
-    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
-    from dynamo_tpu.runtime.context import Context
+def prompt_of(i, isl, shared=0):
+    """Deterministic token prompt; first `shared` tokens identical
+    across i (system-prompt pattern)."""
+    head = [(11 * j) % 31999 + 1 for j in range(shared)]
+    tail = [(7 * i + 13 * j) % 31999 + 1 for j in range(isl - shared)]
+    return head + tail
 
-    eng = TpuEngine(TpuEngineConfig(
-        model=cfg, num_pages=2048, max_batch_size=BATCH, prefill_chunk=128,
-        default_max_tokens=OSL, decode_steps_per_sync=K_STEPS,
-        quantize=quantize))
 
-    async def one(i, osl=OSL):
-        req = {"token_ids": [(7 * i + j) % 31999 + 1 for j in range(ISL)],
-               "model": "bench", "sampling": {"temperature": 0.0},
+async def serve_n(eng, n, isl, osl, base=0, shared=0):
+    """Submit n concurrent greedy requests; returns (tok_count, wall_s)."""
+    async def one(i):
+        from dynamo_tpu.runtime.context import Context
+
+        req = {"token_ids": prompt_of(i, isl, shared), "model": "bench",
+               "sampling": {"temperature": 0.0},
                "stop": {"max_tokens": osl}}
         outs = [o async for o in eng.generate(req, Context())]
-        assert outs[-1].get("finish_reason") == "length", outs[-1]
+        last = outs[-1]
+        assert last.get("finish_reason") == "length", last
         return sum(len(o.get("token_ids", ())) for o in outs)
 
-    # warmup: compile EVERY shape the measured phase can hit. Prefill
-    # batches at pow2 widths (engine _next_pow2), so warm each width
-    # with its own synchronized wave — a single missed shape would land
-    # a ~10s remote compile inside the timed window. Decode is a single
-    # fixed-width compile covered by the first request.
-    await one(0)                                          # bp=1 + decode
-    for wave, base in ((2, 30), (4, 40), (8, 50), (BATCH, 60)):
-        await asyncio.gather(*(one(base + i) for i in range(wave)))
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*(one(base + i) for i in range(n)))
+    return sum(counts), time.perf_counter() - t0
 
-    # TTFT probe (unloaded, post-warmup): wall from submit to the first
-    # streamed token of a single request
-    async def ttft_ms(i):
-        req = {"token_ids": [(7 * i + j) % 31999 + 1 for j in range(ISL)],
-               "model": "bench", "sampling": {"temperature": 0.0},
+
+async def ttft_probe(eng, isl, reps=3):
+    from dynamo_tpu.runtime.context import Context
+
+    async def once(i):
+        req = {"token_ids": prompt_of(9000 + i, isl), "model": "bench",
+               "sampling": {"temperature": 0.0},
                "stop": {"max_tokens": 4}}
         t0 = time.perf_counter()
         async for o in eng.generate(req, Context()):
@@ -95,36 +116,23 @@ async def run_engine_bench(cfg, quantize=QUANTIZE):
                 raise RuntimeError(f"ttft probe failed: {o}")
         raise RuntimeError("ttft probe stream ended without tokens")
 
-    ttfts = [await ttft_ms(900 + k) for k in range(3)]
-    ttft = sorted(ttfts)[len(ttfts) // 2]
-
-    # two measured phases, best-of reported (the tunneled chip's sync
-    # latency swings ±20% run to run; both samples go in the extras)
-    rates = []
-    for phase in range(2):
-        base = 100 + phase * N_REQS
-        t0 = time.perf_counter()
-        counts = await asyncio.gather(
-            *(one(base + i) for i in range(N_REQS)))
-        dt = time.perf_counter() - t0
-        rates.append(sum(counts) / dt)
-    params = eng.params
-    await eng.close()
-    return max(rates), rates, params, ttft
+    vals = [await once(k) for k in range(reps)]
+    return sorted(vals)[len(vals) // 2]
 
 
-def run_device_loop(cfg, params):
-    """Raw fused decode loop, no engine: the device ceiling, measured live."""
+def device_loop_rate(cfg, params, batch, k_steps, ctx_len, num_pages):
+    """Raw fused decode loop at the given batch/context: the live device
+    ceiling the engine number is compared against."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from dynamo_tpu.models.llama import decode_multi_step, init_cache
 
-    kc, vc = init_cache(cfg, 2048)
-    b = BATCH
+    kc, vc = init_cache(cfg, num_pages)
+    b = batch
     toks = jnp.zeros(b, dtype=jnp.int32)
-    pos = jnp.full(b, ISL, dtype=jnp.int32)
+    pos = jnp.full(b, ctx_len, dtype=jnp.int32)
     pts = jnp.asarray(np.tile(
         np.arange(1, cfg.max_pages_per_seq + 1, dtype=np.int32), (b, 1)))
     valid = jnp.ones(b, dtype=bool)
@@ -137,7 +145,7 @@ def run_device_loop(cfg, params):
         nonlocal kc, vc
         s, kc, vc = decode_multi_step(
             params, kc, vc, toks, pos, pts, valid, z, z, temps, tps, tks,
-            cfg, K_STEPS)
+            cfg, k_steps)
         np.asarray(s)  # full sync incl. any tunnel round-trip
 
     burst()  # compile
@@ -146,45 +154,268 @@ def run_device_loop(cfg, params):
     for _ in range(reps):
         burst()
     dt = (time.perf_counter() - t0) / reps
-    return b * K_STEPS / dt, dt / K_STEPS
+    del kc, vc
+    return b * k_steps / dt, dt / k_steps
 
 
-def hbm_bytes_per_step(cfg, params):
+def hbm_util_pct(params, cfg, batch, avg_ctx, step_s):
+    """(weight bytes + per-step KV read) / step-time / HBM peak."""
     import jax
 
     param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
-    # per-step KV traffic: read full context + write one token, per lane
-    avg_len = ISL + OSL // 2
-    kv_bytes = (BATCH * avg_len * cfg.num_kv_heads * cfg.head_dim
+    kv_bytes = (batch * avg_ctx * cfg.num_kv_heads * cfg.head_dim
                 * 2 * 2 * cfg.num_layers)
-    return param_bytes + kv_bytes
+    return 100.0 * (param_bytes + kv_bytes) / step_s / 1e9 / V5E_HBM_GBPS
 
 
-async def bench_kv_transfer(cfg, n_pages=256):
-    """Disagg KV transfer GB/s: host-bounce gather vs device-resident
-    gather (the ICI-path source op). VERDICT r2 #7 asks for both."""
-    import time as _t
+# ---------------------------------------------------------------------------
+# short phase (r1/r2 continuity workload)
+# ---------------------------------------------------------------------------
 
-    import numpy as np
 
+async def phase_short():
     from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
 
-    eng = TpuEngine(TpuEngineConfig(model=cfg, num_pages=n_pages + 8,
+    cfg = bench_cfg()
+    eng = TpuEngine(TpuEngineConfig(
+        model=cfg, num_pages=2048, max_batch_size=BATCH, prefill_chunk=128,
+        default_max_tokens=OSL, decode_steps_per_sync=K_STEPS,
+        quantize=QUANTIZE))
+    # warm every prefill batch-width wave the measured phase can hit
+    await serve_n(eng, 1, ISL, OSL, base=0)
+    for wave, base in ((2, 30), (4, 40), (8, 50), (BATCH, 60)):
+        await serve_n(eng, wave, ISL, OSL, base=base)
+    ttft = await ttft_probe(eng, ISL)
+    rates = []
+    for phase in range(2):
+        n_tok, dt = await serve_n(eng, N_REQS, ISL, OSL,
+                                  base=100 + phase * N_REQS)
+        rates.append(n_tok / dt)
+    params = eng.params
+    await eng.close()
+    tok_s = max(rates)
+    loop_tok_s, loop_step_s = device_loop_rate(
+        cfg, params, BATCH, K_STEPS, ISL + OSL // 2, 2048)
+    out = {
+        "value": round(tok_s, 1),
+        "vs_baseline": round(tok_s / R1_DEVICE_LOOP_CEILING_TOK_S, 3),
+        "effective_ms_per_step": round(1000.0 * BATCH / tok_s, 2),
+        "device_loop_tok_s": round(loop_tok_s, 1),
+        "vs_device_loop": round(tok_s / loop_tok_s, 3),
+        "device_ms_per_step": round(loop_step_s * 1000, 2),
+        "hbm_util_pct": round(hbm_util_pct(
+            params, cfg, BATCH, ISL + OSL // 2, loop_step_s), 1),
+        "isl": ISL, "osl": OSL, "n_requests": N_REQS, "batch": BATCH,
+        "quantize": QUANTIZE,
+        "ttft_ms_unloaded_p50": round(ttft, 1),
+        "phase_tok_s": [round(r, 1) for r in rates],
+    }
+    del params
+    gc.collect()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# long-ISL phase (representative workload)
+# ---------------------------------------------------------------------------
+
+
+async def phase_long():
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+
+    # 32-token pages at long context: measured 11.9 ms/step vs 26 ms
+    # with 16-token pages at the r2 pallas block size (see
+    # engine/attention.py block heuristic) — page granularity is an
+    # attention-kernel lever, not just a cache-management knob
+    cfg = bench_cfg(max_pages_per_seq=64, page_size=32)
+    eng = TpuEngine(TpuEngineConfig(
+        model=cfg, num_pages=1536, max_batch_size=L_BATCH,
+        prefill_chunk=512, default_max_tokens=L_OSL,
+        decode_steps_per_sync=K_STEPS, quantize=QUANTIZE))
+    # warmup: compile decode (fixed width) + every (bp, 512) prefill
+    # round width, short OSL so warmup cost is prefill-dominated
+    await serve_n(eng, 1, L_ISL, K_STEPS + 1, base=0)
+    for wave, base in ((2, 300), (4, 310), (8, 320), (16, 330),
+                       (L_BATCH, 350)):
+        await serve_n(eng, wave, L_ISL, 4, base=base)
+    ttft = await ttft_probe(eng, L_ISL)
+
+    # measured: unique prompts (no prefix reuse — worst case)
+    n_tok, dt = await serve_n(eng, L_NREQ, L_ISL, L_OSL, base=1000)
+    tok_s = n_tok / dt
+
+    # cached variant: all prompts share a L_SHARED-token prefix. Prime
+    # the cache with one request, warm the (32, 256) prefill shape the
+    # cached wave hits, then measure.
+    await serve_n(eng, 1, L_ISL, 2, base=2000, shared=L_SHARED)
+    await serve_n(eng, L_BATCH, L_ISL, 4, base=2100, shared=L_SHARED)
+    c_tok, c_dt = await serve_n(eng, L_NREQ, L_ISL, L_OSL, base=3000,
+                                shared=L_SHARED)
+    cached_tok_s = c_tok / c_dt
+
+    # int8-vs-int4 quality smoke inputs: fixed greedy generations
+    async def greedy_tokens(e, i):
+        from dynamo_tpu.runtime.context import Context
+
+        req = {"token_ids": prompt_of(i, 256), "model": "bench",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 32}}
+        return [t async for o in e.generate(req, Context())
+                for t in o.get("token_ids", ())]
+
+    ref_toks = [await greedy_tokens(eng, 5000 + i) for i in range(2)]
+    params = eng.params
+    await eng.close()
+    loop_tok_s, loop_step_s = device_loop_rate(
+        cfg, params, L_BATCH, K_STEPS, L_ISL + L_OSL // 2, 1536)
+    # int4 ablation: same weights (same init seed), int4 layer quant —
+    # raw decode ceiling + a greedy-agreement quality smoke
+    from dynamo_tpu.engine.engine import TpuEngine as _Eng, \
+        TpuEngineConfig as _Cfg
+
+    eng4 = _Eng(_Cfg(model=cfg, num_pages=1536, max_batch_size=L_BATCH,
+                     prefill_chunk=512, decode_steps_per_sync=K_STEPS,
+                     quantize="int4"))
+    int4_toks = [await greedy_tokens(eng4, 5000 + i) for i in range(2)]
+    agree = (sum(sum(a == b for a, b in zip(x, y))
+                 for x, y in zip(ref_toks, int4_toks))
+             / sum(len(x) for x in ref_toks))
+    params4 = eng4.params
+    await eng4.close()
+    loop4_tok_s, loop4_step_s = device_loop_rate(
+        cfg, params4, L_BATCH, K_STEPS, L_ISL + L_OSL // 2, 1536)
+    del params4
+    gc.collect()
+
+    out = {
+        "tok_s": round(tok_s, 1),
+        "cached_tok_s": round(cached_tok_s, 1),
+        "int4_device_ms_per_step": round(loop4_step_s * 1000, 2),
+        "int4_device_loop_tok_s": round(loop4_tok_s, 1),
+        "int4_vs_int8_greedy_agreement": round(agree, 3),
+        "device_loop_tok_s": round(loop_tok_s, 1),
+        "vs_device_loop": round(tok_s / loop_tok_s, 3),
+        "cached_vs_device_loop": round(cached_tok_s / loop_tok_s, 3),
+        "device_ms_per_step": round(loop_step_s * 1000, 2),
+        "hbm_util_pct": round(hbm_util_pct(
+            params, cfg, L_BATCH, L_ISL + L_OSL // 2, loop_step_s), 1),
+        "isl": L_ISL, "osl": L_OSL, "batch": L_BATCH,
+        "n_requests": L_NREQ, "shared_prefix": L_SHARED,
+        "quantize": QUANTIZE,
+        "ttft_ms_unloaded_p50": round(ttft, 1),
+    }
+    del params
+    gc.collect()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checkpoint phase (real loader path at 8B scale)
+# ---------------------------------------------------------------------------
+
+
+async def phase_ckpt():
+    # hard time box: a slow 8B compile must degrade ONE phase, never
+    # eat the round's whole bench (the driver runs this file once)
+    budget = float(os.environ.get("DYN_BENCH_CKPT_TIMEOUT", "1800"))
+    return await asyncio.wait_for(_phase_ckpt_inner(), timeout=budget)
+
+
+async def _phase_ckpt_inner():
+    from dynamo_tpu.models.synth_ckpt import write_synthetic_hf_checkpoint
+
+    t0 = time.perf_counter()
+    path = write_synthetic_hf_checkpoint(CKPT_DIR, CKPT_PRESET)
+    t_build = time.perf_counter() - t0
+
+    from dynamo_tpu.llm.entrypoint import build_tpu_engine
+
+    t0 = time.perf_counter()
+    # build_tpu_engine: resolve → config_from_hf → sharded-safetensors
+    # index → per-layer upload with transpose/cast/int8 ON DEVICE
+    # (loader.load_llama_params_device — the bf16 pytree never fully
+    # exists on device: 8B bf16 = 16 GB = the chip)
+    # prefill widths restricted to {1, 8}: each 8B prefill SHAPE costs
+    # ~10 min of XLA compile on this setup (see ROUND3_NOTES); two
+    # shapes bound the warmup
+    eng, card = build_tpu_engine(
+        path, served_name="bench-8b", num_pages=256, max_batch_size=8,
+        decode_steps_per_sync=K_STEPS, quantize=QUANTIZE,
+        prefill_batch_widths=(1, 8), max_pages_per_seq=32)
+    t_load = time.perf_counter() - t0
+    print(f"bench ckpt: load+quantize+place {t_load:.0f}s", flush=True)
+
+    isl, osl, n = 256, 32, 8
+    t0 = time.perf_counter()
+    await serve_n(eng, 1, isl, K_STEPS + 1, base=0)      # compile bp=1
+    await serve_n(eng, 8, isl, 4, base=40)               # compile bp=8
+    t_warm = time.perf_counter() - t0
+    print(f"bench ckpt: warmup/compiles {t_warm:.0f}s", flush=True)
+    n_tok, dt = await serve_n(eng, n, isl, osl, base=100)
+    tok_s = n_tok / dt
+
+    # sanity: two identical seeded stochastic requests through the full
+    # loaded-weights stack. With RANDOM weights the distribution is
+    # near-uniform over 128k tokens, so bf16 near-ties + different
+    # physical page layouts (run 2 hits the prefix cache) legitimately
+    # flip a few picks — assert strong agreement, not bit equality
+    # (trained weights would be effectively deterministic here).
+    from dynamo_tpu.runtime.context import Context
+
+    async def sample_once():
+        req = {"token_ids": prompt_of(7, isl), "model": "bench-8b",
+               "sampling": {"temperature": 0.8, "top_p": 0.95, "seed": 5},
+               "stop": {"max_tokens": 16}}
+        return [t for o in [o async for o in eng.generate(req, Context())]
+                for t in o.get("token_ids", ())]
+
+    s1, s2 = await sample_once(), await sample_once()
+    agree = sum(a == b for a, b in zip(s1, s2)) / max(len(s1), 1)
+    assert len(s1) == len(s2) and agree >= 0.5, (agree, s1, s2)
+
+    import jax
+
+    param_gb = sum(x.nbytes for x in jax.tree.leaves(eng.params)) / 2**30
+    await eng.close()
+    out = {
+        "model": f"{CKPT_PRESET} (HF layout, synthetic noise weights — "
+                 f"no pretrained checkpoint in image, zero egress)",
+        "tok_s": round(tok_s, 1),
+        "isl": isl, "osl": osl, "batch": n, "quantize": QUANTIZE,
+        "ckpt_build_s": round(t_build, 1),
+        "load_quantize_place_s": round(t_load, 1),
+        "device_param_gb": round(param_gb, 2),
+        "sampled_sanity_tokens": s1[:8],
+        "seeded_rerun_agreement": round(agree, 3),
+    }
+    gc.collect()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# disagg KV transfer
+# ---------------------------------------------------------------------------
+
+
+async def phase_kv(n_pages=256):
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+
+    eng = TpuEngine(TpuEngineConfig(model=bench_cfg(),
+                                    num_pages=n_pages + 8,
                                     max_batch_size=1))
     pages = list(range(1, n_pages + 1))
-    # warm both paths (compile the gathers)
-    host = await eng.read_kv_pages(pages)
-    dev = await eng.read_kv_pages_device(pages)
+    host = await eng.read_kv_pages(pages)          # warm host path
+    dev = await eng.read_kv_pages_device(pages)    # warm device path
     nbytes = host.nbytes
     reps = 3
-    t0 = _t.perf_counter()
+    t0 = time.perf_counter()
     for _ in range(reps):
         await eng.read_kv_pages(pages)
-    host_s = (_t.perf_counter() - t0) / reps
-    t0 = _t.perf_counter()
+    host_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
     for _ in range(reps):
         (await eng.read_kv_pages_device(pages)).block_until_ready()
-    dev_s = (_t.perf_counter() - t0) / reps
+    dev_s = (time.perf_counter() - t0) / reps
     del dev
     await eng.close()
     return {"kv_transfer_mb": round(nbytes / 1e6, 1),
@@ -192,46 +423,45 @@ async def bench_kv_transfer(cfg, n_pages=256):
             "kv_device_gbps": round(nbytes / dev_s / 1e9, 2)}
 
 
-def main():
-    cfg = bench_cfg()
-    # the tunneled chip occasionally drops one call mid-run (observed
-    # once as a spurious "engine step failed"); the driver runs this
-    # file exactly once, so retry the engine phase rather than record a
-    # broken round
-    for attempt in (1, 2):
-        try:
-            tok_s, phase_rates, params, ttft_ms = asyncio.run(
-                run_engine_bench(cfg))
-            break
-        except Exception:
-            if attempt == 2:
-                raise
-            import traceback
+_enable_compile_cache()          # at import: phases are callable directly
 
-            traceback.print_exc()
-            print("bench: engine phase failed; retrying once",
-                  flush=True)
-    kv_stats = asyncio.run(bench_kv_transfer(cfg))
-    loop_tok_s, loop_step_s = run_device_loop(cfg, params)
-    ms_per_step = 1000.0 * BATCH / tok_s  # engine wall per fused step
-    hbm = hbm_bytes_per_step(cfg, params)
-    print(json.dumps({
-        "metric": "engine_output_tokens_per_sec_per_chip",
-        "value": round(tok_s, 1),
-        "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / R1_DEVICE_LOOP_CEILING_TOK_S, 3),
-        "effective_ms_per_step": round(ms_per_step, 2),
-        "device_loop_tok_s": round(loop_tok_s, 1),
-        "vs_device_loop": round(tok_s / loop_tok_s, 3),
-        "device_ms_per_step": round(loop_step_s * 1000, 2),
-        "hbm_util_pct": round(
-            100.0 * hbm / loop_step_s / 1e9 / V5E_HBM_GBPS, 1),
-        "isl": ISL, "osl": OSL, "n_requests": N_REQS, "batch": BATCH,
-        "quantize": QUANTIZE,
-        "ttft_ms_unloaded_p50": round(ttft_ms, 1),
-        "phase_tok_s": [round(r, 1) for r in phase_rates],
-        **kv_stats,
-    }))
+
+def main():
+    skip = set(filter(None,
+                      os.environ.get("DYN_BENCH_SKIP", "").split(",")))
+    out = {"metric": "engine_output_tokens_per_sec_per_chip",
+           "unit": "tok/s/chip"}
+
+    def run(name, coro_fn, retries=1):
+        if name in skip:
+            return {"skipped": True}
+        for attempt in range(retries + 1):
+            try:
+                return asyncio.run(coro_fn())
+            except Exception as e:
+                import traceback
+
+                traceback.print_exc()
+                if attempt == retries:
+                    return {"error": f"{type(e).__name__}: {e}"}
+                print(f"bench: phase {name} failed; retrying",
+                      flush=True)
+
+    # the tunneled chip occasionally drops one call mid-run; each phase
+    # retries once rather than record a broken round
+    short = run("short", phase_short)
+    out.update(short if "error" not in short and "skipped" not in short
+               else {"value": 0.0, "vs_baseline": 0.0,
+                     "short_error": short.get("error", "skipped")})
+    out["long"] = run("long", phase_long)
+    out["ckpt"] = run("ckpt", phase_ckpt)
+    kv = run("kv", phase_kv)
+    out.update(kv if "error" not in kv and "skipped" not in kv
+               else {"kv_error": kv.get("error", "skipped")})
+    print(json.dumps(out), flush=True)
+    # a timed-out phase may leave a to_thread worker blocked on a hung
+    # device op; a normal interpreter exit would join it forever
+    os._exit(0)
 
 
 if __name__ == "__main__":

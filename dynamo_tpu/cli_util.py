@@ -57,6 +57,29 @@ def setup_logging(level: str) -> None:
     init_logging(level.upper())
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compile cache (DYN_COMPILE_CACHE dir; empty string
+    disables). A cold 8B engine pays ~18 min of remote compiles for its
+    serving shapes on v5e; with the cache a restarted worker pays
+    seconds. Called by worker startup; safe no-op if jax lacks it."""
+    import os
+
+    path = os.environ.get("DYN_COMPILE_CACHE",
+                          os.path.expanduser("~/.cache/dynamo_tpu/xla"))
+    if not path:
+        return
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:  # pragma: no cover - degraded, not fatal
+        logging.getLogger(__name__).warning(
+            "persistent compile cache unavailable", exc_info=True)
+
+
 def run_until_signal(main_coro_factory, *, shutdown=None) -> None:
     """asyncio.run a service until SIGINT/SIGTERM.
 

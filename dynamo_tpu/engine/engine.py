@@ -131,9 +131,11 @@ class TpuEngineConfig:
     # step runs SPMD over it. One engine = one rank's (sub)mesh; dp ranks
     # each own a disjoint tp submesh (WorkerWithDpRank addressing).
     mesh: Optional[Any] = None
-    # Weight quantization: None (bf16) or "int8" (per-channel weight-only,
-    # engine/quant.py). Halves the decode weight-stream floor; applied
-    # device-side with donation after params are placed.
+    # Weight quantization: None (bf16), "int8", or "int4" (per-channel
+    # weight-only, engine/quant.py; int4 packs two nibbles per byte via
+    # jnp.int4 — lm_head stays int8 for logit quality). Cuts the decode
+    # weight-stream floor 2×/4×; applied device-side with donation after
+    # params are placed.
     quantize: Optional[str] = None
     # Speculative decoding (engine/spec.py): a small draft model proposes
     # spec_gamma tokens per iteration, the target verifies them in ONE
@@ -152,14 +154,24 @@ class TpuEngineConfig:
     # prompts (no cached prefix) whose uncached span exceeds sp_threshold
     # run ring-attention prefill over sp_mesh's "sp" axis; the
     # sequence-sharded KV is paged back into the cache and the tail (plus
-    # last-token logits) finishes through the normal chunk loop. Requires
-    # mesh=None (params are replicated onto sp_mesh; composing sp×tp on a
-    # 2-D mesh is the multi-host evolution point). sp_threshold=0 disables.
+    # last-token logits) finishes through the normal chunk loop.
+    # Two shapes: a 1-D ("sp",) mesh with mesh=None (weights replicated
+    # per ring chip — single-host long context), or a 2-D ("sp","tp")
+    # mesh composed with mesh= (weights megatron-sharded over tp,
+    # sequence over sp — the multi-host 70B shape; sp_mesh tp size must
+    # equal the engine mesh's). sp_threshold=0 disables.
     sp_mesh: Optional[Any] = None
     sp_threshold: int = 0
     # "contiguous" or "zigzag" (balanced causal ring; ~2× less attend
     # work — engine/ring_attention.py)
     sp_layout: str = "contiguous"
+    # Optional allowed prefill BATCH widths (ascending). Default None =
+    # every pow2 up to max_batch_size. Big models pay minutes of XLA
+    # compile PER prefill shape (an 8B (1, 256) chunk graph measured
+    # ~10 min on v5e over the tunnel); restricting to e.g. (1, 8) bounds
+    # the compile count at the cost of padded prefill FLOPs for
+    # mid-sized rounds.
+    prefill_batch_widths: Optional[tuple] = None
 
 
 @dataclass
@@ -181,6 +193,11 @@ class _Seq:
     next_token: int = -1                  # sampled, KV not yet written
 
     @property
+    def wants_topk(self) -> bool:
+        """True when this lane asked for top-k alternative logprobs."""
+        return self.req.sampling.top_logprobs > 0
+
+    @property
     def needs_constrained(self) -> bool:
         """True when this lane needs the constrained decode burst
         (grammar mask, min_p, or any sampling penalty)."""
@@ -189,6 +206,19 @@ class _Seq:
                 or sp.repetition_penalty != 1.0
                 or sp.frequency_penalty != 0.0
                 or sp.presence_penalty != 0.0)
+
+    @property
+    def spec_blocked(self) -> bool:
+        """True when this lane can NOT ride a spec burst. Narrower than
+        needs_constrained: guided lanes CAN (the spec kernel masks
+        draft proposals and verification through the DFA row); min_p /
+        penalties / top-k-logprob lanes still can't."""
+        sp = self.req.sampling
+        return (sp.min_p > 0.0
+                or sp.repetition_penalty != 1.0
+                or sp.frequency_penalty != 0.0
+                or sp.presence_penalty != 0.0
+                or self.wants_topk)
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
     finished: bool = False
@@ -225,9 +255,24 @@ class TpuEngine:
         # the caller's objects
         owned_params = params is None
         owned_draft = draft_params is None
+        def place_owned(p, owned: bool):
+            """Host (numpy) checkpoints must land on device ONCE at
+            init: a numpy leaf passed to a jitted step re-uploads on
+            EVERY call (jax does not cache host transfers), and over
+            the tunnel that is the whole weight set per burst. The
+            device copy is engine-owned, so quantization may donate
+            it — but only when the caller gave host arrays (device_put
+            of an already-device array is a no-op aliasing the
+            caller's buffer)."""
+            all_host = all(not hasattr(x, "devices")
+                           for x in jax.tree.leaves(p))
+            return jax.device_put(p), owned or all_host
+
         if cfg.mesh is None:
             if params is None:
                 params = init_params(jax.random.PRNGKey(cfg.rng_seed), mcfg)
+            else:
+                params, owned_params = place_owned(params, owned_params)
             self.params = params
             self.k_cache, self.v_cache = init_cache(mcfg, cfg.num_pages)
         else:
@@ -267,8 +312,11 @@ class TpuEngine:
                     "spec_gamma and spec_iters_per_sync must be >= 1")
             self._spec_stats = SpecDecodeStats()
             if cfg.mesh is None:
-                self.draft_params = draft_params if draft_params is not None \
-                    else init_params(
+                if draft_params is not None:
+                    self.draft_params, owned_draft = place_owned(
+                        draft_params, owned_draft)
+                else:
+                    self.draft_params = init_params(
                         jax.random.PRNGKey(cfg.rng_seed + 1), dm)
                 self.dk_cache, self.dv_cache = init_cache(dm, cfg.num_pages)
             else:
@@ -290,34 +338,77 @@ class TpuEngine:
                     out_shardings=cache_sharding(cfg.mesh),
                 )()
         if cfg.quantize:
-            if cfg.quantize != "int8":
+            if cfg.quantize not in ("int8", "int4"):
                 raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
-            from dynamo_tpu.engine.quant import quantize_params_jit
+            from dynamo_tpu.engine.quant import QTensor, quantize_params_jit
+
+            def pre_quantized(p) -> bool:
+                # already-QTensor params must SKIP the jit pass entirely:
+                # a non-donated identity jit COPIES the whole pytree on
+                # device (no aliasing without donation) — at 8B scale
+                # that transient doubles ~9 GB of weights and OOMs the
+                # chip
+                return isinstance(p.get("lm_head"), QTensor) or any(
+                    isinstance(v, QTensor) for v in p["layers"].values())
 
             # donation frees the bf16 buffers, but ONLY when the engine
             # created (or sharded-copied) them — donating caller-provided
             # device arrays would destroy the caller's objects (e.g. a
             # second engine built from the same params)
-            self.params = quantize_params_jit(self.params,
-                                              donate=owned_params)
-            if self.draft_params is not None:
+            if not pre_quantized(self.params):
+                self.params = quantize_params_jit(self.params,
+                                                  donate=owned_params,
+                                                  mode=cfg.quantize)
+            if self.draft_params is not None \
+                    and not pre_quantized(self.draft_params):
                 self.draft_params = quantize_params_jit(
-                    self.draft_params, donate=owned_draft)
+                    self.draft_params, donate=owned_draft,
+                    mode=cfg.quantize)
         self._sp_params = None
+        self._sp_tp = None     # "tp" when sp_mesh is 2-D ("sp", "tp")
         if cfg.sp_mesh is not None and cfg.sp_threshold > 0:
-            if cfg.mesh is not None:
-                raise ValueError(
-                    "sp_mesh requires mesh=None (sp×tp composition is not "
-                    "wired into the engine yet)")
             from jax.sharding import NamedSharding, PartitionSpec
 
-            self._sp_params = jax.device_put(
-                self.params, NamedSharding(cfg.sp_mesh, PartitionSpec()))
-            # weights must exist ONCE per chip: the single-device step
-            # functions reuse the ring's device-0 shard (a view of the
-            # same buffer) instead of keeping a second full copy resident
-            self.params = jax.tree.map(
-                lambda a: a.addressable_shards[0].data, self._sp_params)
+            if "tp" in cfg.sp_mesh.shape:
+                # 2-D sp×tp: ring prefill with megatron-tp-sharded
+                # weights — the multi-host long-context shape (weights
+                # don't fit one chip AND prompts don't fit one chip's
+                # activation memory). The engine's own mesh keeps
+                # serving decode; prefill borrows the wider sp×tp mesh.
+                if cfg.mesh is None:
+                    raise ValueError(
+                        "a 2-D ('sp','tp') sp_mesh requires mesh= (the "
+                        "tp-sharded serving mesh); use a 1-D ('sp',) "
+                        "mesh for replicated-weight rings")
+                eng_tp = dict(cfg.mesh.shape).get("tp", 1)
+                if cfg.sp_mesh.shape["tp"] != eng_tp:
+                    raise ValueError(
+                        f"sp_mesh tp={cfg.sp_mesh.shape['tp']} must "
+                        f"match the engine mesh tp={eng_tp} (same "
+                        f"per-shard weight layout)")
+                from dynamo_tpu.engine.sharding import shard_params
+
+                # specs only name "tp", so the sp axis replicates: each
+                # sp row holds the same tp-sharded weight layout the
+                # engine mesh uses (on shared devices this is the same
+                # bytes; extra sp rows pay the dp-replication cost
+                # multi-host serving pays anyway)
+                self._sp_params = shard_params(self.params, cfg.sp_mesh)
+                self._sp_tp = "tp"
+            else:
+                if cfg.mesh is not None:
+                    raise ValueError(
+                        "a 1-D sp_mesh replicates weights; with mesh= "
+                        "use a 2-D ('sp','tp') sp_mesh")
+                self._sp_params = jax.device_put(
+                    self.params,
+                    NamedSharding(cfg.sp_mesh, PartitionSpec()))
+                # weights must exist ONCE per chip: the single-device
+                # step functions reuse the ring's device-0 shard (a view
+                # of the same buffer) instead of a second full copy
+                self.params = jax.tree.map(
+                    lambda a: a.addressable_shards[0].data,
+                    self._sp_params)
         self.pool = PagePool(cfg.num_pages, self.model_cfg.page_size,
                              cfg.worker_id, cfg.dp_rank, event_sink)
         self.kvbm = None   # set by kvbm.KvbmManager when attached
@@ -760,6 +851,8 @@ class TpuEngine:
             if guided_mask is not None:
                 logits_stack = logits_stack + jax.numpy.asarray(
                     guided_mask)
+            tk = (self.TOPK_WIDTH
+                  if any(s.wants_topk for s in pending) else 0)
             sampled = sample_tokens_lp(
                 logits_stack,
                 arr(lambda s: s.seed, np.uint32),
@@ -767,14 +860,16 @@ class TpuEngine:
                 arr(lambda s: s.req.sampling.temperature, np.float32),
                 arr(lambda s: s.req.sampling.top_p, np.float32),
                 arr(lambda s: s.req.sampling.top_k, np.int32),
-                arr(lambda s: s.req.sampling.min_p, np.float32))
-            return np.asarray(sampled)                    # ONE host sync
+                arr(lambda s: s.req.sampling.min_p, np.float32),
+                topk_lp=tk)
+            return np.asarray(sampled), tk                # ONE host sync
 
         async with self._device_lock:
-            packed = await asyncio.to_thread(prefill_all)
+            packed, tk = await asyncio.to_thread(prefill_all)
         tokens = packed[0].astype(np.int32)
         logprobs = packed[1]
-        for seq, token, lp in zip(pending, tokens, logprobs):
+        for i, (seq, token, lp) in enumerate(zip(pending, tokens,
+                                                 logprobs)):
             # token_seq mirrors what prefill wrote to the device; register
             # every complete block this worker now holds (no-op for blocks
             # matched from already-registered shared pages)
@@ -785,7 +880,13 @@ class TpuEngine:
                     block.local_hash, block.parent_seq_hash)
             seq.prefilled = True
             seq.draft_pos = len(seq.prompt)
-            self._emit_token(seq, int(token), float(lp))
+            topk = None
+            if tk and seq.wants_topk:
+                width = min(seq.req.sampling.top_logprobs, tk)
+                topk = [[int(packed[2 + j, i]),
+                         float(packed[2 + tk + j, i])]
+                        for j in range(width)]
+            self._emit_token(seq, int(token), float(lp), topk=topk)
         return True
 
     # -- decode -------------------------------------------------------------
@@ -807,7 +908,7 @@ class TpuEngine:
         # batch-width): preemption inside the page-allocation loop below
         # can promote a later lane into the batch
         use_spec = self.draft_params is not None and all(
-            not s.needs_constrained for s in runnable)
+            not s.spec_blocked for s in runnable)
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
         # every runnable seq needs pages covering pos .. pos+k_steps-1
@@ -855,6 +956,9 @@ class TpuEngine:
                 batch.remove(s)
         if not batch:
             return True          # progressed: lanes finished with errors
+        # top-k alternatives ride the packed burst only when some lane
+        # asked (separate compiled variant; hot path unaffected)
+        tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
         max_pages = mcfg.max_pages_per_seq
         tokens = np.zeros(b, dtype=np.int32)
         positions = np.zeros(b, dtype=np.int32)
@@ -886,6 +990,18 @@ class TpuEngine:
                 # burst or its proposals attend garbage
                 await self._draft_catchup(stale)
 
+            use_guided = any(s.guided is not None for s in batch)
+            gkw = {}
+            if use_guided:
+                g_ids, g_states, stop_ids_a = \
+                    self._guided_lane_arrays(batch, b)
+                g_bits, g_next, g_eos_ok = self._guided_device_stack()
+                gkw = dict(use_guided=True, g_bits=g_bits, g_next=g_next,
+                           g_eos_ok=g_eos_ok,
+                           g_ids=jax.numpy.asarray(g_ids),
+                           g_states=jax.numpy.asarray(g_states),
+                           stop_ids=jax.numpy.asarray(stop_ids_a))
+
             def run_spec_burst():
                 packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
                     self.params, self.draft_params,
@@ -897,7 +1013,7 @@ class TpuEngine:
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
                     jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
                     mcfg, cfg.draft_model, cfg.spec_gamma,
-                    cfg.spec_iters_per_sync)
+                    cfg.spec_iters_per_sync, **gkw)
                 return np.asarray(packed), kc, vc, dk, dv  # ONE host sync
 
             async with self._device_lock:
@@ -935,12 +1051,8 @@ class TpuEngine:
             # slots are stable here: every batch grammar was registered
             # (and any backstop renumbering settled) at the top of
             # _decode_iter, before any lane arrays were built
-            slot_of = {id(s): self._guided_slot_of(s) for s in batch}
+            g_ids, g_states, stop_ids = self._guided_lane_arrays(batch, b)
             g_bits, g_next, g_eos_ok = self._guided_device_stack()
-            g_ids = np.zeros(b, dtype=np.int32)
-            g_states = np.zeros(b, dtype=np.int32)
-            stop_ids = np.full((b, self.GUIDED_STOP_WIDTH), -1,
-                               dtype=np.int32)
             min_ps = np.zeros(b, dtype=np.float32)
             rep_pens = np.ones(b, dtype=np.float32)
             freq_pens = np.zeros(b, dtype=np.float32)
@@ -948,10 +1060,6 @@ class TpuEngine:
             prompt_counts = np.zeros((b, V), dtype=np.int32)
             out_counts = np.zeros((b, V), dtype=np.int32)
             for i, s in enumerate(batch):
-                g_ids[i] = slot_of[id(s)]
-                g_states[i] = s.guided_state
-                for j, t in enumerate(self._guided_stop_ids(s)):
-                    stop_ids[i, j] = t
                 sp = s.req.sampling
                 min_ps[i] = sp.min_p
                 rep_pens[i] = sp.repetition_penalty
@@ -983,7 +1091,8 @@ class TpuEngine:
                     jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
                     jax.numpy.asarray(top_ps),
-                    jax.numpy.asarray(top_ks), mcfg, k_steps)
+                    jax.numpy.asarray(top_ks), mcfg, k_steps,
+                    topk_lp=tk)
 
             async with self._device_lock:
                 packed_dev, self.k_cache, self.v_cache = \
@@ -992,7 +1101,7 @@ class TpuEngine:
                 "k": k_steps, "batch": batch, "packed": packed_dev,
                 "positions": positions, "valid": valid, "seeds": seeds,
                 "steps": steps, "temps": temps, "top_ps": top_ps,
-                "top_ks": top_ks, "deferred": []}
+                "top_ks": top_ks, "tk": tk, "deferred": []}
             return await self._pipeline_consume()
 
         def run_burst():
@@ -1013,7 +1122,8 @@ class TpuEngine:
                     jax.numpy.asarray(out_counts),
                     g_bits, g_next, g_eos_ok, jax.numpy.asarray(g_ids),
                     jax.numpy.asarray(g_states),
-                    jax.numpy.asarray(stop_ids), mcfg, k_steps)
+                    jax.numpy.asarray(stop_ids), mcfg, k_steps,
+                    topk_lp=tk)
                 return np.asarray(sampled), kc, vc
             sampled, kc, vc = decode_multi_step(
                 self.params, self.k_cache, self.v_cache,
@@ -1021,24 +1131,29 @@ class TpuEngine:
                 jax.numpy.asarray(page_tables), jax.numpy.asarray(valid),
                 jax.numpy.asarray(seeds), jax.numpy.asarray(steps),
                 jax.numpy.asarray(temps), jax.numpy.asarray(top_ps),
-                jax.numpy.asarray(top_ks), mcfg, k_steps)
+                jax.numpy.asarray(top_ks), mcfg, k_steps, topk_lp=tk)
             return np.asarray(sampled), kc, vc            # ONE host sync
 
         async with self._device_lock:
             packed, self.k_cache, self.v_cache = \
                 await asyncio.to_thread(run_burst)
-        self._emit_burst(batch, packed, k_steps)
+        self._emit_burst(batch, packed, k_steps, tk)
         return True
 
     def _emit_burst(self, batch: list[_Seq], packed: np.ndarray,
-                    k_steps: int) -> None:
-        """Emit a consumed burst's tokens: packed (2, K, B) — ids f32 +
-        chosen logprobs. Overshoot past a lane's finish is discarded;
-        each consumed input token's block registration happens as its KV
-        becomes attributable (shared by the sync and pipelined paths so
-        their stop/overshoot semantics can never diverge)."""
+                    k_steps: int, tk: int = 0) -> None:
+        """Emit a consumed burst's tokens: packed (2 + 2*tk, K, B) — ids
+        f32 + chosen logprobs (+ top-k alternative ids/logprobs when tk).
+        Overshoot past a lane's finish is discarded; each consumed input
+        token's block registration happens as its KV becomes
+        attributable (shared by the sync and pipelined paths so their
+        stop/overshoot semantics can never diverge)."""
         sampled = packed[0].astype(np.int32)     # (K, B)
         logprobs = packed[1]                     # (K, B)
+        tk_ids = tk_lps = None
+        if tk:
+            tk_ids = packed[2:2 + tk].astype(np.int32)   # (tk, K, B)
+            tk_lps = packed[2 + tk:2 + 2 * tk]
         for i, s in enumerate(batch):
             for k in range(k_steps):
                 if s.finished or s not in self._running:
@@ -1049,8 +1164,14 @@ class TpuEngine:
                     self.pool.register_page(
                         s.pages[block.block_index], block.seq_hash,
                         block.local_hash, block.parent_seq_hash)
+                topk = None
+                if tk and s.wants_topk:
+                    width = min(s.req.sampling.top_logprobs, tk)
+                    topk = [[int(tk_ids[j, k, i]),
+                             float(tk_lps[j, k, i])]
+                            for j in range(width)]
                 self._emit_token(s, int(sampled[k, i]),
-                                 float(logprobs[k, i]))
+                                 float(logprobs[k, i]), topk=topk)
 
     def _sp_bulk_prefill(self, pending: list[_Seq],
                          offsets: dict[int, int]) -> None:
@@ -1086,13 +1207,28 @@ class TpuEngine:
             _, k_all, v_all = sp_prefill(self._sp_params, toks, mcfg,
                                          cfg.sp_mesh,
                                          layout=cfg.sp_layout,
-                                         kv_order="ring")
-            # gather the sequence-sharded KV onto the cache's device and
-            # scatter it into this sequence's pages. kv_order="ring":
-            # un-permuting BEFORE the gather would all-gather full-T KV
-            # onto every ring chip; instead permute locally post-gather
-            dev = list(self.k_cache[0].devices())[0]
-            k_all, v_all = jax.device_put((k_all[:, 0], v_all[:, 0]), dev)
+                                         kv_order="ring",
+                                         tp_axis=self._sp_tp)
+            # land the sequence-sharded KV on the cache's own sharding
+            # and scatter it into this sequence's pages. kv_order="ring":
+            # un-permuting BEFORE the reshard would all-gather full-T KV
+            # onto every ring chip; instead permute post-reshard, where
+            # T is no longer sp-sharded
+            if self._sp_tp is not None:
+                # tp-sharded cache: reshard (L, T, KVH, D) from
+                # (seq over sp, heads over tp) to the cache layout
+                # (heads over the engine mesh's tp, T whole) — one
+                # all-to-all-ish collective, inserted by XLA
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                tgt = NamedSharding(cfg.mesh,
+                                    PartitionSpec(None, None, "tp", None))
+                k_all, v_all = jax.device_put(
+                    (k_all[:, 0], v_all[:, 0]), tgt)
+            else:
+                dev = list(self.k_cache[0].devices())[0]
+                k_all, v_all = jax.device_put(
+                    (k_all[:, 0], v_all[:, 0]), dev)
             if cfg.sp_layout == "zigzag":
                 from dynamo_tpu.engine.ring_attention import (
                     zigzag_permutation,
@@ -1132,7 +1268,13 @@ class TpuEngine:
             # pow2 batch width: compiles stay bounded to log2 widths
             # per bucket while low-concurrency prefill (compute-bound,
             # unlike decode) avoids paying max_batch_size× the FLOPs
-            bp = _next_pow2(len(active), 1, cfg.max_batch_size)
+            if cfg.prefill_batch_widths:
+                bp = next((w for w in cfg.prefill_batch_widths
+                           if w >= len(active)),
+                          cfg.prefill_batch_widths[-1])
+                bp = min(bp, cfg.max_batch_size)
+            else:
+                bp = _next_pow2(len(active), 1, cfg.max_batch_size)
             active = active[:bp]
             chunk_lens = [min(target_len_of(s) - offsets[id(s)],
                               cfg.prefill_chunk) for s in active]
@@ -1163,6 +1305,13 @@ class TpuEngine:
         return kc, vc, last_logits
 
     # -- guided decoding ----------------------------------------------------
+
+    # Widest top-k alternatives the packed burst carries (OpenAI allows
+    # top_logprobs<=20 but >8 is vanishingly rare; the width is a compile
+    # shape, so it is fixed and requests are capped at the protocol
+    # layer). Lanes that don't ask pay nothing: the no-topk variant is a
+    # separate compiled burst.
+    TOPK_WIDTH = 8
 
     MAX_GUIDED_GRAMMARS = 32
     GUIDED_STOP_WIDTH = 8
@@ -1236,6 +1385,22 @@ class TpuEngine:
         import json as _json
 
         return _json.dumps(spec, sort_keys=True)
+
+    def _guided_lane_arrays(self, batch: list, b: int):
+        """(g_ids, g_states, stop_ids) numpy arrays for a burst's lanes
+        (slots must already be registered/settled for the batch) — the
+        ONE packing both the constrained and the spec-guided bursts use,
+        so their slot/state/stop semantics can never diverge."""
+        g_ids = np.zeros(b, dtype=np.int32)
+        g_states = np.zeros(b, dtype=np.int32)
+        stop_ids = np.full((b, self.GUIDED_STOP_WIDTH), -1,
+                           dtype=np.int32)
+        for i, s in enumerate(batch):
+            g_ids[i] = self._guided_slot_of(s)
+            g_states[i] = s.guided_state
+            for j, t in enumerate(self._guided_stop_ids(s)):
+                stop_ids[i, j] = t
+        return g_ids, g_states, stop_ids
 
     def _guided_unpend(self, key: str) -> None:
         """Release one pending ref taken in generate()."""
@@ -1416,7 +1581,7 @@ class TpuEngine:
                         jax.numpy.asarray(inf["temps"]),
                         jax.numpy.asarray(inf["top_ps"]),
                         jax.numpy.asarray(inf["top_ks"]),
-                        mcfg, k)
+                        mcfg, k, topk_lp=inf.get("tk", 0))
 
                 async with self._device_lock:
                     packed2, self.k_cache, self.v_cache = \
@@ -1426,13 +1591,14 @@ class TpuEngine:
                        "valid": inf["valid"], "seeds": inf["seeds"],
                        "steps": inf["steps"] + k, "temps": inf["temps"],
                        "top_ps": inf["top_ps"],
-                       "top_ks": inf["top_ks"], "deferred": []}
+                       "top_ks": inf["top_ks"],
+                       "tk": inf.get("tk", 0), "deferred": []}
         packed = await asyncio.to_thread(np.asarray, inf["packed"])
         # while the speculative burst runs, finished lanes' pages must
         # not return to the pool (the burst still writes to them)
         self._defer_releases = nxt["deferred"] if nxt is not None else None
         try:
-            self._emit_burst(batch, packed, k)
+            self._emit_burst(batch, packed, k, inf.get("tk", 0))
         finally:
             self._defer_releases = None
         for pages in inf["deferred"]:
@@ -1443,7 +1609,8 @@ class TpuEngine:
     # -- lifecycle helpers --------------------------------------------------
 
     def _emit_token(self, seq: _Seq, token: int,
-                    logprob: Optional[float] = None) -> None:
+                    logprob: Optional[float] = None,
+                    topk: Optional[list] = None) -> None:
         if seq.guided is not None:
             # authoritative DFA state lives host-side (device lane states
             # are re-seeded from it each burst, so overshoot discards and
@@ -1463,6 +1630,8 @@ class TpuEngine:
         out = EngineOutput(token_ids=[token], finish_reason=finish)
         if logprob is not None:
             out.log_probs = [logprob]
+        if topk is not None:
+            out.top_logprobs = [topk]
         exported = False
         if finish is not None and \
                 (seq.req.kv_transfer_params or {}).get("do_remote_decode"):

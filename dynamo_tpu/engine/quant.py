@@ -27,6 +27,7 @@ maps over q and s together), donation, and GSPMD sharding unchanged.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any
 
 import jax
@@ -72,12 +73,19 @@ class QTensor:
         return self.q.dtype
 
 
-def quantize(w: jax.Array) -> QTensor:
-    """Per-output-channel symmetric int8 over the contraction dim (-2)."""
+def quantize(w: jax.Array, bits: int = 8) -> QTensor:
+    """Per-output-channel symmetric int quantization over the
+    contraction dim (-2). bits=8 → int8; bits=4 → int4 (jnp.int4 —
+    XLA packs two nibbles per byte on TPU, halving weight HBM traffic
+    again at a larger rounding error: the decode lever the r2 ablation
+    named after int8)."""
+    assert bits in (8, 4), bits
     wf = jnp.asarray(w).astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
-    s = jnp.maximum(amax, 1e-12) / 127.0
-    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    qmax = (1 << (bits - 1)) - 1
+    s = jnp.maximum(amax, 1e-12) / qmax
+    dt = jnp.int8 if bits == 8 else jnp.int4
+    q = jnp.clip(jnp.round(wf / s), -qmax, qmax).astype(dt)
     return QTensor(q=q, s=s)
 
 
@@ -94,26 +102,89 @@ def qm(x: jax.Array, w: Any) -> jax.Array:
     return x @ w
 
 
-def quantize_params(params: dict, quantize_lm_head: bool = True) -> dict:
+# Above this vocab width the int8 lm_head matmul sends the XLA/Mosaic
+# compile into a tailspin (measured on v5e: an 8-layer llama3-8b decode
+# burst compiles in 9 s with a bf16 lm_head vs 168 s with int8 at
+# V=128256; V=32000 int8 is fine). The bf16 lm_head costs ~0.5 GB HBM
+# and ~1 ms/step on an 8B — the compile cliff costs minutes per shape.
+LM_HEAD_QUANT_MAX_VOCAB = 65536
+
+
+def _lm_head_quant_ok(w) -> bool:
+    return w.shape[-1] <= LM_HEAD_QUANT_MAX_VOCAB
+
+
+def _bits_of(mode) -> int:
+    return 4 if mode in (4, "int4") else 8
+
+
+def quantize_params(params: dict, quantize_lm_head: bool = True,
+                    mode: str = "int8") -> dict:
     """Quantize the llama-layout param pytree (models/llama.py init_params).
 
     Pure jnp — run under `jax.jit` (optionally with donation) so sharded
     params quantize in place on their devices without a host bounce.
+    Idempotent: leaves that are already QTensor pass through, so
+    host-pre-quantized checkpoints (quantize_params_host) can flow
+    through an engine configured with quantize="int8" unchanged.
     """
+    bits = _bits_of(mode)
     out = dict(params)
     out["layers"] = {
-        k: (quantize(v) if k in QUANT_KEYS else v)
+        k: (quantize(v, bits)
+            if k in QUANT_KEYS and not isinstance(v, QTensor) else v)
         for k, v in params["layers"].items()
     }
-    if quantize_lm_head and "lm_head" in params:
-        out["lm_head"] = quantize(params["lm_head"])
+    if quantize_lm_head and "lm_head" in params \
+            and not isinstance(params["lm_head"], QTensor) \
+            and _lm_head_quant_ok(params["lm_head"]):
+        # lm_head stays int8 even under int4: the output head is the
+        # quality-critical matmul and its rounding error lands directly
+        # on the logits
+        out["lm_head"] = quantize(params["lm_head"], 8)
     return out
 
 
-def quantize_params_jit(params: dict, donate: bool = True) -> dict:
+def quantize_host(w) -> QTensor:
+    """quantize() in host numpy: same scheme, no device involvement."""
+    import numpy as np
+
+    wf = np.asarray(w).astype(np.float32)
+    amax = np.max(np.abs(wf), axis=-2, keepdims=True)
+    s = np.maximum(amax, 1e-12) / 127.0
+    q = np.clip(np.rint(wf / s), -127, 127).astype(np.int8)
+    return QTensor(q=q, s=s)
+
+
+def quantize_params_host(params: dict,
+                         quantize_lm_head: bool = True) -> dict:
+    """Host-side int8 quantization of a loaded (numpy) checkpoint.
+
+    This is the independent REFERENCE implementation the differential
+    tests check the device paths against (tests/test_quant.py,
+    tests/test_weights.py) — production loads go through
+    models/loader.load_llama_params_device, which quantizes on the
+    accelerator (numpy over ml_dtypes bf16 is emulated and takes tens
+    of minutes at 8B scale on a small host)."""
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize_host(v)
+            if k in QUANT_KEYS and not isinstance(v, QTensor) else v)
+        for k, v in params["layers"].items()
+    }
+    if quantize_lm_head and "lm_head" in params \
+            and not isinstance(params["lm_head"], QTensor) \
+            and _lm_head_quant_ok(params["lm_head"]):
+        out["lm_head"] = quantize_host(params["lm_head"])
+    return out
+
+
+def quantize_params_jit(params: dict, donate: bool = True,
+                        mode: str = "int8") -> dict:
     """Device-side quantization; donates the bf16 buffers so peak memory
     is ~1.5× the bf16 params, not 2.5×."""
-    fn = jax.jit(quantize_params, donate_argnums=(0,) if donate else ())
+    fn = jax.jit(functools.partial(quantize_params, mode=mode),
+                 donate_argnums=(0,) if donate else ())
     return fn(params)
 
 

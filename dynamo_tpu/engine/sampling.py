@@ -132,15 +132,32 @@ def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
     return jnp.take_along_axis(logp, sampled[:, None], axis=-1)[:, 0]
 
 
+def topk_logprobs(logits: jax.Array, k: int) -> tuple[jax.Array,
+                                                      jax.Array]:
+    """((B, k) ids f32, (B, k) logprobs) of the k most likely tokens —
+    same log_softmax semantics as chosen_logprob (pre-sampling-filter
+    logits, matching OpenAI's 'model distribution' contract)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    vals, ids = jax.lax.top_k(logp, k)
+    return ids.astype(jnp.float32), vals
+
+
 def _sample_tokens_lp_traced(logits, seeds, steps, temperature, top_p,
-                             top_k, min_p=None):
-    """sample_tokens + chosen-token logprob, PACKED (2, B) f32 (token ids
-    exact in f32; one host transfer instead of two — the tunnel charges
-    per sync, not per byte)."""
+                             top_k, min_p=None, topk_lp: int = 0):
+    """sample_tokens + chosen-token logprob (+ optional top-k
+    alternatives), PACKED (2 + 2*topk_lp, B) f32 (token ids exact in
+    f32; one host transfer instead of two — the tunnel charges per
+    sync, not per byte). Rows: [sampled, chosen_lp, topk ids...,
+    topk lps...]."""
     sampled = sample_tokens_traced(logits, seeds, steps, temperature,
                                    top_p, top_k, min_p)
-    return jnp.stack([sampled.astype(jnp.float32),
-                      chosen_logprob(logits, sampled)])
+    rows = [sampled.astype(jnp.float32), chosen_logprob(logits, sampled)]
+    if topk_lp:
+        ids, vals = topk_logprobs(logits, topk_lp)
+        rows += [ids[:, i] for i in range(topk_lp)]
+        rows += [vals[:, i] for i in range(topk_lp)]
+    return jnp.stack(rows)
 
 
-sample_tokens_lp = jax.jit(_sample_tokens_lp_traced)
+sample_tokens_lp = jax.jit(_sample_tokens_lp_traced,
+                           static_argnames=("topk_lp",))

@@ -76,7 +76,8 @@ def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "draft_cfg", "gamma", "num_iters"),
+         static_argnames=("cfg", "draft_cfg", "gamma", "num_iters",
+                          "use_guided"),
          donate_argnums=(2, 3, 4, 5))
 def spec_decode_multi_step(
         params: dict, draft_params: dict,
@@ -86,12 +87,25 @@ def spec_decode_multi_step(
         valid: jax.Array, seeds: jax.Array, steps0: jax.Array,
         temperature: jax.Array, top_p: jax.Array, top_k: jax.Array,
         cfg: LlamaConfig, draft_cfg: LlamaConfig,
-        gamma: int, num_iters: int):
+        gamma: int, num_iters: int,
+        use_guided: bool = False,
+        g_bits=None, g_next=None, g_eos_ok=None,
+        g_ids=None, g_states=None, stop_ids=None):
     """`num_iters` fused draft→verify→accept iterations, ONE host sync.
 
     tokens/positions/valid/seeds/steps0/temperature: (B,). Pages for
     positions .. positions + num_iters*(gamma+1) - 1 must be
     pre-allocated in `page_tables` (engine guarantees).
+
+    use_guided: grammar-constrained lanes ride the spec burst — draft
+    proposals AND target verification distributions are masked by each
+    lane's DFA row (llm/guided.py tables; slot 0 = trivial grammar for
+    unguided lanes). The Leviathan test stays correct because draft and
+    target share the identical masked support, and the DFA state at
+    every verified position equals the draft's tentative state on the
+    accepted prefix (accepted tokens ARE the draft's proposals). Lane
+    stop tokens become legal where the grammar accepts (g_eos_ok), same
+    overlay as decode_multi_step_guided.
 
     Returns (packed (3, num_iters, gamma+1, B) f32, k_cache, v_cache,
     dk_cache, dv_cache, new_positions (B,)); packed rows: token ids /
@@ -101,9 +115,35 @@ def spec_decode_multi_step(
     B = tokens.shape[0]
     G1 = gamma + 1
     draft_seeds = seeds.astype(jnp.uint32) ^ _DRAFT_SEED_SALT
+    if use_guided:
+        V = cfg.vocab_size
+        byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
+        bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
+        is_stop = (jnp.arange(V, dtype=jnp.int32)[None, None, :]
+                   == stop_ids[:, :, None]).any(axis=1)    # (B, V)
+
+        def allow_rows(states):
+            rows = g_bits[g_ids, states]               # (B, ceil(V/8))
+            allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
+            return (allowed > 0) | (g_eos_ok[g_ids, states][:, None]
+                                    & is_stop)
+
+        def advance(states, toks_):
+            return g_next[g_ids, states, toks_].astype(jnp.int32)
+    else:
+        def allow_rows(states):
+            return None
+
+        def advance(states, toks_):
+            return states
+
+    def mask(logits, allow):
+        if allow is None:
+            return logits
+        return jnp.where(allow, logits, -1e30)
 
     def one_iter(it, carry):
-        cur, pos, kc, vc, dk, dv, steps, out = carry
+        cur, pos, kc, vc, dk, dv, steps, gst, out = carry
 
         # -- draft: gamma autoregressive proposals (its own small cache).
         # gamma+1 forwards: the last one's logits are unused but it WRITES
@@ -112,17 +152,22 @@ def spec_decode_multi_step(
         # later draft attention over it).
         d_tokens = [cur]
         d_probs = []
+        d_allows = []        # per-position grammar masks (guided only)
+        d_states = [gst]     # DFA state BEFORE sampling position j+1
         dtok = cur
+        st = gst
         for j in range(gamma + 1):
             dlogits, dk, dv = _decode_once(
                 draft_params, dk, dv, dtok, pos + j, page_tables, valid,
                 draft_cfg)
             if j == gamma:
                 break
-            dp = _lane_probs(dlogits, temperature, top_p, top_k)
+            allow_j = allow_rows(st)
+            dp = _lane_probs(mask(dlogits, allow_j), temperature, top_p,
+                             top_k)
             key = jax.vmap(
-                lambda s, st: jax.random.fold_in(
-                    jax.random.fold_in(jax.random.PRNGKey(s), st),
+                lambda s, st_: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(s), st_),
                     jnp.uint32(j))
             )(draft_seeds, steps)
             stoch = jax.vmap(_categorical)(key, dp)
@@ -130,6 +175,9 @@ def spec_decode_multi_step(
                              jnp.argmax(dp, axis=-1)).astype(jnp.int32)
             d_tokens.append(dtok)
             d_probs.append(dp)
+            d_allows.append(allow_j)
+            st = advance(st, dtok)
+            d_states.append(st)
         verify_toks = jnp.stack(d_tokens, axis=1)          # (B, G1)
         draft_p = jnp.stack(d_probs, axis=1)               # (B, gamma, V)
 
@@ -138,6 +186,13 @@ def spec_decode_multi_step(
         x, kc, vc = paged_forward(params, kc, vc, verify_toks, page_tables,
                                   pos, seq_lens, cfg, False)
         logits = qm(x, params["lm_head"]).astype(jnp.float32)  # (B, G1, V)
+        if use_guided:
+            # mask position i by the state reached after the accepted
+            # prefix — identical to the draft's tentative state there
+            allow_all = jnp.stack(
+                d_allows + [allow_rows(d_states[gamma])],
+                axis=1)                                    # (B, G1, V)
+            logits = jnp.where(allow_all, logits, -1e30)
         target_p = _lane_probs(logits, temperature, top_p, top_k)
 
         # -- acceptance ----------------------------------------------------
@@ -198,12 +253,24 @@ def spec_decode_multi_step(
 
         last = emitted[jnp.arange(B), n_acc]
         new_pos = jnp.where(valid, pos + count, pos)
+        if use_guided:
+            # state after the accepted prefix, advanced by the extra
+            # token (d_states[i] = state before sampling position i+1)
+            states_stack = jnp.stack(d_states, axis=1)     # (B, G1)
+            st_at_n = jnp.take_along_axis(
+                states_stack, n_acc[:, None], axis=1)[:, 0]
+            new_gst = advance(st_at_n, last)
+        else:
+            new_gst = gst
         return (last, new_pos, kc, vc, dk, dv,
-                steps + count.astype(jnp.uint32), out)
+                steps + count.astype(jnp.uint32), new_gst, out)
 
     out0 = jnp.zeros((3, num_iters, G1, B), dtype=jnp.float32)
-    cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, out = lax.fori_loop(
+    gst0 = (g_states.astype(jnp.int32) if use_guided
+            else jnp.zeros((B,), jnp.int32))
+    (cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, _,
+     out) = lax.fori_loop(
         0, num_iters, one_iter,
         (tokens, positions, k_cache, v_cache, dk_cache, dv_cache,
-         steps0.astype(jnp.uint32), out0))
+         steps0.astype(jnp.uint32), gst0, out0))
     return out, k_cache, v_cache, dk_cache, dv_cache, pos

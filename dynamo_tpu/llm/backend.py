@@ -88,11 +88,13 @@ class Backend(Operator):
             token_ids = out.get("token_ids", ())
             finish = out.get("finish_reason")
             in_lps = out.get("log_probs")
+            in_tops = out.get("top_logprobs")
             text_parts = []
             matched_stop = None
             hit_eos = False
             emitted_ids = []
             emitted_lps = [] if in_lps is not None else None
+            emitted_tops = [] if in_tops is not None else None
             for ti, t in enumerate(token_ids):
                 generated += 1
                 if t in eos_ids and not req.stop.ignore_eos:
@@ -105,6 +107,8 @@ class Backend(Operator):
                     # logprobs stay aligned with EMITTED tokens, not with
                     # whatever text happened to detokenize this frame
                     emitted_lps.append(in_lps[ti])
+                if emitted_tops is not None and ti < len(in_tops):
+                    emitted_tops.append(in_tops[ti])
                 delta = decode.step(t)
                 if delta:
                     emit, matched_stop = jail.feed(delta)
@@ -115,6 +119,8 @@ class Backend(Operator):
             def with_lps(d: dict) -> dict:
                 if emitted_lps is not None:
                     d["log_probs"] = emitted_lps
+                if emitted_tops is not None:
+                    d["top_logprobs"] = emitted_tops
                 return d
             if matched_stop is not None:
                 yield with_lps({"text": "".join(text_parts),

@@ -171,6 +171,7 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      draft_model: Optional[str] = None, spec_gamma: int = 4,
                      spec_iters_per_sync: int = 8, sp_degree: int = 0,
                      sp_threshold: int = 2048, sp_layout: str = "zigzag",
+                     prefill_batch_widths=None,
                      **model_overrides):
     """(TpuEngine, ModelDeploymentCard) for a real checkpoint.
 
@@ -196,7 +197,23 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
 
     path = resolve_model(model)
     cfg = config_from_hf(path, **model_overrides)
-    params = None if random_init else load_llama_params(path, cfg)
+    if random_init:
+        params = None
+    elif mesh is None:
+        # single-(sub)mesh engines load straight onto the device:
+        # transpose/cast/int8 run on the chip (loader docstring — the
+        # host-side path takes tens of minutes at 8B scale on a small
+        # host, and 8B bf16 wouldn't fit HBM un-quantized anyway). The
+        # engine's own device_put/quantize passes are no-ops on the
+        # result.
+        from dynamo_tpu.models.loader import load_llama_params_device
+
+        params = load_llama_params_device(path, cfg, quantize=quantize)
+    else:
+        # mesh path: host arrays; shard_params places per-shard and the
+        # engine quantizes in place (sharded bf16 fits per chip by
+        # construction)
+        params = load_llama_params(path, cfg)
     sp_mesh = None
     if sp_degree > 1:
         from dynamo_tpu.engine.ring_attention import sp_mesh as make_sp
@@ -229,7 +246,7 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
         def token_bytes(tok=tok, vocab=vocab):
             return token_bytes_of(tok, vocab)
 
-        eos_id = tok.eos_token_id() or 0
+        eos_id = tok.eos_token_id or 0     # property, NOT a method
     except Exception as e:  # pragma: no cover - degraded, not fatal
         import logging
 
@@ -245,7 +262,8 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                         spec_iters_per_sync=spec_iters_per_sync,
                         sp_mesh=sp_mesh,
                         sp_threshold=sp_threshold if sp_mesh else 0,
-                        sp_layout=sp_layout),
+                        sp_layout=sp_layout,
+                        prefill_batch_widths=prefill_batch_widths),
         params=params, draft_params=draft_params,
         token_bytes=token_bytes, eos_token_id=eos_id)
     if kvbm_host_blocks:

@@ -80,6 +80,15 @@ class OpenAIPreprocessor(Operator):
 
     # -- request path -------------------------------------------------------
 
+    def _token_text(self, tid: int) -> str:
+        """Display text of ONE token id (OpenAI logprobs entries).
+        Isolated decode — partial UTF-8 renders as replacement chars,
+        which is the standard contract for per-token strings."""
+        try:
+            return self.tokenizer.decode([tid])
+        except Exception:
+            return ""
+
     def preprocess_chat(self, req: ChatCompletionRequest,
                         image_tokens: Optional[dict] = None
                         ) -> PreprocessedRequest:
@@ -460,6 +469,18 @@ class OpenAIPreprocessor(Operator):
             for t in tasks:
                 t.cancel()
 
+    def _lp_entry(self, tid: int, lp: float, top) -> dict:
+        """One OpenAI chat logprobs.content[] entry."""
+        text = self._token_text(tid)
+        entry = {"token": text, "logprob": lp,
+                 "bytes": list(text.encode("utf-8"))}
+        if top is not None:
+            entry["top_logprobs"] = [
+                {"token": (t := self._token_text(int(aid))),
+                 "logprob": alp, "bytes": list(t.encode("utf-8"))}
+                for aid, alp in top]
+        return entry
+
     async def _chat_chunks(self, pre: PreprocessedRequest,
                            oai: ChatCompletionRequest, request_id: str,
                            created: int, context: Context
@@ -468,17 +489,30 @@ class OpenAIPreprocessor(Operator):
         completion_tokens = 0
         yield chat_chunk(request_id, oai.model, created, role="assistant")
         finish: Optional[str] = None
+        # entries buffer while text is held back (stop-jail, multibyte
+        # holdback) — same gating as the completions path
+        want_lps = bool(oai.logprobs)
+        pending: list[dict] = []
         async for out in self.inner.generate(pre.to_dict(), context):
-            completion_tokens += len(out.get("token_ids", ()))
+            ids = out.get("token_ids", ())
+            completion_tokens += len(ids)
             text = out.get("text", "")
             finish = out.get("finish_reason")
+            if want_lps and out.get("log_probs"):
+                tops = out.get("top_logprobs") or [None] * len(ids)
+                for tid, lp, top in zip(ids, out["log_probs"], tops):
+                    pending.append(self._lp_entry(tid, lp, top))
             if text:
-                yield chat_chunk(request_id, oai.model, created, content=text)
+                entries, pending = (pending, []) if want_lps else (None,
+                                                                   None)
+                yield chat_chunk(request_id, oai.model, created,
+                                 content=text, logprob_content=entries)
             if finish:
                 break
         yield chat_chunk(
             request_id, oai.model, created, finish_reason=finish or "stop",
-            usage=usage_dict(prompt_tokens, completion_tokens))
+            usage=usage_dict(prompt_tokens, completion_tokens),
+            logprob_content=(pending or None) if want_lps else None)
 
     async def _postprocess_completion(self, pre: PreprocessedRequest,
                                       oai: CompletionRequest, request_id: str,
@@ -513,22 +547,48 @@ class OpenAIPreprocessor(Operator):
         # text is held back (stop-jail, multibyte holdback) still carry
         # token logprobs — buffer them until a chunk flows.
         want_lps = oai.logprobs is not None
+        want_top = bool(oai.logprobs)      # logprobs=N>0: N alternatives
         pending_lps: list[float] = []
+        pending_toks: list[str] = []
+        pending_tops: list[dict] = []
+
+        def drain():
+            nonlocal pending_lps, pending_toks, pending_tops
+            lps, pending_lps = pending_lps, []
+            toks, pending_toks = pending_toks, []
+            tops, pending_tops = pending_tops, []
+            return {"token_logprobs": lps or None, "tokens": toks or None,
+                    "top_logprobs": (tops or None) if want_top else None}
+
         async for out in self.inner.generate(pre.to_dict(), context):
-            completion_tokens += len(out.get("token_ids", ()))
+            ids = out.get("token_ids", ())
+            completion_tokens += len(ids)
             text = out.get("text", "")
             finish = out.get("finish_reason")
             if want_lps and out.get("log_probs"):
                 pending_lps.extend(out["log_probs"])
+                for ti, tid in enumerate(ids[:len(out["log_probs"])]):
+                    tok_text = self._token_text(tid)
+                    pending_toks.append(tok_text)
+                    if want_top:
+                        top = (out.get("top_logprobs") or [])
+                        alts = top[ti] if ti < len(top) else None
+                        d: dict = {}
+                        for a, lp in (alts or []):
+                            t = self._token_text(int(a))
+                            # distinct ids can decode to the same text
+                            # (partial UTF-8 → U+FFFD); keep the
+                            # higher-ranked alternative, never overwrite
+                            if t not in d:
+                                d[t] = lp
+                        pending_tops.append(d)
             if text:
-                lps = None
-                if want_lps:
-                    lps, pending_lps = pending_lps, []
+                kw = drain() if want_lps else {}
                 yield completion_chunk(request_id, oai.model, created,
-                                       text, token_logprobs=lps)
+                                       text, **kw)
             if finish:
                 break
+        tail = drain() if want_lps else {}
         yield completion_chunk(
             request_id, oai.model, created, "", finish_reason=finish or "stop",
-            usage=usage_dict(prompt_tokens, completion_tokens),
-            token_logprobs=(pending_lps or None) if want_lps else None)
+            usage=usage_dict(prompt_tokens, completion_tokens), **tail)

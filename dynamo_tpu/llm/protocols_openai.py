@@ -38,6 +38,12 @@ class OpenAIError(ValueError):
 # a batch slot — an uncapped n is a single-request denial of service
 MAX_N = 16
 
+# widest top-k logprob alternatives served (engine TOPK_WIDTH: the
+# packed-burst row count is a compile shape, so the cap is part of the
+# protocol contract; OpenAI itself allows <=20 but >8 is vanishingly
+# rare)
+MAX_TOP_LOGPROBS = 8
+
 
 def _require(cond: bool, msg: str) -> None:
     if not cond:
@@ -95,6 +101,7 @@ class ChatCompletionRequest:
     ignore_eos: bool = False             # extension (nvext in reference)
     min_tokens: Optional[int] = None
     logprobs: bool = False
+    top_logprobs: int = 0                # alternatives per token (<=8)
     n: int = 1
     # Guided decoding (reference GuidedDecodingOptions / common_ext.rs):
     # from `response_format` (json_object / json_schema) or nvext
@@ -119,6 +126,12 @@ class ChatCompletionRequest:
             stop = [stop]
         nvext = d.get("nvext") or {}
         max_tokens = d.get("max_tokens", d.get("max_completion_tokens"))
+        top_lps = int(d.get("top_logprobs") or 0)
+        _require(0 <= top_lps <= MAX_TOP_LOGPROBS,
+                 f"'top_logprobs' must be between 0 and "
+                 f"{MAX_TOP_LOGPROBS}")
+        _require(top_lps == 0 or bool(d.get("logprobs")),
+                 "'top_logprobs' requires 'logprobs': true")
         return cls(
             model=d["model"], messages=msgs, stream=bool(d.get("stream")),
             max_tokens=max_tokens,
@@ -131,7 +144,8 @@ class ChatCompletionRequest:
             ignore_eos=bool(d.get("ignore_eos",
                                   nvext.get("ignore_eos", False))),
             min_tokens=d.get("min_tokens"),
-            logprobs=bool(d.get("logprobs")), n=int(d.get("n", 1)),
+            logprobs=bool(d.get("logprobs")),
+            top_logprobs=top_lps, n=int(d.get("n", 1)),
             guided=_guided_from(d, nvext),
             raw=d,
         )
@@ -154,6 +168,14 @@ class ChatCompletionRequest:
             s.seed = int(self.seed)
         if self.guided is not None:
             s.guided = self.guided
+        tl = getattr(self, "top_logprobs", None)
+        if tl is None or isinstance(tl, bool):
+            tl = 0
+        if not tl and isinstance(getattr(self, "logprobs", None), int) \
+                and not isinstance(self.logprobs, bool):
+            # completions API: logprobs=N means N alternatives per token
+            tl = int(self.logprobs)
+        s.top_logprobs = int(tl)
         return s
 
     def stop_conditions(self) -> StopConditions:
@@ -200,6 +222,9 @@ class CompletionRequest:
         if isinstance(stop, str):
             stop = [stop]
         nvext = d.get("nvext") or {}
+        lps = d.get("logprobs")
+        _require(lps is None or 0 <= int(lps) <= MAX_TOP_LOGPROBS,
+                 f"'logprobs' must be between 0 and {MAX_TOP_LOGPROBS}")
         return cls(
             model=d["model"], prompt=prompt, stream=bool(d.get("stream")),
             max_tokens=d.get("max_tokens"), temperature=d.get("temperature"),
@@ -242,17 +267,23 @@ def new_request_id(prefix: str = "chatcmpl") -> str:
 def chat_chunk(request_id: str, model: str, created: int,
                content: Optional[str] = None, role: Optional[str] = None,
                finish_reason: Optional[str] = None,
-               usage: Optional[dict] = None) -> dict:
+               usage: Optional[dict] = None,
+               logprob_content: Optional[list[dict]] = None) -> dict:
     delta: dict[str, Any] = {}
     if role is not None:
         delta["role"] = role
     if content is not None:
         delta["content"] = content
+    choice: dict[str, Any] = {"index": 0, "delta": delta,
+                              "finish_reason": finish_reason}
+    if logprob_content is not None:
+        # OpenAI chat logprobs: per-token entries with optional
+        # top_logprobs alternatives
+        choice["logprobs"] = {"content": logprob_content}
     out = {
         "id": request_id, "object": "chat.completion.chunk",
         "created": created, "model": model,
-        "choices": [{"index": 0, "delta": delta,
-                     "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if usage is not None:
         out["usage"] = usage
@@ -277,7 +308,8 @@ async def _fold_chunks(chunks: AsyncIterator[dict], on_choice) -> tuple:
 def chat_completion(request_id: str, model: str, created: int, text: str,
                     finish_reason: str, usage: dict,
                     tool_calls: Optional[list[dict]] = None,
-                    reasoning: str = "") -> dict:
+                    reasoning: str = "",
+                    logprob_content: Optional[list[dict]] = None) -> dict:
     message: dict[str, Any] = {"role": "assistant", "content": text}
     if tool_calls:
         # unary shape carries no streaming 'index' field
@@ -286,14 +318,17 @@ def chat_completion(request_id: str, model: str, created: int, text: str,
             for tc in tool_calls]
     if reasoning:
         message["reasoning_content"] = reasoning
+    choice: dict[str, Any] = {
+        "index": 0,
+        "message": message,
+        "finish_reason": finish_reason,
+    }
+    if logprob_content is not None:
+        choice["logprobs"] = {"content": logprob_content}
     return {
         "id": request_id, "object": "chat.completion", "created": created,
         "model": model,
-        "choices": [{
-            "index": 0,
-            "message": message,
-            "finish_reason": finish_reason,
-        }],
+        "choices": [choice],
         "usage": usage,
     }
 
@@ -301,11 +336,13 @@ def chat_completion(request_id: str, model: str, created: int, text: str,
 def completion_chunk(request_id: str, model: str, created: int, text: str,
                      finish_reason: Optional[str] = None,
                      usage: Optional[dict] = None,
-                     token_logprobs: Optional[list[float]] = None) -> dict:
+                     token_logprobs: Optional[list[float]] = None,
+                     tokens: Optional[list[str]] = None,
+                     top_logprobs: Optional[list[dict]] = None) -> dict:
     logprobs = None
     if token_logprobs is not None:
         logprobs = {"token_logprobs": token_logprobs,
-                    "tokens": None, "top_logprobs": None,
+                    "tokens": tokens, "top_logprobs": top_logprobs,
                     "text_offset": None}
     out = {
         "id": request_id, "object": "text_completion", "created": created,
@@ -320,11 +357,14 @@ def completion_chunk(request_id: str, model: str, created: int, text: str,
 
 def completion_response(request_id: str, model: str, created: int, text: str,
                         finish_reason: str, usage: dict,
-                        token_logprobs: Optional[list[float]] = None
+                        token_logprobs: Optional[list[float]] = None,
+                        tokens: Optional[list[str]] = None,
+                        top_logprobs: Optional[list[dict]] = None
                         ) -> dict:
     return completion_chunk(request_id, model, created, text,
                             finish_reason, usage,
-                            token_logprobs=token_logprobs)
+                            token_logprobs=token_logprobs,
+                            tokens=tokens, top_logprobs=top_logprobs)
 
 
 def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
@@ -377,7 +417,7 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
 
     def empty() -> dict:
         return {"text": [], "tool_calls": [], "reasoning": [],
-                "finish": "stop"}
+                "finish": "stop", "lp_content": None}
 
     def on_choice(i: int, choice: dict) -> None:
         st = per.setdefault(i, empty())
@@ -390,6 +430,9 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
             st["tool_calls"].append(tc)
         if delta.get("reasoning_content"):
             st["reasoning"].append(delta["reasoning_content"])
+        lp = choice.get("logprobs")
+        if lp and lp.get("content"):
+            st["lp_content"] = (st["lp_content"] or []) + lp["content"]
         if choice.get("finish_reason"):
             st["finish"] = choice["finish_reason"]
 
@@ -401,7 +444,8 @@ async def aggregate_chat_stream(chunks: AsyncIterator[dict]) -> dict:
         one = chat_completion(
             request_id, model, created, "".join(st["text"]), st["finish"],
             usage, tool_calls=st["tool_calls"],
-            reasoning="".join(st["reasoning"]))["choices"][0]
+            reasoning="".join(st["reasoning"]),
+            logprob_content=st["lp_content"])["choices"][0]
         one["index"] = i
         choices.append(one)
     return {"id": request_id, "object": "chat.completion",
@@ -416,7 +460,8 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
     per: dict[int, dict] = {}
 
     def empty() -> dict:
-        return {"text": [], "lps": [], "finish": "stop"}
+        return {"text": [], "lps": [], "toks": [], "tops": [],
+                "finish": "stop"}
 
     def on_choice(i: int, choice: dict) -> None:
         st = per.setdefault(i, empty())
@@ -425,6 +470,10 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
         lp = choice.get("logprobs")
         if lp and lp.get("token_logprobs"):
             st["lps"].extend(lp["token_logprobs"])
+            if lp.get("tokens"):
+                st["toks"].extend(lp["tokens"])
+            if lp.get("top_logprobs"):
+                st["tops"].extend(lp["top_logprobs"])
         if choice.get("finish_reason"):
             st["finish"] = choice["finish_reason"]
 
@@ -435,7 +484,9 @@ async def aggregate_completion_stream(chunks: AsyncIterator[dict]) -> dict:
         st = per.get(i, empty())
         one = completion_response(
             request_id, model, created, "".join(st["text"]), st["finish"],
-            usage, token_logprobs=st["lps"] or None)["choices"][0]
+            usage, token_logprobs=st["lps"] or None,
+            tokens=st["toks"] or None,
+            top_logprobs=st["tops"] or None)["choices"][0]
         one["index"] = i
         choices.append(one)
     return {"id": request_id, "object": "text_completion",

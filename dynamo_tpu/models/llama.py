@@ -368,7 +368,7 @@ def decode_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
                         page_tables, valid, cfg)
 
 
-@partial(jax.jit, static_argnames=("cfg", "num_steps"),
+@partial(jax.jit, static_argnames=("cfg", "num_steps", "topk_lp"),
          donate_argnums=(1, 2))
 def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
                       tokens: jax.Array, positions: jax.Array,
@@ -376,8 +376,9 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
                       seeds: jax.Array, steps0: jax.Array,
                       temperature: jax.Array, top_p: jax.Array,
                       top_k: jax.Array, cfg: LlamaConfig,
-                      num_steps: int) -> tuple[jax.Array, jax.Array,
-                                               jax.Array]:
+                      num_steps: int,
+                      topk_lp: int = 0) -> tuple[jax.Array, jax.Array,
+                                                 jax.Array]:
     """`num_steps` fused decode+sample iterations with ONE host round-trip.
 
     Host↔device syncs dominate decode latency (on a tunneled chip they are
@@ -388,11 +389,15 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
 
     Pages for positions..positions+num_steps-1 must be pre-allocated in
     `page_tables` (engine guarantees this). Returns
-    (packed (2, num_steps, B) f32, k_cache, v_cache) where packed[0] is
-    the sampled token ids (exact in f32: vocab « 2^24) and packed[1] the
-    chosen-token logprobs — PACKED so the host still pays exactly ONE
-    transfer per burst (a second np.asarray would cost another full
-    sync round-trip).
+    (packed (2 + 2*topk_lp, num_steps, B) f32, k_cache, v_cache) where
+    packed[0] is the sampled token ids (exact in f32: vocab « 2^24),
+    packed[1] the chosen-token logprobs, and rows 2..2+topk_lp /
+    2+topk_lp..2+2*topk_lp the top-k alternative ids/logprobs when
+    topk_lp > 0 — PACKED so the host still pays exactly ONE transfer
+    per burst (a second np.asarray would cost another full sync
+    round-trip). topk_lp is static: the engine compiles the top-k
+    variant only once some lane asks for alternatives, so the hot path
+    never pays the (B, V) top-k when nobody wants it.
     """
     from dynamo_tpu.engine.sampling import sample_tokens_traced
 
@@ -404,20 +409,27 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
             logits, seeds, steps0 + i, temperature, top_p, top_k)
         # chosen-token logprob: one extra (B, V) reduction pass — noise
         # next to the lm_head matmul that produced the logits
-        from dynamo_tpu.engine.sampling import chosen_logprob
+        from dynamo_tpu.engine.sampling import chosen_logprob, topk_logprobs
 
         chosen = chosen_logprob(logits, sampled)
         out = out.at[0, i].set(sampled.astype(jnp.float32))
         out = out.at[1, i].set(chosen)
+        if topk_lp:
+            ids, vals = topk_logprobs(logits, topk_lp)
+            out = lax.dynamic_update_slice(
+                out, ids.T[:, None, :], (2, i, 0))
+            out = lax.dynamic_update_slice(
+                out, vals.T[:, None, :], (2 + topk_lp, i, 0))
         return sampled, kc, vc, out
 
-    out0 = jnp.zeros((2, num_steps, tokens.shape[0]), dtype=jnp.float32)
+    out0 = jnp.zeros((2 + 2 * topk_lp, num_steps, tokens.shape[0]),
+                     dtype=jnp.float32)
     _, k_cache, v_cache, out = lax.fori_loop(
         0, num_steps, body, (tokens, k_cache, v_cache, out0))
     return out, k_cache, v_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "num_steps"),
+@partial(jax.jit, static_argnames=("cfg", "num_steps", "topk_lp"),
          donate_argnums=(1, 2))
 def decode_multi_step_guided(params: dict, k_cache, v_cache,
                              tokens: jax.Array, positions: jax.Array,
@@ -432,7 +444,7 @@ def decode_multi_step_guided(params: dict, k_cache, v_cache,
                              g_next: jax.Array, g_eos_ok: jax.Array,
                              g_ids: jax.Array, g_states: jax.Array,
                              stop_ids: jax.Array, cfg: LlamaConfig,
-                             num_steps: int):
+                             num_steps: int, topk_lp: int = 0):
     """The CONSTRAINED decode burst: `decode_multi_step` plus everything
     the plain hot path doesn't pay for — grammar masks, min_p, and the
     OpenAI/HF sampling penalties — enforced ON DEVICE so constrained
@@ -486,9 +498,21 @@ def decode_multi_step_guided(params: dict, k_cache, v_cache,
             valid.astype(counts.dtype))
         out = out.at[0, i].set(sampled.astype(jnp.float32))
         out = out.at[1, i].set(chosen)
+        if topk_lp:
+            # alternatives come from the same post-penalty post-mask
+            # logits the lane sampled from (what "the distribution"
+            # means for a constrained lane)
+            from dynamo_tpu.engine.sampling import topk_logprobs
+
+            tk_ids, tk_vals = topk_logprobs(logits, topk_lp)
+            out = lax.dynamic_update_slice(
+                out, tk_ids.T[:, None, :], (2, i, 0))
+            out = lax.dynamic_update_slice(
+                out, tk_vals.T[:, None, :], (2 + topk_lp, i, 0))
         return sampled, st, counts, kc, vc, out
 
-    out0 = jnp.zeros((2, num_steps, tokens.shape[0]), dtype=jnp.float32)
+    out0 = jnp.zeros((2 + 2 * topk_lp, num_steps, tokens.shape[0]),
+                     dtype=jnp.float32)
     _, _, _, k_cache, v_cache, out = lax.fori_loop(
         0, num_steps, body,
         (tokens, g_states.astype(jnp.int32), out_counts, k_cache,

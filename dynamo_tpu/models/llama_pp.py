@@ -10,10 +10,14 @@ Microbatches flow stage-to-stage via `lax.ppermute` one neighbor hop per
 step (ICI), with the classic GPipe schedule: S + M - 1 steps, stage s
 active on microbatch m at step s + m.
 
-Notes on scope: this is the PREFILL/forward pipeline. For decode, PP
-adds a per-token bubble that TP over ICI does not — on TPU pods TP (and
-SP for long context) is the preferred serving layout, so decode remains
-tp-sharded; PP exists for weight-capacity scaling and parity.
+Decode is pipelined too (`pp_decode_multi_step`): microbatches of
+lanes round-robin through the stages, each stage holding its layer
+slice's paged KV, with the sampled token fed back to stage 0 through a
+psum mailbox. PP still adds a per-token bubble TP over ICI does not —
+on TPU pods TP (and SP for long context) remains the preferred serving
+layout — but models whose weights exceed a TP slice's HBM can now
+serve BOTH phases under pp (requires n_micro >= n_stages to hide the
+feedback latency).
 
 All control flow is a `lax.scan` over the schedule with static shapes —
 nothing recompiles per microbatch count change except the schedule
@@ -32,9 +36,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.models.llama import (
     LlamaConfig,
+    _layer_params,
     _swiglu,
+    _write_kv,
     dense_attention,
     rms_norm,
+    rope,
 )
 
 
@@ -144,3 +151,189 @@ def pp_prefill_logits(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         is_leaf=lambda x: not isinstance(x, dict))
     out = _pp_prefill_jit(sharded_params, mb, cfg, mesh, axis, n_micro)
     return out[-1].reshape(B, cfg.vocab_size)
+
+
+# ---------------------------------------------------------------------------
+# decode pipeline
+# ---------------------------------------------------------------------------
+
+
+def pp_cache_specs() -> P:
+    """Paged KV caches stacked (L, KVH, N, P, D), layer axis over pp."""
+    return P("pp", None, None, None, None)
+
+
+def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
+                     page_tables, valid, seeds, steps0, temperature,
+                     top_p, top_k, cfg: LlamaConfig, axis: str,
+                     n_stages: int, n_micro: int, num_steps: int):
+    """Per-stage body. tokens0/positions/valid/seeds/steps0/temperature/
+    top_p/top_k: (M, Bm); page_tables: (M, Bm, max_pages); caches
+    (L_local, KVH, N, P, D) stage-local. Returns (2, num_steps, M, Bm)
+    sampled ids + chosen logprobs (real on the last stage) and the
+    updated caches."""
+    from dynamo_tpu.engine.attention import paged_attention_decode
+    from dynamo_tpu.engine.sampling import chosen_logprob, sample_tokens_traced
+
+    stage = lax.axis_index(axis)
+    M, Bm = tokens0.shape
+    E = cfg.hidden_size
+    L_local = k_cache.shape[0]
+    total = num_steps * n_micro
+
+    out0 = jnp.zeros((2, num_steps, M, Bm), jnp.float32)
+    x0 = jnp.zeros((Bm, E), cfg.dtype)
+    out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
+    perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def step(carry, r):
+        x_recv, mailbox, kc_all, vc_all, out = carry
+        p = r - stage
+        active = (p >= 0) & (p < total)
+        p_safe = jnp.clip(p, 0, total - 1)
+        k_idx = p_safe // n_micro
+        m = p_safe % n_micro
+        tok_m = lax.dynamic_index_in_dim(mailbox, m, 0, False)   # (Bm,)
+        pos_m = lax.dynamic_index_in_dim(positions, m, 0,
+                                         False) + k_idx
+        tbl_m = lax.dynamic_index_in_dim(page_tables, m, 0, False)
+        valid_m = lax.dynamic_index_in_dim(valid, m, 0, False) & active
+
+        x_in = jnp.where(stage == 0, params["embed"][tok_m], x_recv)
+        page_ids = jnp.take_along_axis(
+            tbl_m, (pos_m // cfg.page_size)[:, None], axis=1)[:, 0]
+        offsets = pos_m % cfg.page_size
+        lengths = jnp.where(valid_m, pos_m + 1, 0)
+        x = x_in
+        new_k, new_v = [], []
+        for l in range(L_local):
+            lp = _layer_params(params, l)
+            kc, vc = kc_all[l], vc_all[l]
+            hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+            q = qm(hn, lp["wq"]).reshape(Bm, cfg.num_heads, cfg.head_dim)
+            k = qm(hn, lp["wk"]).reshape(Bm, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            v = qm(hn, lp["wv"]).reshape(Bm, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            q = rope(q[:, None], pos_m[:, None], cfg.rope_theta)[:, 0]
+            k = rope(k[:, None], pos_m[:, None], cfg.rope_theta)[:, 0]
+            kc, vc = _write_kv(kc, vc, k, v, page_ids, offsets, valid_m)
+            attn = paged_attention_decode(
+                q, kc, vc, lengths, tbl_m, page_size=cfg.page_size)
+            x = x + qm(attn.reshape(Bm, -1), lp["wo"])
+            hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+            x = x + _swiglu(hn, lp)
+            new_k.append(kc)
+            new_v.append(vc)
+        kc_all = jnp.stack(new_k)
+        vc_all = jnp.stack(new_v)
+
+        xf = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = qm(xf, params["lm_head"]).astype(jnp.float32)
+        sampled = sample_tokens_traced(
+            logits,
+            lax.dynamic_index_in_dim(seeds, m, 0, False),
+            lax.dynamic_index_in_dim(steps0, m, 0, False) + k_idx,
+            lax.dynamic_index_in_dim(temperature, m, 0, False),
+            lax.dynamic_index_in_dim(top_p, m, 0, False),
+            lax.dynamic_index_in_dim(top_k, m, 0, False))
+        lp_chosen = chosen_logprob(logits, sampled)
+        write = active & (stage == n_stages - 1)
+
+        cur = lax.dynamic_slice(out, (0, k_idx, m, 0), (2, 1, 1, Bm))
+        upd = jnp.where(write,
+                        jnp.stack([sampled.astype(jnp.float32),
+                                   lp_chosen])[:, None, None, :],
+                        cur)
+        out = lax.dynamic_update_slice(out, upd, (0, k_idx, m, 0))
+        # feedback: the last stage's sampled token becomes microbatch
+        # m's next step-0 input on EVERY stage (psum broadcast — only
+        # the last stage contributes a delta)
+        delta = jnp.where(write, sampled - tok_m, 0)
+        delta_all = lax.psum(
+            jnp.zeros((M, Bm), jnp.int32)
+            .at[m].set(delta), axis)
+        mailbox = mailbox + delta_all
+        x_next = lax.ppermute(x, axis, perm_fwd)
+        return (x_next, mailbox, kc_all, vc_all, out), None
+
+    mailbox0 = lax.pcast(tokens0, (axis,), to='varying')
+    rounds = total + n_stages - 1
+    (_, _, k_cache, v_cache, out), _ = lax.scan(
+        step, (x0, mailbox0, k_cache, v_cache, out0),
+        jnp.arange(rounds))
+    return out[None], k_cache, v_cache
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "mesh", "axis", "n_micro",
+                                    "num_steps"),
+                   donate_argnums=(1, 2))
+def _pp_decode_jit(params, k_cache, v_cache, tokens, positions,
+                   page_tables, valid, seeds, steps0, temperature,
+                   top_p, top_k, cfg: LlamaConfig, mesh: Mesh, axis: str,
+                   n_micro: int, num_steps: int):
+    n_stages = mesh.shape[axis]
+    fn = jax.shard_map(
+        functools.partial(_pp_decode_local, cfg=cfg, axis=axis,
+                          n_stages=n_stages, n_micro=n_micro,
+                          num_steps=num_steps),
+        mesh=mesh,
+        in_specs=(pp_param_specs(), pp_cache_specs(), pp_cache_specs(),
+                  P(None, None), P(None, None), P(None, None, None),
+                  P(None, None), P(None, None), P(None, None),
+                  P(None, None), P(None, None), P(None, None)),
+        out_specs=(P(axis, None, None, None, None),
+                   pp_cache_specs(), pp_cache_specs()))
+    return fn(params, k_cache, v_cache, tokens, positions, page_tables,
+              valid, seeds, steps0, temperature, top_p, top_k)
+
+
+def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
+                         positions, page_tables, valid, seeds, steps0,
+                         temperature, top_p, top_k, cfg: LlamaConfig,
+                         mesh: Mesh, num_steps: int, n_micro: int = 2,
+                         axis: str = "pp"):
+    """Microbatched pipeline decode: `num_steps` fused decode+sample
+    steps for B lanes split into n_micro groups that round-robin
+    through the pp stages (GPipe schedule with a sampled-token feedback
+    mailbox). Greedy output is identical to `decode_multi_step` on the
+    same weights — the pipeline changes WHERE layers run, not what they
+    compute (tests/test_moe_pp.py proves token equality).
+
+    params: host/replicated-layout pytree (placed here with layer
+    stacks sharded over "pp"); k_cache/v_cache: (L, KVH, N, P, D)
+    stacked paged caches (sharded over "pp" on L); tokens/positions/
+    valid/seeds/steps0/temperature/top_p/top_k: (B,);
+    page_tables: (B, max_pages). B divisible by n_micro;
+    n_micro >= n_stages (the schedule needs a microbatch's step-k
+    token sampled before its step-k+1 slot reaches stage 0).
+
+    Returns (packed (2, num_steps, B) f32 — decode_multi_step's row
+    layout, k_cache, v_cache)."""
+    n_stages = mesh.shape[axis]
+    assert cfg.num_layers % n_stages == 0
+    assert n_micro >= n_stages, (
+        f"n_micro={n_micro} must be >= pp stages {n_stages}")
+    B = tokens.shape[0]
+    assert B % n_micro == 0, f"batch {B} not divisible by {n_micro}"
+    Bm = B // n_micro
+
+    def mb(a):
+        return a.reshape(n_micro, Bm, *a.shape[1:])
+
+    sharded_params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, pp_param_specs(),
+        is_leaf=lambda x: not isinstance(x, dict))
+    cache_ns = NamedSharding(mesh, pp_cache_specs())
+    k_cache = jax.device_put(k_cache, cache_ns)
+    v_cache = jax.device_put(v_cache, cache_ns)
+    out, k_cache, v_cache = _pp_decode_jit(
+        sharded_params, k_cache, v_cache, mb(tokens), mb(positions),
+        mb(page_tables), mb(valid), mb(seeds), mb(steps0),
+        mb(temperature), mb(top_p), mb(top_k), cfg, mesh, axis,
+        n_micro, num_steps)
+    # (S, 2, K, M, Bm) stacked over pp → last stage holds the real rows
+    packed = out[-1].reshape(2, num_steps, B)
+    return packed, k_cache, v_cache

@@ -179,6 +179,117 @@ def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
     return params
 
 
+def load_llama_params_device(path: str, cfg: LlamaConfig,
+                             quantize=False) -> dict:
+    """Checkpoint → DEVICE param pytree, transposing/casting/quantizing
+    on the accelerator.
+
+    Why not load_llama_params + placement: HF stores dense weights
+    (out, in); the host-side `.T` + contiguous copy over a 16 GB
+    checkpoint takes tens of minutes on a small host (strided bf16
+    copies), and a big model's bf16 can't be device-resident all at
+    once anyway (Llama-3-8B bf16 = 16 GB = a whole v5e). Here each raw
+    tensor is uploaded as stored, and transpose + cast (+ int8
+    quantization, keeping only the int8 on device) run on the chip;
+    per-layer results are stacked device-side. Peak HBM ≈ final params
+    + one layer's transients."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.quant import (
+        QUANT_KEYS,
+        _bits_of,
+        quantize as quant_fn,
+    )
+
+    bits = _bits_of(quantize)      # quantize: falsy | "int8" | "int4"
+
+    idx = _TensorIndex(path)
+    L = cfg.num_layers
+
+    @jax.jit
+    def prep_t(w):                      # (out, in) -> (in, out) cast
+        return jnp.transpose(w).astype(cfg.dtype)
+
+    @jax.jit
+    def prep(w):                        # cast only
+        return w.astype(cfg.dtype)
+
+    def dense(name, transpose=True):
+        t = jax.device_put(idx.get(name))
+        out = prep_t(t) if transpose else prep(t)
+        out.block_until_ready()         # bound transient HBM
+        return out
+
+    p = "model.layers.{}."
+    names = {
+        "wq": p + "self_attn.q_proj.weight",
+        "wk": p + "self_attn.k_proj.weight",
+        "wv": p + "self_attn.v_proj.weight",
+        "wo": p + "self_attn.o_proj.weight",
+        "w_gate": p + "mlp.gate_proj.weight",
+        "w_up": p + "mlp.up_proj.weight",
+        "w_down": p + "mlp.down_proj.weight",
+    }
+    from dynamo_tpu.engine.quant import QTensor
+
+    q_layer = jax.jit(functools.partial(quant_fn, bits=bits),
+                      donate_argnums=(0,))
+    import logging
+
+    _log = logging.getLogger(__name__)
+    layers: dict[str, Any] = {}
+    for key, fmt in names.items():
+        _log.info("loading %s (%d layers)", key, L)
+        if quantize and key in QUANT_KEYS:
+            # quantize per LAYER before stacking: transients stay int8
+            # (stacking 32 bf16 layers first would spike peak HBM past
+            # a 16 GB chip near the end of an 8B load)
+            qs, ss = [], []
+            for i in range(L):
+                qt = q_layer(dense(fmt.format(i)))
+                qt.q.block_until_ready()
+                qs.append(qt.q)
+                ss.append(qt.s)
+            layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss))
+            del qs, ss
+        else:
+            layers[key] = jnp.stack(
+                [dense(fmt.format(i)) for i in range(L)])
+    for key, fmt in (("attn_norm", p + "input_layernorm.weight"),
+                     ("mlp_norm", p + "post_attention_layernorm.weight")):
+        layers[key] = jnp.stack(
+            [jnp.asarray(idx.get(fmt.format(i)), dtype=jnp.float32)
+             for i in range(L)])
+    params: dict[str, Any] = {
+        "embed": dense("model.embed_tokens.weight", transpose=False),
+        "layers": layers,
+        "final_norm": jnp.asarray(idx.get("model.norm.weight"),
+                                  dtype=jnp.float32),
+    }
+    _log.info("loading embed/lm_head")
+    if "lm_head.weight" in idx:
+        lm = dense("lm_head.weight")
+    else:  # tie_word_embeddings
+        lm = jnp.transpose(params["embed"])
+    from dynamo_tpu.engine.quant import _lm_head_quant_ok
+
+    if quantize and _lm_head_quant_ok(lm):
+        # lm_head stays int8 even under int4 (logit quality)
+        qt = jax.jit(quant_fn, donate_argnums=(0,))(lm)
+        qt.q.block_until_ready()
+        params["lm_head"] = qt
+    else:
+        # big-vocab lm_head stays bf16: the int8 (E, 128k) matmul sends
+        # XLA/Mosaic compile into a tailspin (quant.py
+        # LM_HEAD_QUANT_MAX_VOCAB)
+        params["lm_head"] = lm
+    idx.close()
+    return params
+
+
 def load_model(name_or_path: str, **cfg_overrides: Any
                ) -> tuple[LlamaConfig, dict]:
     """(config, host params) for a local/cached checkpoint."""

@@ -42,6 +42,10 @@ def _rewrite(chunk: dict, *, content: Optional[str] = None,
              tool_calls: Optional[list[dict]] = None,
              finish_reason: Any = "__keep__") -> dict:
     out = copy.deepcopy(chunk)
+    # one incoming chunk may fan out into several rewrites (reasoning
+    # split, jail release) or none (held) — per-token logprob entries
+    # are re-attached EXACTLY ONCE by JailedStream.apply, never copied
+    out["choices"][0].pop("logprobs", None)
     delta: dict = {}
     role = out["choices"][0].get("delta", {}).get("role")
     if role:
@@ -72,6 +76,7 @@ class JailedStream:
         self._calls_emitted = False
         self._content_emitted = False  # any non-whitespace content sent
         self._call_index = 0      # streaming tool_calls index (per stream)
+        self._pending_lp: list[dict] = []  # logprob entries awaiting emit
         if tool_config is not None:
             self._matcher = MarkerMatcher(tool_config.json.start_tokens)
             self._end_matcher = MarkerMatcher(tool_config.json.end_tokens)
@@ -88,15 +93,37 @@ class JailedStream:
                 continue
             content = _delta_content(chunk)
             finish = choices[0].get("finish_reason")
+            self._collect_lp(chunk)
             if content:
-                for out in self._feed(chunk, content):
+                outs = self._feed(chunk, content)
+                self._attach_lp(outs)
+                for out in outs:
                     self._note_emitted(out)
                     yield out
             elif not finish:
-                yield chunk  # role-only prologue etc.
+                outs = [chunk]
+                self._attach_lp(outs)
+                yield outs[0]  # role-only prologue etc.
             if finish:
-                for out in self._flush(chunk, finish):
+                outs = self._flush(chunk, finish)
+                self._attach_lp(outs)
+                for out in outs:
                     yield out
+
+    def _collect_lp(self, chunk: dict) -> None:
+        # Buffer the incoming chunk's per-token logprob entries; they
+        # re-attach to the next chunk that actually flows (held-back
+        # text must not lose its entries, split chunks must not double
+        # them).
+        lp = (chunk.get("choices") or [{}])[0].get("logprobs")
+        if lp and lp.get("content"):
+            self._pending_lp.extend(lp["content"])
+
+    def _attach_lp(self, outs: list) -> None:
+        if self._pending_lp and outs:
+            outs[0]["choices"][0]["logprobs"] = {
+                "content": self._pending_lp}
+            self._pending_lp = []
 
     def _note_emitted(self, out: dict) -> None:
         if (out["choices"][0]["delta"].get("content") or "").strip():
@@ -191,8 +218,14 @@ class JailedStream:
         self._jail_buf = ""
         outs = []
         if not calls:
-            # closed but not a call: release the raw region and resume
-            outs.append(_rewrite(chunk, content=region))
+            # closed but not a call: release the region and resume. For
+            # marker-payload formats the RAW region is the honest
+            # content; harmony's channel framing is protocol, not
+            # content — release the parsed text instead
+            release = normal if self.tool_config.format == "harmony" \
+                else region
+            if release:
+                outs.append(_rewrite(chunk, content=release))
         else:
             if normal:
                 outs.append(_rewrite(chunk, content=normal))
@@ -236,8 +269,12 @@ class JailedStream:
                 out["choices"][0]["finish_reason"] = None
                 outs.append(out)
             elif self._jail_buf:
-                outs.append(_rewrite(finish_chunk, content=self._jail_buf,
-                                     finish_reason=None))
+                release = normal \
+                    if self.tool_config.format == "harmony" \
+                    else self._jail_buf
+                if release:
+                    outs.append(_rewrite(finish_chunk, content=release,
+                                         finish_reason=None))
             self._jailed = False
             self._jail_buf = ""
         elif leftover:
@@ -247,6 +284,11 @@ class JailedStream:
             out.pop("usage", None)
         final = copy.deepcopy(finish_chunk)
         final["choices"][0]["delta"] = {}
+        # the finish chunk's entries were already buffered by
+        # _collect_lp; keeping the original dict here would emit them
+        # TWICE whenever a leftover/tool-call chunk precedes `final`
+        # (apply's _attach_lp puts the pending entries on outs[0])
+        final["choices"][0].pop("logprobs", None)
         if self._calls_emitted:
             final["choices"][0]["finish_reason"] = "tool_calls"
         outs.append(final)

@@ -103,6 +103,27 @@ def _preset_pythonic() -> ToolCallConfig:
         end_tokens=["]", "<|python_end|>"]))
 
 
+def _preset_harmony() -> ToolCallConfig:
+    # gpt-oss harmony channel format (ref
+    # lib/parsers/src/tool_calling/harmony/harmony_parser.rs:30):
+    #   <|channel|>analysis<|message|>think...<|end|><|start|>assistant
+    #   <|channel|>commentary to=functions.NAME <|constrain|>json
+    #   <|message|>{json args}<|call|>
+    # Only assistant/commentary messages addressed to functions.* are
+    # tool calls; analysis/final content is normal text (the reasoning
+    # split is the gpt_oss reasoning parser's job).
+    # <|end|>/<|return|> also close the jail: a commentary PREAMBLE
+    # (no functions recipient) ends with <|end|> — without it the jail
+    # would buffer the whole rest of the response and kill streaming
+    return ToolCallConfig(format="harmony", allow_bare_json=False,
+                          json=JsonParserConfig(
+                              start_tokens=[
+                                  "<|start|>assistant<|channel|>commentary",
+                                  "<|channel|>commentary"],
+                              end_tokens=["<|call|>", "<|end|>",
+                                          "<|return|>"]))
+
+
 _PARSERS = {
     "default": ToolCallConfig,
     "hermes": _preset_hermes,
@@ -114,6 +135,8 @@ _PARSERS = {
     "deepseek_v3_1": _preset_deepseek,
     "pythonic": _preset_pythonic,
     "llama4_pythonic": _preset_pythonic,
+    "harmony": _preset_harmony,
+    "gpt_oss": _preset_harmony,
 }
 
 
@@ -207,6 +230,8 @@ def parse_tool_calls(text: str, config: Optional[ToolCallConfig] = None
     config = config or ToolCallConfig()
     if config.format == "pythonic":
         return _parse_pythonic(text, config)
+    if config.format == "harmony":
+        return _parse_harmony(text)
     return _parse_json(text, config)
 
 
@@ -270,6 +295,66 @@ def _parse_json(text: str, config: ToolCallConfig
     if not calls:
         return text, []  # looked like a call but wasn't: leave text alone
     return normal.strip(), calls
+
+
+_HARMONY_MSG = "<|message|>"
+_HARMONY_SEG_END = ("<|end|>", "<|call|>", "<|return|>")
+
+
+def _parse_harmony(text: str) -> tuple[str, list[ToolCall]]:
+    """Harmony channel messages → (normal_text, calls).
+
+    Segments are header<|message|>content pairs; a segment's content
+    runs to the next <|end|>/<|call|>/<|return|> (or EOF for a
+    still-streaming message). Headers naming `commentary` with a
+    `to=functions.NAME` recipient are tool calls; every other
+    channel's content (analysis, final, plain commentary preamble)
+    flows through as normal text."""
+    import re
+
+    normal_parts: list[str] = []
+    calls: list[ToolCall] = []
+    pos = 0
+    while True:
+        m = text.find(_HARMONY_MSG, pos)
+        if m < 0:
+            tail = text[pos:]
+            # leading/only segment with no channel framing at all
+            normal_parts.append(_strip_harmony_tokens(tail))
+            break
+        header = text[pos:m]
+        body_start = m + len(_HARMONY_MSG)
+        seg_end, end_tok = len(text), ""
+        for tok in _HARMONY_SEG_END:
+            p = text.find(tok, body_start)
+            if p >= 0 and p < seg_end:
+                seg_end, end_tok = p, tok
+        content = text[body_start:seg_end]
+        # text before the first <|channel|>/<|start|> marker in the
+        # header is normal output (content of the PREVIOUS unframed span)
+        frame = min((p for p in (header.find("<|channel|>"),
+                                 header.find("<|start|>")) if p >= 0),
+                    default=len(header))
+        normal_parts.append(header[:frame])
+        rec = re.search(r"to=functions\.([\w.-]+)", header[frame:])
+        if rec is not None and "commentary" in header[frame:]:
+            args = content.strip()
+            try:
+                json.loads(args)
+            except ValueError:
+                args = json.dumps({"value": args})
+            calls.append(ToolCall(name=rec.group(1), arguments=args))
+        else:
+            normal_parts.append(content)
+        pos = seg_end + len(end_tok)
+    return "".join(normal_parts).strip(), calls
+
+
+def _strip_harmony_tokens(s: str) -> str:
+    for tok in ("<|start|>assistant", "<|start|>", "<|end|>",
+                "<|return|>", "<|call|>"):
+        s = s.replace(tok, "")
+    return s
 
 
 def _call_from_obj(obj, jc: JsonParserConfig) -> Optional[ToolCall]:

@@ -171,6 +171,11 @@ class SamplingOptions:
     # one of {"regex": str} / {"choice": [str]} / {"json": true|schema}.
     # Enforced natively by the TPU engine (llm/guided.py DFA tables).
     guided: Optional[dict] = None
+    # Top-k alternative logprobs per emitted token (OpenAI
+    # `top_logprobs` / completions `logprobs=N`); 0 = chosen-token only.
+    # The engine packs the alternatives into the per-burst transfer
+    # (engine TOPK_WIDTH caps the width).
+    top_logprobs: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -249,6 +254,9 @@ class EngineOutput:
     finish_reason: Optional[str] = None
     cum_log_prob: Optional[float] = None
     log_probs: Optional[list[float]] = None
+    # per emitted token: [[token_id, logprob], ...] top-k alternatives
+    # (aligned with token_ids, like log_probs)
+    top_logprobs: Optional[list[list[list[float]]]] = None
     kv_transfer_params: Optional[dict] = None   # prefill → decode handoff
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -260,6 +268,8 @@ class EngineOutput:
             d["cum_log_prob"] = self.cum_log_prob
         if self.log_probs is not None:
             d["log_probs"] = self.log_probs
+        if self.top_logprobs is not None:
+            d["top_logprobs"] = self.top_logprobs
         if self.kv_transfer_params is not None:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.extra:
@@ -273,6 +283,7 @@ class EngineOutput:
             finish_reason=d.get("finish_reason"),
             cum_log_prob=d.get("cum_log_prob"),
             log_probs=d.get("log_probs"),
+            top_logprobs=d.get("top_logprobs"),
             kv_transfer_params=d.get("kv_transfer_params"),
             extra=d.get("extra", {}),
         )

@@ -71,7 +71,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--context-length", type=int, default=None,
                    help="override model context (max_pages_per_seq)")
-    p.add_argument("--quantize", default=None, choices=["int8"],
+    p.add_argument("--quantize", default=None, choices=["int8", "int4"],
                    help="weight-only quantization for the TPU engine")
     p.add_argument("--draft-model", default=None,
                    help="small checkpoint for speculative decoding")
@@ -280,6 +280,9 @@ def _multinode_mesh(args: argparse.Namespace):
 def main(argv=None) -> None:
     args = parse_args(argv)
     setup_logging(args.log_level)
+    from dynamo_tpu.cli_util import enable_compile_cache
+
+    enable_compile_cache()
 
     async def start():
         from dynamo_tpu.disagg.handlers import (

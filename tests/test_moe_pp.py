@@ -172,3 +172,111 @@ def test_pp_weights_are_stage_sharded(cpu_mesh_devices):
         NamedSharding(mesh, pp_param_specs()["layers"]["wq"]))
     # each stage holds exactly 1 of the 4 layers' weights
     assert {s.data.shape[0] for s in wq.addressable_shards} == {1}
+
+
+def test_pp_decode_matches_single_device_decode(cpu_mesh_devices):
+    """pp=2 microbatched decode emits tokens identical to the plain
+    fused decode loop on the same weights (greedy) — the VERDICT r3
+    'pp decode' done-criterion."""
+    from dynamo_tpu.engine.attention import set_attention_impl
+    from dynamo_tpu.models.llama import (
+        LlamaConfig,
+        decode_multi_step,
+        init_cache,
+        init_params,
+    )
+    from dynamo_tpu.models.llama_pp import pp_decode_multi_step
+
+    set_attention_impl("xla")
+    cfg = LlamaConfig.tiny(num_layers=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    B, K = 4, 6
+    n_pages = 1 + B * 4
+    tokens = np.asarray([7, 11, 13, 17], dtype=np.int32)
+    positions = np.zeros(B, dtype=np.int32)
+    tables = np.zeros((B, cfg.max_pages_per_seq), dtype=np.int32)
+    for i in range(B):
+        tables[i, :4] = 1 + 4 * i + np.arange(4)
+    valid = np.ones(B, dtype=bool)
+    z = np.zeros(B, dtype=np.uint32)
+    temps = np.zeros(B, dtype=np.float32)
+    tps = np.ones(B, dtype=np.float32)
+    tks = np.zeros(B, dtype=np.int32)
+
+    kc, vc = init_cache(cfg, n_pages)
+    ref, _, _ = decode_multi_step(
+        params, kc, vc, jnp.asarray(tokens), jnp.asarray(positions),
+        jnp.asarray(tables), jnp.asarray(valid), jnp.asarray(z),
+        jnp.asarray(z), jnp.asarray(temps), jnp.asarray(tps),
+        jnp.asarray(tks), cfg, K)
+    ref = np.asarray(ref)
+
+    mesh = Mesh(np.asarray(cpu_mesh_devices[:2]), axis_names=("pp",))
+    shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, cfg.page_size,
+             cfg.head_dim)
+    kc2 = jnp.zeros(shape, cfg.dtype)
+    vc2 = jnp.zeros(shape, cfg.dtype)
+    packed, kc2, vc2 = pp_decode_multi_step(
+        params, kc2, vc2, jnp.asarray(tokens), jnp.asarray(positions),
+        jnp.asarray(tables), jnp.asarray(valid), jnp.asarray(z),
+        jnp.asarray(z), jnp.asarray(temps), jnp.asarray(tps),
+        jnp.asarray(tks), cfg, mesh, K, n_micro=2)
+    got = np.asarray(packed)
+    np.testing.assert_array_equal(got[0], ref[0])
+    # logprobs see bf16 re-association across the stage split: tokens
+    # are bit-identical, the float diagnostics are merely close
+    np.testing.assert_allclose(got[1], ref[1], atol=5e-2)
+
+
+def test_pp_decode_stochastic_seeded_matches(cpu_mesh_devices):
+    """Seeded sampling through the pipeline consumes the same (seed,
+    step) stream as the plain loop. bf16 re-association across the
+    stage split can flip genuine near-ties (random tiny-model logits
+    are nearly flat), so assert strong agreement; the greedy test above
+    is the bit-exact one."""
+    from dynamo_tpu.engine.attention import set_attention_impl
+    from dynamo_tpu.models.llama import (
+        LlamaConfig,
+        decode_multi_step,
+        init_cache,
+        init_params,
+    )
+    from dynamo_tpu.models.llama_pp import pp_decode_multi_step
+
+    set_attention_impl("xla")
+    cfg = LlamaConfig.tiny(num_layers=2)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    B, K = 4, 5
+    n_pages = 1 + B * 4
+    tokens = np.asarray([3, 5, 7, 9], dtype=np.int32)
+    positions = np.zeros(B, dtype=np.int32)
+    tables = np.zeros((B, cfg.max_pages_per_seq), dtype=np.int32)
+    for i in range(B):
+        tables[i, :4] = 1 + 4 * i + np.arange(4)
+    valid = np.ones(B, dtype=bool)
+    seeds = np.arange(B, dtype=np.uint32) + 5
+    z = np.zeros(B, dtype=np.uint32)
+    temps = np.full(B, 0.9, dtype=np.float32)
+    tps = np.full(B, 0.9, dtype=np.float32)
+    tks = np.zeros(B, dtype=np.int32)
+
+    kc, vc = init_cache(cfg, n_pages)
+    ref, _, _ = decode_multi_step(
+        params, kc, vc, jnp.asarray(tokens), jnp.asarray(positions),
+        jnp.asarray(tables), jnp.asarray(valid), jnp.asarray(seeds),
+        jnp.asarray(z), jnp.asarray(temps), jnp.asarray(tps),
+        jnp.asarray(tks), cfg, K)
+    ref = np.asarray(ref)
+
+    mesh = Mesh(np.asarray(cpu_mesh_devices[:2]), axis_names=("pp",))
+    shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, cfg.page_size,
+             cfg.head_dim)
+    packed, _, _ = pp_decode_multi_step(
+        params, jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+        jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tables),
+        jnp.asarray(valid), jnp.asarray(seeds), jnp.asarray(z),
+        jnp.asarray(temps), jnp.asarray(tps), jnp.asarray(tks),
+        cfg, mesh, K, n_micro=4)
+    got = np.asarray(packed)[0]
+    agree = (got == ref[0]).mean()
+    assert agree >= 0.8, (agree, got, ref[0])

@@ -459,3 +459,208 @@ async def test_bare_list_released_when_not_a_call():
     assert "".join(texts) == "[1, 2, 3] is the list you wanted and more text"
     # streaming resumed immediately after release (not one flush blob)
     assert len(texts) >= 3
+
+
+# -- harmony (gpt-oss) ------------------------------------------------------
+# Reference fixtures mirror lib/parsers/src/tool_calling/harmony/
+# harmony_parser.rs:30 and reasoning/gpt_oss_parser.rs test strings.
+
+
+def test_harmony_tool_call_with_analysis_preamble():
+    from dynamo_tpu.parsers import get_tool_parser, parse_tool_calls
+
+    text = ("<|channel|>analysis<|message|>Need the weather — use "
+            "get_current_weather.<|end|><|start|>assistant<|channel|>"
+            "commentary to=functions.get_current_weather <|constrain|>json"
+            "<|message|>{\"location\": \"San Francisco\"}<|call|>")
+    normal, calls = parse_tool_calls(text, get_tool_parser("harmony"))
+    assert len(calls) == 1
+    assert calls[0].name == "get_current_weather"
+    assert json.loads(calls[0].arguments) == {"location": "San Francisco"}
+    # analysis content is normal text HERE (the reasoning split is the
+    # gpt_oss reasoning parser's job); no channel tokens may leak
+    assert "get_current_weather" not in calls[0].arguments
+    assert "<|" not in normal and "Need the weather" in normal
+
+
+def test_harmony_final_channel_is_normal_text():
+    from dynamo_tpu.parsers import get_tool_parser, parse_tool_calls
+
+    text = ("<|channel|>analysis<|message|>Capital question; easy."
+            "<|end|><|start|>assistant<|channel|>final<|message|>"
+            "The capital of Brazil is Brasília.<|return|>")
+    normal, calls = parse_tool_calls(text, get_tool_parser("harmony"))
+    assert calls == []
+    assert "The capital of Brazil is Brasília." in normal
+    assert "<|" not in normal
+
+
+def test_harmony_streaming_missing_call_token():
+    # a still-streaming tool call (no <|call|> yet) must still parse
+    from dynamo_tpu.parsers import get_tool_parser, parse_tool_calls
+
+    text = ("<|channel|>commentary to=functions.get_system_health "
+            "<|constrain|>json<|message|>{}")
+    normal, calls = parse_tool_calls(text, get_tool_parser("harmony"))
+    assert len(calls) == 1 and calls[0].name == "get_system_health"
+    assert calls[0].arguments == "{}"
+    assert normal == ""
+
+
+def test_harmony_parallel_calls_and_plain_commentary():
+    from dynamo_tpu.parsers import get_tool_parser, parse_tool_calls
+
+    text = ("<|channel|>commentary<|message|>Let me check two things."
+            "<|end|><|start|>assistant<|channel|>commentary "
+            "to=functions.a <|constrain|>json<|message|>{\"x\": 1}"
+            "<|call|><|start|>assistant<|channel|>commentary "
+            "to=functions.b <|constrain|>json<|message|>{\"y\": 2}"
+            "<|call|>")
+    normal, calls = parse_tool_calls(text, get_tool_parser("harmony"))
+    assert [c.name for c in calls] == ["a", "b"]
+    assert json.loads(calls[0].arguments) == {"x": 1}
+    assert json.loads(calls[1].arguments) == {"y": 2}
+    # commentary WITHOUT a functions recipient is user-visible preamble
+    assert "Let me check two things." in normal
+
+
+def test_harmony_detection_and_jail_end():
+    from dynamo_tpu.parsers import get_tool_parser
+    from dynamo_tpu.parsers.tool_calls import (
+        detect_tool_call_start,
+        find_tool_call_end,
+    )
+
+    cfg = get_tool_parser("harmony")
+    assert detect_tool_call_start("<|start|>assistant<|channel|>comm", cfg)
+    assert detect_tool_call_start("<|channel|>commentary to=", cfg)
+    assert not detect_tool_call_start("plain text {", cfg)
+    text = ("<|channel|>commentary to=functions.f <|constrain|>json"
+            "<|message|>{}<|call|>tail")
+    end = find_tool_call_end(text, cfg)
+    assert text[end:] == "tail"
+
+
+def test_harmony_non_function_recipient_not_a_call():
+    from dynamo_tpu.parsers import get_tool_parser, parse_tool_calls
+
+    text = ("<|channel|>commentary to=browser.open <|message|>"
+            "{\"url\": \"x\"}<|call|>")
+    normal, calls = parse_tool_calls(text, get_tool_parser("harmony"))
+    assert calls == []
+
+
+def _lp_chunk(content, n_entries, finish=None):
+    c = _chunk(content, finish=finish)
+    c["choices"][0]["logprobs"] = {
+        "content": [{"token": f"t{i}", "logprob": -0.5,
+                     "bytes": [116], "top_logprobs": []}
+                    for i in range(n_entries)]}
+    return c
+
+
+def _lp_entries(chunks):
+    out = []
+    for c in chunks:
+        lp = c["choices"][0].get("logprobs")
+        if lp and lp.get("content"):
+            out.extend(lp["content"])
+    return out
+
+
+async def test_jail_preserves_logprob_entries_exactly_once():
+    """A chunk split by the reasoning parser must not duplicate its
+    logprobs entries, and a chunk fully held back (partial marker) must
+    not lose them — they ride the next emitted chunk."""
+    from dynamo_tpu.parsers import get_reasoning_parser
+
+    js = JailedStream(tool_config=get_tool_parser("hermes"),
+                      reasoning=get_reasoning_parser(None))
+    chunks = [
+        _chunk(role="assistant"),
+        # splits into reasoning + content rewrites
+        _lp_chunk("<think>hm</think>hello ", 4),
+        # fully held back: partial tool marker
+        _lp_chunk("<tool", 1),
+        # resolves to plain text, carries the held entry + its own
+        _lp_chunk(" nope", 1),
+        _chunk(finish="stop"),
+    ]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _texts(outs) == "hello <tool nope"
+    entries = _lp_entries(outs)
+    assert len(entries) == 6, entries     # 4 + 1 + 1, no dup, no loss
+    # no single chunk carries the same entries twice
+    reasoning = "".join(c["choices"][0]["delta"].get("reasoning_content")
+                        or "" for c in outs)
+    assert reasoning == "hm"
+
+
+async def test_jail_logprobs_flush_on_finish():
+    """Entries still pending at stream end attach to a flush chunk."""
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    chunks = [
+        _lp_chunk("<tool_call>{\"name\": \"f\", \"arguments\": {}}", 3),
+        _chunk(finish="stop"),
+    ]
+    outs = await _collect(js.apply(_agen(chunks)))
+    calls = _tool_calls(outs)
+    assert len(calls) == 1
+    assert len(_lp_entries(outs)) == 3
+
+
+async def test_harmony_jail_preamble_streams_before_final():
+    """A commentary PREAMBLE (no functions recipient) closes at <|end|>
+    and must release mid-stream — the final answer streams normally,
+    not in one burst at finish."""
+    from dynamo_tpu.parsers import get_reasoning_parser
+
+    # harmony deployments pair the tool parser with the gpt_oss
+    # reasoning parser (which strips the final-channel framing)
+    js = JailedStream(tool_config=get_tool_parser("harmony"),
+                      reasoning=get_reasoning_parser("gpt_oss"))
+    chunks = [
+        _chunk("<|channel|>commentary<|message|>Let me check."),
+        _chunk("<|end|>"),
+        _chunk("<|start|>assistant<|channel|>final<|message|>The answer"),
+        _chunk(" is 42."),
+        _chunk(finish="stop"),
+    ]
+    outs = await _collect(js.apply(_agen(chunks)))
+    # the preamble must be released BEFORE the finish chunk arrives
+    texts_before_finish = "".join(
+        c["choices"][0]["delta"].get("content") or ""
+        for c in outs
+        if not c["choices"][0].get("finish_reason"))
+    assert "Let me check." in texts_before_finish
+    assert "The answer is 42." in _texts(outs)
+    assert _tool_calls(outs) == []
+    assert "<|" not in _texts(outs)
+
+
+async def test_harmony_jail_tool_call_stream():
+    js = JailedStream(tool_config=get_tool_parser("harmony"))
+    chunks = [
+        _chunk("<|channel|>commentary to=functions.get_weather "),
+        _chunk("<|constrain|>json<|message|>{\"city\": \"SF\"}"),
+        _chunk("<|call|>"),
+        _chunk(finish="stop"),
+    ]
+    outs = await _collect(js.apply(_agen(chunks)))
+    calls = _tool_calls(outs)
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+    assert outs[-1]["choices"][0]["finish_reason"] == "tool_calls"
+
+
+async def test_jail_finish_chunk_logprobs_not_duplicated():
+    """Entries arriving ON the finish chunk while text is jailed must
+    appear exactly once (the flush leftover carries them; the final
+    chunk must not repeat them)."""
+    js = JailedStream(tool_config=get_tool_parser("hermes"))
+    fin = _lp_chunk("", 2, finish="stop")
+    fin["choices"][0]["delta"] = {}
+    chunks = [_chunk("held <tool"), fin]
+    outs = await _collect(js.apply(_agen(chunks)))
+    assert _texts(outs) == "held <tool"
+    assert len(_lp_entries(outs)) == 2

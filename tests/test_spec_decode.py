@@ -190,3 +190,161 @@ async def test_draft_catchup_after_fallback_burst():
     # the spec path DID engage after the nucleus lane drained
     assert eng._spec_stats.num_draft_tokens > 0
     await eng.close()
+
+
+async def test_greedy_spec_with_guided_matches_constrained_engine():
+    """VERDICT r3: constrained lanes coexist in a spec burst. Greedy
+    spec+grammar output must equal the no-draft constrained engine's —
+    the draft only changes speed, never tokens, even under a mask."""
+    token_bytes = [bytes([i]) if i < 256 else None
+                   for i in range(CFG.vocab_size)]
+
+    async def run(draft):
+        eng = TpuEngine(TpuEngineConfig(
+            model=CFG, num_pages=96, max_batch_size=2,
+            default_max_tokens=12, decode_steps_per_sync=4,
+            draft_model=CFG if draft else None, spec_gamma=3,
+            spec_iters_per_sync=2),
+            draft_params=(init_params(jax.random.PRNGKey(7), CFG)
+                          if draft else None),
+            token_bytes=token_bytes, eos_token_id=0)
+        req = {"token_ids": list(PROMPT), "model": "m",
+               "sampling": {"temperature": 0.0,
+                            "guided": {"regex": "[a-f]{10}"}},
+               "stop": {"max_tokens": 12, "stop_token_ids": [0]}}
+        toks = []
+        async for o in eng.generate(req, Context()):
+            toks += o.get("token_ids", [])
+        stats = eng._spec_stats
+        await eng.close()
+        return toks, stats
+
+    base, _ = await run(draft=False)
+    spec, stats = await run(draft=True)
+    assert spec == base
+    assert stats.num_draft_tokens > 0          # spec actually engaged
+    body = bytes(t for t in spec if t != 0)
+    assert len(body) == 10 and all(97 <= c <= 102 for c in body), body
+
+
+async def test_spec_guided_mixed_batch_with_plain_lane():
+    """A guided lane and a plain sampled lane share one spec burst."""
+    token_bytes = [bytes([i]) if i < 256 else None
+                   for i in range(CFG.vocab_size)]
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2,
+        default_max_tokens=10, decode_steps_per_sync=4,
+        draft_model=CFG, spec_gamma=2, spec_iters_per_sync=2),
+        draft_params=init_params(jax.random.PRNGKey(3), CFG),
+        token_bytes=token_bytes, eos_token_id=0)
+
+    async def guided():
+        req = {"token_ids": [1, 2, 3], "model": "m",
+               "sampling": {"temperature": 0.7, "seed": 5,
+                            "guided": {"choice": ["abcd", "wxyz"]}},
+               "stop": {"max_tokens": 8, "stop_token_ids": [0]}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    async def plain():
+        req = {"token_ids": [9, 8, 7], "model": "m",
+               "sampling": {"temperature": 0.8, "seed": 11},
+               "stop": {"max_tokens": 8}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    g, p = await asyncio.gather(guided(), plain())
+    body = bytes(t for t in g if t != 0)
+    assert body in (b"abcd", b"wxyz"), body
+    assert len(p) == 8
+    assert eng._spec_stats.num_draft_tokens > 0
+    await eng.close()
+
+
+async def test_spec_sampled_distribution_matches_target_only():
+    """Leviathan correctness, measured: over many lanes/seeds, the
+    first spec-emitted token's empirical distribution must match
+    target-only sampling from the same filtered distribution (total
+    variation distance small). A biased acceptance rule shows up here
+    directly."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import filtered_probs
+    from dynamo_tpu.engine.spec import spec_decode_multi_step
+    from dynamo_tpu.models.llama import init_cache, prefill_step
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+    B = 64
+    reps = 4
+    prompt = [3, 1, 4, 1]        # page-aligned (page_size 4): lanes can
+    # share the READ-ONLY prompt page while writing their own proposals
+    # into per-lane pages (shared write pages would race across lanes)
+    n_pages = 2 + 2 * B
+    kc, vc = init_cache(CFG, num_pages=n_pages)
+    T = 8
+    padded = np.zeros(T, dtype=np.int32)
+    padded[:len(prompt)] = prompt
+    prefill_table = np.zeros(CFG.max_pages_per_seq, dtype=np.int32)
+    prefill_table[:2] = [1, 2]
+    logits, kc, vc = prefill_step(
+        params, kc, vc, jnp.asarray(padded), jnp.asarray(prefill_table),
+        jnp.int32(0), jnp.int32(len(prompt)), CFG)
+    dkc, dvc = init_cache(CFG, num_pages=n_pages)
+    _, dkc, dvc = prefill_step(
+        draft_params, dkc, dvc, jnp.asarray(padded),
+        jnp.asarray(prefill_table), jnp.int32(0),
+        jnp.int32(len(prompt)), CFG)
+    lane_tables = np.zeros((B, CFG.max_pages_per_seq), dtype=np.int32)
+    for i in range(B):
+        lane_tables[i, 0] = 1                    # shared prompt page
+        lane_tables[i, 1] = 3 + 2 * i            # private write pages
+        lane_tables[i, 2] = 4 + 2 * i
+    # the spec lanes are fed `cur` (position 4, KV unwritten); the first
+    # emitted token is drawn at position 5 — the reference distribution
+    # conditions on prompt + [cur]. top_k=8, temp 1.0: small support so
+    # B*reps samples resolve it.
+    del logits
+    cur = 7
+    temp, top_k = 1.0, 8
+    rkc, rvc = init_cache(CFG, num_pages=4)
+    padded5 = np.zeros(T, dtype=np.int32)
+    padded5[:5] = prompt + [cur]
+    ref_table = np.zeros(CFG.max_pages_per_seq, dtype=np.int32)
+    ref_table[:2] = [1, 2]
+    ref_logits, _, _ = prefill_step(
+        params, rkc, rvc, jnp.asarray(padded5), jnp.asarray(ref_table),
+        jnp.int32(0), jnp.int32(5), CFG)
+    ref = np.asarray(filtered_probs(
+        ref_logits[None].astype(jnp.float32), jnp.asarray([temp]),
+        jnp.asarray([1.0]), jnp.asarray([top_k])))[0]
+
+    counts = np.zeros(CFG.vocab_size)
+    n = 0
+    last_tok = cur
+    for r in range(reps):
+        # fresh caches each rep (donated by the spec call)
+        kc2 = tuple(jnp.array(x) for x in kc)
+        vc2 = tuple(jnp.array(x) for x in vc)
+        dkc2 = tuple(jnp.array(x) for x in dkc)
+        dvc2 = tuple(jnp.array(x) for x in dvc)
+        packed, *_ = spec_decode_multi_step(
+            params, draft_params, kc2, vc2, dkc2, dvc2,
+            jnp.full((B,), last_tok, jnp.int32),
+            jnp.full((B,), len(prompt), jnp.int32),
+            jnp.asarray(lane_tables),
+            jnp.ones((B,), bool),
+            jnp.asarray(np.arange(B) + r * B, dtype=np.uint32),
+            jnp.zeros((B,), jnp.uint32),
+            jnp.full((B,), temp, jnp.float32),
+            jnp.ones((B,), jnp.float32),
+            jnp.full((B,), top_k, jnp.int32),
+            CFG, CFG, 2, 1)
+        first = np.asarray(packed)[0, 0, 0, :].astype(np.int64)
+        for t in first:
+            counts[t] += 1
+            n += 1
+    emp = counts / n
+    tv = 0.5 * np.abs(emp - ref).sum()
+    # 256 samples over <=8 support: TV ~ O(sqrt(k/n)) ~ 0.12 expected
+    assert tv < 0.25, (tv, np.nonzero(counts)[0], ref.max())

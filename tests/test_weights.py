@@ -124,6 +124,12 @@ async def test_engine_serves_loaded_checkpoint(checkpoint):
         # fixture has no tokenizer files: the card must fall back to the
         # byte tokenizer, NOT publish an hf path the frontend can't build
         assert card.model_path == path and card.tokenizer_kind == "byte"
+        # guided must be LIVE (token_bytes provider wired) and the eos
+        # must come from the tokenizer property — a regression here once
+        # silently disabled guided stop-token overlays for every
+        # checkpoint worker (eos_token_id is a property, not a method)
+        assert engine._guided_vocab is not None
+        assert engine._guided_eos == 256       # ByteTokenizer EOS
         prompt = [5, 9, 23, 51, 3, 78, 12, 34]
         n_new = 6
         with torch.no_grad():
@@ -154,3 +160,38 @@ def test_card_uses_hf_tokenizer_when_files_exist(checkpoint, tmp_path):
         random_init=True, page_size=8, max_pages_per_seq=8)
     assert card.tokenizer_kind == "hf"
     assert card.tokenizer_path == str(ckpt2)
+
+
+def test_device_loader_matches_host_loader(checkpoint):
+    """load_llama_params_device == host load + placement, bit-for-bit
+    (bf16) and same int8 rounding when quantizing."""
+    from dynamo_tpu.engine.quant import QTensor, quantize_params_host
+    from dynamo_tpu.models.loader import (
+        config_from_hf,
+        load_llama_params,
+        load_llama_params_device,
+    )
+
+    path, _ = checkpoint
+    cfg = config_from_hf(path, page_size=8, max_pages_per_seq=8)
+    host = load_llama_params(path, cfg)
+    dev = load_llama_params_device(path, cfg)
+    np.testing.assert_array_equal(np.asarray(dev["layers"]["wq"]),
+                                  np.asarray(host["layers"]["wq"]))
+    np.testing.assert_array_equal(np.asarray(dev["embed"]),
+                                  np.asarray(host["embed"]))
+    np.testing.assert_array_equal(np.asarray(dev["lm_head"]),
+                                  np.asarray(host["lm_head"]))
+    hq = quantize_params_host(host)
+    dq = load_llama_params_device(path, cfg, quantize=True)
+    assert isinstance(dq["layers"]["w_gate"], QTensor)
+    assert not isinstance(dq["layers"]["attn_norm"], QTensor)
+    dg = np.asarray(dq["layers"]["w_gate"].q, dtype=np.int32)
+    hg = np.asarray(hq["layers"]["w_gate"].q, dtype=np.int32)
+    diff = dg != hg
+    # XLA vs numpy f32 division may land exactly-on-.5 ties one ulp
+    # apart — a handful of ±1 quantum differences is expected, anything
+    # more means the schemes diverged
+    assert diff.mean() < 1e-3 and np.abs(dg - hg).max() <= 1, diff.mean()
+    np.testing.assert_allclose(np.asarray(dq["lm_head"].s),
+                               np.asarray(hq["lm_head"].s), rtol=1e-5)

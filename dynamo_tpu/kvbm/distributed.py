@@ -154,6 +154,11 @@ class KvbmDistributed:
 
     # -- fetch --------------------------------------------------------------
 
+    def status(self) -> dict:
+        """Controller view of the remote (G4) tier: blocks this worker
+        advertises to peers (pulled counts live in manager.stats)."""
+        return {"advertised_blocks": len(self.manager.store.hashes())}
+
     async def _peer_adverts(self) -> list:
         """Peers' adverts, cached for the debounce interval so one admit
         round scans the registry once, not once per sequence."""

@@ -64,6 +64,46 @@ class KvbmManager:
         engine.pool.evict_hook = self._on_evict
         engine.kvbm = self
 
+    # -- controller surface (reference block_manager/controller.rs) --------
+
+    def status(self) -> dict:
+        """Per-tier occupancy + lifetime stats (ControlMessage::Status).
+        G1 is the engine's device page pool; G2/G3 the tiered store;
+        G4 the remote advert set when distributed KVBM is attached."""
+        pool = self.engine.pool
+        out = {
+            "g1": {"pages": pool.capacity, "active": pool.active_pages,
+                   "used": pool.used_pages,
+                   "usage": round(pool.usage(), 4)},
+            **self.store.occupancy(),
+            "stats": {
+                "offloaded": self.stats.offloaded,
+                "onboarded": self.stats.onboarded,
+                "onboard_queries": self.stats.onboard_queries,
+                "remote_onboarded": self.stats.remote_onboarded,
+                "onboard_hit_rate": round(
+                    self.stats.onboarded
+                    / max(self.stats.onboard_queries, 1), 4),
+            },
+        }
+        if self.remote is not None:
+            out["g4"] = self.remote.status()
+        return out
+
+    def reset(self, level: str = "all") -> dict:
+        """Manual flush (ControlMessage::ResetPool/ResetAll): "g1"
+        drops the device prefix cache (inactive pages only — pages held
+        by running sequences are never touched), "g2"/"g3" flush the
+        host/disk tiers, "all" does everything."""
+        if level not in ("g1", "g2", "g3", "all"):
+            raise ValueError(f"unknown cache level {level!r}")
+        dropped: dict = {}
+        if level in ("g1", "all"):
+            dropped["g1"] = self.engine.clear_kv_blocks()
+        if level in ("g2", "g3", "all"):
+            dropped.update(self.store.clear(level))
+        return dropped
+
     # -- offload (G1 → G2) --------------------------------------------------
 
     def _on_evict(self, batch: list[tuple[int, int]]) -> None:

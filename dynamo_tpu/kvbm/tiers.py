@@ -58,6 +58,11 @@ class HostTier:
             displaced.append(self._blocks.popitem(last=False))
         return displaced
 
+    def clear(self) -> int:
+        n = len(self._blocks)
+        self._blocks.clear()
+        return n
+
     def pop(self, seq_hash: int) -> Optional[np.ndarray]:
         return self._blocks.pop(seq_hash, None)
 
@@ -137,6 +142,12 @@ class DiskTier:
             except OSError:
                 pass
 
+    def clear(self) -> int:
+        n = len(self._lru)
+        for h in list(self._lru):
+            self.pop(h)
+        return n
+
 
 class TieredStore:
     """Host + disk tiers behind one interface; disk hits promote to host."""
@@ -188,6 +199,27 @@ class TieredStore:
                 break
             n += 1
         return n
+
+    def clear(self, level: str = "all") -> dict:
+        """Manual flush (reference controller ResetPool/ResetAll):
+        level "g2" (host), "g3" (disk), or "all". Returns blocks dropped
+        per tier."""
+        dropped = {}
+        if level in ("g2", "all"):
+            dropped["g2"] = self.host.clear()
+        if level in ("g3", "all") and self.disk is not None:
+            dropped["g3"] = self.disk.clear()
+        if dropped:
+            self._changed()
+        return dropped
+
+    def occupancy(self) -> dict:
+        out = {"g2": {"blocks": len(self.host),
+                      "capacity": self.host.capacity}}
+        if self.disk is not None:
+            out["g3"] = {"blocks": len(self.disk),
+                         "capacity": self.disk.capacity}
+        return out
 
     def hashes(self) -> list[int]:
         """All block hashes across tiers (the distributed advert)."""

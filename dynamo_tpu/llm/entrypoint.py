@@ -89,8 +89,11 @@ class WorkerHandle:
     card: ModelDeploymentCard
     served: object
     served_clear: object = None
+    served_controller: object = None
 
     async def stop(self) -> None:
+        if self.served_controller is not None:
+            await self.served_controller.shutdown()
         if self.served_clear is not None:
             await self.served_clear.shutdown()
         await self.served.shutdown()
@@ -128,8 +131,32 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
 
         served_clear = await comp.endpoint("clear_kv_blocks").serve(
             clear_handler, instance_id=served.instance.instance_id)
+    served_ctl = None
+    if getattr(engine, "kvbm", None) is not None:
+        kvbm = engine.kvbm
+
+        async def controller_handler(request, context):
+            # reference block_manager/controller.rs ControlMessage:
+            # Status / ResetPool(level) / ResetAll
+            op = (request or {}).get("op", "status")
+            if op == "status":
+                yield {"status": "success", **kvbm.status()}
+            elif op == "reset":
+                level = (request or {}).get("level", "all")
+                try:
+                    dropped = kvbm.reset(level)
+                except ValueError as e:
+                    yield {"status": "error", "error": str(e)}
+                    return
+                yield {"status": "success", "dropped": dropped}
+            else:
+                yield {"status": "error",
+                       "error": f"unknown kvbm controller op {op!r}"}
+
+        served_ctl = await comp.endpoint("kvbm_controller").serve(
+            controller_handler, instance_id=served.instance.instance_id)
     await register_llm(runtime, card)
-    return WorkerHandle(runtime, card, served, served_clear)
+    return WorkerHandle(runtime, card, served, served_clear, served_ctl)
 
 
 def wire_engine_events(runtime: DistributedRuntime,

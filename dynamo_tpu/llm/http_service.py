@@ -109,6 +109,8 @@ class HttpService:
             web.post("/v1/responses", self._responses),
             web.get("/v1/models", self._models),
             web.post("/clear_kv_blocks", self._clear_kv_blocks),
+            web.get("/kvbm/status", self._kvbm_status),
+            web.post("/kvbm/reset", self._kvbm_reset),
             web.get("/health", self._health),
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
@@ -323,9 +325,9 @@ class HttpService:
         if usage.get("output_tokens") is not None:
             self._osl.observe(usage["output_tokens"])
 
-    async def _clear_kv_blocks(self, request: web.Request) -> web.Response:
-        """Admin route (service/clear_kv_blocks.rs): tell every worker
-        instance of every served model to drop its reusable KV cache."""
+    async def _fanout_admin(self, endpoint: str, payload: dict) -> dict:
+        """Send one admin request to every instance of every served
+        model's `endpoint`; per-instance results keyed by model."""
         from dynamo_tpu.runtime.push import PushRouter
 
         results: dict[str, dict] = {}
@@ -336,7 +338,7 @@ class HttpService:
             card = entry.card
             client = await (self.manager.runtime.namespace(card.namespace)
                             .component(card.component)
-                            .endpoint("clear_kv_blocks").client())
+                            .endpoint(endpoint).client())
             await client.start()
             router = PushRouter(client)
             per_instance: dict[str, object] = {}
@@ -344,7 +346,7 @@ class HttpService:
                 for inst in client.instances():
                     try:
                         async for out in router.direct(
-                                {}, inst.instance_id, Context()):
+                                payload, inst.instance_id, Context()):
                             per_instance[f"{inst.instance_id:x}"] = out
                     except Exception as e:  # instance died mid-call
                         per_instance[f"{inst.instance_id:x}"] = {
@@ -352,6 +354,33 @@ class HttpService:
             finally:
                 await client.stop()
             results[name] = per_instance
+        return results
+
+    async def _clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin route (service/clear_kv_blocks.rs): tell every worker
+        instance of every served model to drop its reusable KV cache."""
+        results = await self._fanout_admin("clear_kv_blocks", {})
+        return web.json_response({"status": "success", "results": results})
+
+    async def _kvbm_status(self, request: web.Request) -> web.Response:
+        """KVBM controller status (block_manager/controller.rs
+        ControlMessage::Status): per-tier occupancy + offload/onboard
+        stats from every worker running a KVBM manager. Workers without
+        KVBM simply expose no kvbm_controller endpoint and are absent."""
+        results = await self._fanout_admin("kvbm_controller",
+                                           {"op": "status"})
+        return web.json_response({"status": "success", "results": results})
+
+    async def _kvbm_reset(self, request: web.Request) -> web.Response:
+        """KVBM controller reset (ControlMessage::ResetPool/ResetAll):
+        body {"level": "g1"|"g2"|"g3"|"all"} (default all)."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        level = (body or {}).get("level", "all")
+        results = await self._fanout_admin(
+            "kvbm_controller", {"op": "reset", "level": level})
         return web.json_response({"status": "success", "results": results})
 
     async def _serve_openai(self, request: web.Request,
@@ -513,6 +542,9 @@ class HttpService:
             "/v1/responses": ("Responses API (typed SSE events when "
                               "stream=true)", True),
             "/v1/models": ("Served models", False),
+            "/kvbm/status": ("KVBM per-tier occupancy + stats", False),
+            "/kvbm/reset": ("Flush KVBM tiers (level: g1/g2/g3/all)",
+                            False),
             "/clear_kv_blocks": ("Drop every worker's reusable KV cache",
                                  False),
             "/health": ("Model-serving readiness", False),

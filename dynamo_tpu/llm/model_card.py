@@ -85,10 +85,26 @@ async def register_llm(runtime, card: ModelDeploymentCard) -> None:
     """Publish the card under the process lease (worker side, the analog of
     the reference's `register_llm`, bindings lib.rs:123 → model_card.rs:463).
     The lease attachment means a dead worker's card disappears, and the
-    frontend drops the model when its last card vanishes."""
+    frontend drops the model when its last card vanishes. Re-published
+    automatically after a coordinator restart (the key embeds the lease
+    id, so the replay publishes under the re-created lease)."""
     await runtime.store.put(card.store_key(runtime.lease_id), card.to_json(),
                             runtime.lease_id)
 
+    async def _reput() -> None:
+        await runtime.store.put(card.store_key(runtime.lease_id),
+                                card.to_json(), runtime.lease_id)
+
+    # the card object keeps its own hook handle (same shape as
+    # ServedEndpoint._reput) so unregister can drop exactly this replay
+    card._replay_hook = _reput
+    if hasattr(runtime, "replay_on_reconnect"):
+        runtime.replay_on_reconnect(_reput)
+
 
 async def unregister_llm(runtime, card: ModelDeploymentCard) -> None:
+    hook = getattr(card, "_replay_hook", None)
+    if hook is not None and hasattr(runtime, "drop_replay"):
+        runtime.drop_replay(hook)
+        card._replay_hook = None
     await runtime.store.delete(card.store_key(runtime.lease_id))

@@ -161,6 +161,8 @@ class ModelWatcher:
         return self
 
     async def _run(self) -> None:
+        from dynamo_tpu.runtime.store import RESET
+
         assert self._watch is not None
         async for ev in self._watch:
             try:
@@ -170,6 +172,12 @@ class ModelWatcher:
                     model = self._key_model.pop(ev.key, None)
                     if model is not None:
                         await self.manager.remove_card(model, ev.key)
+                elif ev.kind == RESET:
+                    # coordinator restarted: drop every discovered card;
+                    # surviving workers re-publish (replayed as PUTs)
+                    for key, model in list(self._key_model.items()):
+                        self._key_model.pop(key, None)
+                        await self.manager.remove_card(model, key)
             except Exception:
                 logger.exception("model watcher failed on %s", ev.key)
 

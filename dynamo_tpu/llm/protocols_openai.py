@@ -168,14 +168,7 @@ class ChatCompletionRequest:
             s.seed = int(self.seed)
         if self.guided is not None:
             s.guided = self.guided
-        tl = getattr(self, "top_logprobs", None)
-        if tl is None or isinstance(tl, bool):
-            tl = 0
-        if not tl and isinstance(getattr(self, "logprobs", None), int) \
-                and not isinstance(self.logprobs, bool):
-            # completions API: logprobs=N means N alternatives per token
-            tl = int(self.logprobs)
-        s.top_logprobs = int(tl)
+        s.top_logprobs = int(getattr(self, "top_logprobs", 0) or 0)
         return s
 
     def stop_conditions(self) -> StopConditions:
@@ -225,6 +218,7 @@ class CompletionRequest:
         lps = d.get("logprobs")
         _require(lps is None or 0 <= int(lps) <= MAX_TOP_LOGPROBS,
                  f"'logprobs' must be between 0 and {MAX_TOP_LOGPROBS}")
+        lps = None if lps is None else int(lps)
         return cls(
             model=d["model"], prompt=prompt, stream=bool(d.get("stream")),
             max_tokens=d.get("max_tokens"), temperature=d.get("temperature"),
@@ -237,12 +231,18 @@ class CompletionRequest:
                                   nvext.get("ignore_eos", False))),
             min_tokens=d.get("min_tokens"),
             echo=bool(d.get("echo")),
-            logprobs=d.get("logprobs"),
+            logprobs=lps,
             n=int(d.get("n", 1)),
             guided=_guided_from(d, nvext), raw=d,
         )
 
-    sampling_options = ChatCompletionRequest.sampling_options
+    def sampling_options(self) -> SamplingOptions:
+        s = ChatCompletionRequest.sampling_options(self)
+        # completions API: logprobs=N means N alternatives per token
+        # (normalized to int in from_dict)
+        if self.logprobs:
+            s.top_logprobs = int(self.logprobs)
+        return s
 
     def stop_conditions(self) -> StopConditions:
         return StopConditions(max_tokens=self.max_tokens,

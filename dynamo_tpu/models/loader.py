@@ -272,7 +272,12 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     _log.info("loading embed/lm_head")
     if "lm_head.weight" in idx:
         lm = dense("lm_head.weight")
-    else:  # tie_word_embeddings
+    else:
+        # tie_word_embeddings: the (E, V) copy is materialized — true
+        # weight sharing would need a transposed-matmul marker through
+        # qm(); at 128k vocab bf16 that is ~1 GB of avoidable HBM, an
+        # accepted cost until a tied checkpoint at that scale matters
+        # (the int8 path quantizes the copy and frees it)
         lm = jnp.transpose(params["embed"])
     from dynamo_tpu.engine.quant import _lm_head_quant_ok
 

@@ -45,11 +45,20 @@ def _pool(seed: int, scale: float):
 
 
 def _fill(pool, offset: int, shape):
+    """Cyclic copy out of the pool with direct slice assignments —
+    one write per output byte (a strided-view reshape would silently
+    materialize an intermediate copy and double the 16 GB of traffic
+    this trick exists to avoid)."""
     n = int(np.prod(shape))
-    reps = -(-n // _POOL_ELEMS) + 1
-    flat = np.lib.stride_tricks.as_strided(  # cheap cyclic view
-        pool, (reps, _POOL_ELEMS), (0, pool.itemsize)).reshape(-1)
-    return np.array(flat[offset:offset + n], copy=True).reshape(shape)
+    out = np.empty(n, dtype=pool.dtype)
+    first = min(n, _POOL_ELEMS - offset)
+    out[:first] = pool[offset:offset + first]
+    pos = first
+    while pos < n:
+        m = min(_POOL_ELEMS, n - pos)
+        out[pos:pos + m] = pool[:m]
+        pos += m
+    return out.reshape(shape)
 
 
 def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",

@@ -131,7 +131,16 @@ class Endpoint:
         rt.transport_server.register(inst.subject, serve_engine)
         rt.register_local(inst.subject, serve_engine)
         await rt.store.put(inst.etcd_key, inst.to_json(), rt.lease_id)
-        return ServedEndpoint(self, inst, engine)
+        served = ServedEndpoint(self, inst, engine)
+
+        async def _reput() -> None:
+            # coordinator restarted: the fresh store has no instance key
+            # (and rt.lease_id is already the re-created lease)
+            await rt.store.put(inst.etcd_key, inst.to_json(), rt.lease_id)
+
+        served._reput = _reput
+        rt.replay_on_reconnect(_reput)
+        return served
 
     async def client(self, static_instances: Optional[list[Instance]] = None
                      ) -> "EndpointClient":
@@ -144,9 +153,12 @@ class ServedEndpoint:
         self.endpoint = endpoint
         self.instance = instance
         self.engine = engine
+        self._reput = None      # reconnect re-registration (serve())
 
     async def shutdown(self) -> None:
         rt = self.endpoint.runtime
+        if self._reput is not None:
+            rt.drop_replay(self._reput)
         if rt.health is not None:
             rt.health.unregister(self.instance.subject)
         rt.transport_server.unregister(self.instance.subject)
@@ -192,6 +204,8 @@ class EndpointClient:
         return self
 
     async def _run(self) -> None:
+        from dynamo_tpu.runtime.store import RESET
+
         assert self._watch is not None
         async for ev in self._watch:
             if ev.kind == PUT:
@@ -202,6 +216,13 @@ class EndpointClient:
                 iid = int(ev.key.rsplit("/", 1)[-1], 16)
                 inst = self._instances.pop(iid, None)
                 if inst is not None:
+                    self._emit(DELETE, inst)
+            elif ev.kind == RESET:
+                # coordinator restarted: the empty store will never send
+                # DELETEs for instances that died with it — drop the
+                # whole view; the replay that follows rebuilds survivors
+                for inst in list(self._instances.values()):
+                    self._instances.pop(inst.instance_id, None)
                     self._emit(DELETE, inst)
             self._ready.set()
 

@@ -44,6 +44,38 @@ class DistributedRuntime:
         self._shutdown = asyncio.Event()
         self._status_server = None
         self.health = None  # HealthCheckManager when enabled
+        # async callables replayed after a coordinator restart: the new
+        # store is empty, so every lease-attached key must be re-put
+        # (instance registrations, model cards, adverts)
+        self._reregisters: list = []
+        if hasattr(store, "on_reconnect"):
+            store.on_reconnect.append(self._on_store_reconnect)
+
+    def replay_on_reconnect(self, fn) -> None:
+        """Register an async callable that re-publishes one
+        lease-attached key after a coordinator restart. Called AFTER
+        the runtime's lease has been re-created (self.lease_id is fresh
+        when fn runs)."""
+        self._reregisters.append(fn)
+
+    def drop_replay(self, fn) -> None:
+        try:
+            self._reregisters.remove(fn)
+        except ValueError:
+            pass
+
+    async def _on_store_reconnect(self) -> None:
+        self.lease_id = await self.store.create_lease(
+            self.config.lease_ttl)
+        for fn in list(self._reregisters):
+            try:
+                await fn()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "re-registration failed after coordinator restart",
+                    exc_info=True)
 
     # -- construction ------------------------------------------------------
 

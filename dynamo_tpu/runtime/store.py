@@ -25,6 +25,11 @@ from typing import AsyncIterator, Optional
 
 PUT = "put"
 DELETE = "delete"
+# synthetic event a reconnecting StoreClient injects into every live
+# watch when the coordinator comes back: consumers must CLEAR their
+# derived view (the restarted store is empty, so no DELETEs will ever
+# arrive for keys that died with it) before the replayed PUTs rebuild it
+RESET = "reset"
 
 
 @dataclass

@@ -203,29 +203,47 @@ def _kv_from_wire(w) -> Optional[KeyValue]:
 
 class StoreClient(KeyValueStore, EventBus):
     """KeyValueStore + EventBus over one StoreServer connection, with auto
-    lease keepalive."""
+    lease keepalive and coordinator-restart resilience: when the
+    connection dies (unless close() was called) the client reconnects
+    with backoff, re-establishes every live watch and subscription
+    (injecting a RESET event so watchers clear state the restarted —
+    empty — store can never send DELETEs for), and runs registered
+    `on_reconnect` hooks so the application layer can re-create leases
+    and re-publish lease-attached keys. The reference gets this story
+    from etcd client retry + compaction semantics; the no-raft
+    coordinator needs it explicitly."""
 
-    def __init__(self, host: str, port: int) -> None:
+    RECONNECT_BACKOFF = (0.2, 0.5, 1.0, 2.0, 5.0)
+
+    def __init__(self, host: str, port: int,
+                 auto_reconnect: bool = True) -> None:
         self.host = host
         self.port = port
+        self.auto_reconnect = auto_reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
+        self._watch_specs: dict[int, str] = {}     # wid -> prefix
         self._subs: dict[int, Subscription] = {}
+        self._sub_specs: dict[int, str] = {}       # sid -> subject
         self._ids = itertools.count(1)
         self._wids = itertools.count(1)
         self._sids = itertools.count(1)
         self._rx_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
         self._keepalive_task: Optional[asyncio.Task] = None
         self._leases: dict[int, float] = {}  # lease_id -> ttl
         self._write_lock = asyncio.Lock()
-        self._closed = False
+        self._closed = False          # close() called: permanent
+        self._connected = asyncio.Event()
+        self.on_reconnect: list = []  # async callables, run post-restore
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._connected.set()
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
 
     async def _rx_loop(self) -> None:
@@ -257,22 +275,85 @@ class StoreClient(KeyValueStore, EventBus):
         except asyncio.CancelledError:
             pass
         except Exception:  # ConnectionError or a corrupt/undecodable frame
-            logger.exception("store client rx loop died")
+            if not self._closed:
+                logger.warning("store connection lost", exc_info=True)
         finally:
-            self._closed = True
+            self._connected.clear()
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("store connection lost"))
             self._pending.clear()
-            for watch in list(self._watches.values()):
-                watch.cancel()
-            self._watches.clear()
-            for sub in list(self._subs.values()):
-                sub.cancel()
-            self._subs.clear()
+            if self._closed or not self.auto_reconnect:
+                self._closed = True
+                for watch in list(self._watches.values()):
+                    watch.cancel()
+                self._watches.clear()
+                self._watch_specs.clear()
+                for sub in list(self._subs.values()):
+                    sub.cancel()
+                self._subs.clear()
+                self._sub_specs.clear()
+            elif self._reconnect_task is None:
+                # watches/subs stay registered client-side; the
+                # reconnect loop re-establishes them server-side
+                self._reconnect_task = asyncio.get_running_loop() \
+                    .create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        try:
+            attempt = 0
+            while not self._closed:
+                delay = self.RECONNECT_BACKOFF[
+                    min(attempt, len(self.RECONNECT_BACKOFF) - 1)]
+                await asyncio.sleep(delay)
+                attempt += 1
+                try:
+                    await self.connect()
+                except Exception:
+                    continue
+                try:
+                    await self._restore()
+                except Exception:
+                    logger.warning("store re-establish failed; retrying",
+                                   exc_info=True)
+                    self._connected.clear()
+                    if self._writer is not None:
+                        self._writer.close()
+                    continue
+                logger.info("store connection restored "
+                            "(%d watches, %d subs)",
+                            len(self._watch_specs), len(self._sub_specs))
+                return
+        finally:
+            self._reconnect_task = None
+
+    async def _restore(self) -> None:
+        """Post-reconnect: RESET + re-register every live watch, re-sub
+        every subscription, then run application hooks (lease and key
+        re-registration — the restarted store is empty)."""
+        from dynamo_tpu.runtime.store import RESET
+
+        # stale lease ids died with the old server
+        self._leases.clear()
+        for wid, prefix in list(self._watch_specs.items()):
+            watch = self._watches.get(wid)
+            if watch is None or watch._cancelled:
+                continue
+            watch.queue.put_nowait(StoreEvent(RESET, prefix, b"", 0))
+            await self._call({"op": "watch", "prefix": prefix,
+                              "wid": wid, "replay": True})
+        for sid, subject in list(self._sub_specs.items()):
+            sub = self._subs.get(sid)
+            if sub is None or sub._cancelled:
+                continue
+            await self._call({"op": "sub", "subject": subject,
+                              "sid": sid, "from_start": False})
+        for hook in list(self.on_reconnect):
+            await hook()
 
     async def _call(self, msg: dict) -> dict:
-        if self._writer is None or self._closed:
+        if self._writer is None or self._closed \
+                or not self._connected.is_set():
             raise ConnectionError("store connection lost")
         mid = next(self._ids)
         msg["id"] = mid
@@ -327,7 +408,10 @@ class StoreClient(KeyValueStore, EventBus):
                 try:
                     ok = await self.keep_alive(lease_id)
                 except ConnectionError:
-                    return
+                    # disconnected: the reconnect loop re-creates leases
+                    # via the application hooks; keep the loop alive for
+                    # whatever lease comes next
+                    break
                 if not ok:
                     self._leases.pop(lease_id, None)
 
@@ -342,12 +426,17 @@ class StoreClient(KeyValueStore, EventBus):
         watch = Watch()
         wid = next(self._wids)
         self._watches[wid] = watch
+        self._watch_specs[wid] = prefix
         orig_cancel = watch.cancel
 
         def cancel() -> None:
             orig_cancel()
             self._watches.pop(wid, None)
-            if not self._closed:
+            self._watch_specs.pop(wid, None)
+            # skip the server notification while disconnected: the
+            # fire-and-forget _call would raise into an unawaited task
+            # (the restarted server has no such watch anyway)
+            if not self._closed and self._connected.is_set():
                 asyncio.get_running_loop().create_task(
                     self._call({"op": "watch_cancel", "wid": wid})
                 )
@@ -374,12 +463,14 @@ class StoreClient(KeyValueStore, EventBus):
 
         def on_cancel() -> None:
             self._subs.pop(sid, None)
-            if not self._closed:
+            self._sub_specs.pop(sid, None)
+            if not self._closed and self._connected.is_set():
                 asyncio.get_running_loop().create_task(
                     self._call({"op": "unsub", "sid": sid}))
 
         sub = Subscription(on_cancel=on_cancel)
         self._subs[sid] = sub
+        self._sub_specs[sid] = subject
         try:
             await self._call({"op": "sub", "subject": subject, "sid": sid,
                               "from_start": from_start})
@@ -390,6 +481,8 @@ class StoreClient(KeyValueStore, EventBus):
 
     async def close(self) -> None:
         self._closed = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
         if self._keepalive_task is not None:
             self._keepalive_task.cancel()
         if self._rx_task is not None:

@@ -530,3 +530,57 @@ async def test_n_capped_and_error_cancels_siblings():
         assert with_usage[0]["usage"]["completion_tokens"] == 6
     finally:
         await teardown_stack(rt, fe, hs, es)
+
+
+async def test_kvbm_controller_http_routes(tmp_path):
+    """/kvbm/status and /kvbm/reset fan out to every worker's
+    kvbm_controller endpoint (reference block_manager controller over
+    the system's admin plane)."""
+    import aiohttp
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name="tiny", namespace="ns", component="tpu",
+        tokenizer_kind="word", tokenizer_path="tiny",
+        router_mode="round_robin")
+    eng = TpuEngine(TpuEngineConfig(
+        model=LlamaConfig.tiny(), num_pages=10, max_batch_size=2,
+        default_max_tokens=6, decode_steps_per_sync=2))
+    KvbmManager(eng, KvbmConfig(host_blocks=4, disk_blocks=4,
+                                disk_dir=str(tmp_path)))
+    handle = await serve_engine(rt, eng, card)
+    frontend = await start_frontend(rt)
+    try:
+        for _ in range(100):
+            if "tiny" in frontend.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{frontend.url}/kvbm/status") as r:
+                assert r.status == 200
+                body = await r.json()
+            inst = next(iter(body["results"]["tiny"].values()))
+            assert inst["g1"]["pages"] == 9
+            assert inst["g2"]["capacity"] == 4
+            async with s.post(f"{frontend.url}/kvbm/reset",
+                              json={"level": "all"}) as r:
+                assert r.status == 200
+                body = await r.json()
+            inst = next(iter(body["results"]["tiny"].values()))
+            assert inst["status"] == "success" and "dropped" in inst
+            # bad level surfaces as a per-instance error, not a 500
+            async with s.post(f"{frontend.url}/kvbm/reset",
+                              json={"level": "g9"}) as r:
+                assert r.status == 200
+                body = await r.json()
+            inst = next(iter(body["results"]["tiny"].values()))
+            assert inst["status"] == "error"
+    finally:
+        await frontend.stop()
+        await handle.stop()
+        await eng.close()
+        await rt.close()

@@ -139,3 +139,42 @@ async def test_disk_tier_end_to_end(tmp_path):
         assert mgr.stats.onboarded > 0
     finally:
         await eng.close()
+
+
+async def test_controller_status_and_reset(tmp_path):
+    """KVBM controller surface (reference block_manager/controller.rs:
+    Status / ResetPool / ResetAll): per-tier occupancy, stats, manual
+    flush per level."""
+    eng = TpuEngine(TpuEngineConfig(
+        model=LlamaConfig.tiny(), num_pages=10, max_batch_size=2,
+        default_max_tokens=6, decode_steps_per_sync=2))
+    mgr = KvbmManager(eng, KvbmConfig(host_blocks=2, disk_blocks=8,
+                                      disk_dir=str(tmp_path)))
+    try:
+        await collect(eng, req(list(range(1, 13))))
+        for base in (50, 80, 110):
+            await collect(eng, req(list(range(base, base + 12))))
+        st = mgr.status()
+        assert st["g1"]["pages"] == 9          # scratch page excluded
+        assert st["g2"]["capacity"] == 2
+        assert st["g2"]["blocks"] == 2         # LRU full, rest demoted
+        assert st["g3"]["blocks"] >= 1
+        assert st["stats"]["offloaded"] >= 3
+        assert 0.0 <= st["stats"]["onboard_hit_rate"] <= 1.0
+
+        # flush g3 only
+        dropped = mgr.reset("g3")
+        assert dropped["g3"] >= 1 and "g2" not in dropped
+        assert mgr.status()["g3"]["blocks"] == 0
+        # flush everything
+        dropped = mgr.reset("all")
+        assert dropped["g2"] == 2
+        st2 = mgr.status()
+        assert st2["g2"]["blocks"] == 0
+        assert st2["g1"]["active"] == 0 or st2["g1"]["used"] >= 0
+        import pytest
+
+        with pytest.raises(ValueError):
+            mgr.reset("g7")
+    finally:
+        await eng.close()

@@ -281,3 +281,78 @@ def test_worker_cli_multinode_validation():
     args = parse_args(["--mock", "--num-nodes", "2"])
     with _pytest.raises(SystemExit, match="leader-addr"):
         _multinode_mesh(args)
+
+
+async def test_coordinator_restart_mid_serve(procs):
+    """Kill the coordinator and restart it on the SAME port mid-serve:
+    every client must reconnect, re-create its lease, re-publish its
+    instance key and model card, and requests must keep flowing — the
+    durability role etcd plays for the reference
+    (lib/runtime/src/transports/etcd.rs), owned explicitly here
+    (store_net.StoreClient reconnect + runtime replay hooks)."""
+    store_port = free_port()
+    http_port = free_port()
+    store = f"tcp://127.0.0.1:{store_port}"
+
+    coord = spawn("dynamo_tpu.coordinator", "--port", str(store_port))
+    procs.append(coord)
+    await wait_ready(coord, "COORDINATOR_READY")
+
+    w1 = spawn("dynamo_tpu.worker", "--mock", "--store", store,
+               "--router-mode", "round_robin", "--lease-ttl", LEASE_TTL)
+    procs.append(w1)
+    await wait_ready(w1, "WORKER_READY")
+
+    fe = spawn("dynamo_tpu.frontend", "--store", store,
+               "--host", "127.0.0.1", "--port", str(http_port))
+    procs.append(fe)
+    await wait_ready(fe, "FRONTEND_READY")
+    url = f"http://127.0.0.1:{http_port}"
+
+    body = {"model": "mock-model", "max_tokens": 8,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+    async with aiohttp.ClientSession() as s:
+        for _ in range(100):
+            async with s.get(f"{url}/v1/models") as r:
+                if (await r.json()).get("data"):
+                    break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("model never discovered")
+        async with s.post(f"{url}/v1/chat/completions", json=body) as r:
+            assert r.status == 200, await r.text()
+
+        # coordinator dies hard and comes back on the same port
+        coord.kill()
+        coord.wait(timeout=5)
+        coord2 = spawn("dynamo_tpu.coordinator", "--port",
+                       str(store_port))
+        procs.append(coord2)
+        await wait_ready(coord2, "COORDINATOR_READY")
+
+        # worker + frontend reconnect, re-register, re-discover; the
+        # system must converge to serving again
+        deadline = asyncio.get_running_loop().time() + 30.0
+        last_err = None
+        while asyncio.get_running_loop().time() < deadline:
+            try:
+                async with s.post(f"{url}/v1/chat/completions",
+                                  json=body) as r:
+                    if r.status == 200:
+                        out = await r.json()
+                        assert out["choices"][0]["message"]["content"]
+                        break
+                    last_err = (r.status, await r.text())
+            except aiohttp.ClientError as e:
+                last_err = e
+            await asyncio.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"requests never recovered after coordinator restart: "
+                f"{last_err}")
+
+        # the rebuilt store actually holds the re-registrations: a fresh
+        # client (new frontend) can discover the model from it
+        async with s.get(f"{url}/v1/models") as r:
+            assert (await r.json()).get("data"), "model list empty"

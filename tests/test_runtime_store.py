@@ -103,3 +103,70 @@ async def test_tcp_store_conn_death_revokes_lease():
     watch.cancel()
     await c2.close()
     await server.stop()
+
+
+async def test_client_reconnects_after_server_restart():
+    """StoreClient survives a coordinator bounce: watches get a RESET
+    then replayed state from the new server, subscriptions keep
+    delivering, and on_reconnect hooks run so the app layer can
+    re-create leases and re-put keys."""
+    from dynamo_tpu.runtime.store import RESET
+
+    server = StoreServer()
+    host, port = await server.start()
+    c = StoreClient(host, port)
+    c.RECONNECT_BACKOFF = (0.05, 0.1)
+    await c.connect()
+    hook_ran = asyncio.Event()
+
+    async def hook():
+        lease = await c.create_lease(5.0)
+        await c.put("r/a", b"reborn", lease)
+        hook_ran.set()
+
+    c.on_reconnect.append(hook)
+    try:
+        await c.put("r/a", b"v1")
+        watch = await c.watch_prefix("r/")
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert ev.kind == PUT
+        sub = await c.subscribe("events.x")
+
+        await server.stop()                      # coordinator dies
+        server2 = StoreServer(port=port)         # ...and comes back
+        await server2.start()
+
+        # hook re-registered state on the fresh server
+        await asyncio.wait_for(hook_ran.wait(), 5)
+        # watch saw a RESET, then the hook's re-put replayed as PUT
+        kinds = []
+        while True:
+            ev = await asyncio.wait_for(watch.__anext__(), 5)
+            kinds.append((ev.kind, ev.key))
+            if ev.kind == PUT and ev.key == "r/a":
+                break
+        assert kinds[0][0] == RESET, kinds
+        kv = await c.get("r/a")
+        assert kv.value == b"reborn"
+
+        # subscription still delivers after re-establish
+        await c.publish("events.x", {"n": 1})
+        msg = await asyncio.wait_for(sub.__anext__(), 5)
+        assert msg["payload"] == {"n": 1}
+        watch.cancel()
+        sub.cancel()
+        await server2.stop()
+    finally:
+        await c.close()
+
+
+async def test_client_close_does_not_reconnect():
+    server = StoreServer()
+    host, port = await server.start()
+    c = StoreClient(host, port)
+    c.RECONNECT_BACKOFF = (0.05,)
+    await c.connect()
+    await c.close()
+    await asyncio.sleep(0.3)
+    assert c._reconnect_task is None
+    await server.stop()

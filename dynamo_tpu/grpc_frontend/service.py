@@ -44,6 +44,12 @@ def _completion_body(pb, req) -> dict:
     if prompt is None:
         raise OpenAIError("missing 'text_input' BYTES tensor")
     body: dict = {"model": req.model_name, "prompt": prompt}
+    _apply_parameters(req, body)
+    return body
+
+
+def _apply_parameters(req, body: dict) -> None:
+    """KServe request `parameters` map → OpenAI-ish body knobs."""
     for key, p in req.parameters.items():
         which = p.WhichOneof("parameter_choice")
         if which is None:
@@ -68,7 +74,6 @@ def _completion_body(pb, req) -> dict:
         except (TypeError, ValueError):
             raise OpenAIError(
                 f"bad value for parameter {key!r}: {val!r}") from None
-    return body
 
 
 def _text_response(pb, model: str, rid: str, text: str,
@@ -82,6 +87,65 @@ def _text_response(pb, model: str, rid: str, text: str,
     if finish_reason:
         resp.parameters["finish_reason"].string_param = finish_reason
     return resp
+
+
+def _infer_mode(req) -> str:
+    """Dispatch a ModelInferRequest by its tensors (kserve.rs serves
+    both text-over-tensor LLM requests and tensor-based models):
+    "tokens" when an input_ids INT tensor is present (token-in/
+    token-out LLM inference), "embed" when parameters.task == "embed"
+    (text_input BYTES → FP32 embeddings), else "text"."""
+    for t in req.inputs:
+        if t.name == "input_ids":
+            return "tokens"
+    p = req.parameters.get("task")
+    if p is not None and p.WhichOneof("parameter_choice") == \
+            "string_param" and p.string_param == "embed":
+        return "embed"
+    return "text"
+
+
+def _token_request(req) -> dict:
+    """input_ids INT32/INT64 tensor → engine-level PreprocessedRequest
+    dict (token-in/token-out: no tokenizer in the path at all).
+    Shape must be [T] or [1, T] — KServe v2 batching (leading dim > 1)
+    is rejected rather than silently flattened into one sequence."""
+    ids = None
+    for t in req.inputs:
+        if t.name == "input_ids":
+            if ids is not None:
+                raise OpenAIError("duplicate 'input_ids' tensor")
+            shape = list(t.shape)
+            if len(shape) > 2 or (len(shape) == 2 and shape[0] != 1):
+                raise OpenAIError(
+                    f"'input_ids' must be [T] or [1, T], got {shape} "
+                    f"(batched tensor requests are not supported)")
+            ids = (list(t.contents.int64_contents)
+                   or list(t.contents.int_contents))
+    if not ids:
+        raise OpenAIError("empty 'input_ids' tensor")
+    body: dict = {"model": req.model_name}
+    _apply_parameters(req, body)
+    sampling = {k: body[k] for k in ("temperature", "top_p", "top_k",
+                                     "min_p", "seed") if k in body}
+    stop = {"max_tokens": body.get("max_tokens", 64)}
+    if "min_tokens" in body:
+        stop["min_tokens"] = body["min_tokens"]
+    if body.get("ignore_eos"):
+        stop["ignore_eos"] = True
+    return {"token_ids": [int(i) for i in ids], "model": req.model_name,
+            "sampling": sampling, "stop": stop}
+
+
+def _embed_body(pb, req) -> dict:
+    texts = []
+    for t in req.inputs:
+        if t.name == "text_input":
+            texts += [b.decode("utf-8", "replace")
+                      for b in t.contents.bytes_contents]
+    if not texts:
+        raise OpenAIError("missing 'text_input' BYTES tensor")
+    return {"model": req.model_name, "input": texts}
 
 
 class KserveGrpcService:
@@ -156,7 +220,12 @@ class KserveGrpcService:
         import grpc
 
         pb = self._pb
+        mode = _infer_mode(request)
         try:
+            if mode == "tokens":
+                return await self._token_infer(request, context)
+            if mode == "embed":
+                return await self._embed_infer(request, context)
             body = _completion_body(pb, request)
         except OpenAIError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
@@ -166,6 +235,87 @@ class KserveGrpcService:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         return _text_response(pb, request.model_name, request.id, text,
                               finish)
+
+    async def _token_infer(self, request, context):
+        """Tensor-based LLM inference (kserve.rs ModelInput::Tensor
+        analog): input_ids INT tensor in, output_ids INT64 tensor out —
+        the engine contract (PreprocessedRequest → EngineOutput) through
+        the model's TOKEN-LEVEL pipeline entry (Migration → the
+        configured kv/round-robin/random router), no tokenizer anywhere
+        in the path."""
+        import asyncio
+
+        import grpc
+
+        pb = self._pb
+        req_d = _token_request(request)
+        entry = self.manager.get(request.model_name)
+        if entry is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {request.model_name!r} not found")
+        # EOS semantics match the text path: the preprocessor would arm
+        # the tokenizer's eos id unless ignore_eos
+        if not req_d["stop"].get("ignore_eos") \
+                and entry.eos_token_id is not None:
+            req_d["stop"]["stop_token_ids"] = [entry.eos_token_id]
+        ctx = Context()
+        out_ids: list[int] = []
+        finish = ""
+        try:
+            async for out in entry.token_engine.generate(req_d, ctx):
+                out_ids += [int(t) for t in out.get("token_ids", ())]
+                finish = out.get("finish_reason") or finish
+        except asyncio.CancelledError:
+            ctx.cancel()
+            raise
+        resp = pb.ModelInferResponse(model_name=request.model_name,
+                                     id=request.id)
+        o = resp.outputs.add()
+        o.name = "output_ids"
+        o.datatype = "INT64"
+        o.shape.extend([1, len(out_ids)])
+        o.contents.int64_contents.extend(out_ids)
+        if finish:
+            resp.parameters["finish_reason"].string_param = finish
+        return resp
+
+    async def _embed_infer(self, request, context):
+        """Embeddings over KServe: text_input BYTES tensor (one element
+        per input) → FP32 "embedding" tensor [n, dim]."""
+        import grpc
+
+        from dynamo_tpu.llm.preprocessor import KIND_EMBEDDING
+
+        pb = self._pb
+        body = _embed_body(pb, request)
+        engine = self.manager.engine_for(request.model_name)
+        if engine is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"model {request.model_name!r} not found")
+        import asyncio
+
+        out = None
+        ctx = Context()
+        try:
+            async for item in engine.generate(
+                    {"_kind": KIND_EMBEDDING, "body": body}, ctx):
+                out = item
+        except asyncio.CancelledError:
+            ctx.cancel()   # RPC cancelled: stop the embed fan-out
+            raise
+        vecs = [d["embedding"] for d in (out or {}).get("data", ())]
+        if not vecs:
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                "embedding pipeline returned nothing")
+        resp = pb.ModelInferResponse(model_name=request.model_name,
+                                     id=request.id)
+        o = resp.outputs.add()
+        o.name = "embedding"
+        o.datatype = "FP32"
+        o.shape.extend([len(vecs), len(vecs[0])])
+        for v in vecs:
+            o.contents.fp32_contents.extend(float(x) for x in v)
+        return resp
 
     async def model_stream_infer(self, request_iterator, context):
         import asyncio as _aio

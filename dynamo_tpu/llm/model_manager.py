@@ -30,12 +30,19 @@ logger = logging.getLogger(__name__)
 class ModelEntry:
     def __init__(self, card: ModelDeploymentCard, engine: AsyncEngine,
                  kv_router: Optional[KvPushRouter], client,
-                 encode_client=None) -> None:
+                 encode_client=None, token_engine=None,
+                 eos_token_id=None) -> None:
         self.card = card
         self.engine = engine
         self.kv_router = kv_router
         self.client = client
         self.encode_client = encode_client
+        # token-level entry (Migration → router): PreprocessedRequest
+        # dicts in, EngineOutput dicts out — the KServe tensor path and
+        # anything else that already has token ids enters here so it
+        # gets the SAME routing + migration as text traffic
+        self.token_engine = token_engine
+        self.eos_token_id = eos_token_id
         self.card_keys: set[str] = set()
 
     async def stop_clients(self) -> None:
@@ -104,18 +111,21 @@ class ModelManager:
                                 .endpoint(ENCODE_ENDPOINT).client())
             await enc_client.start()
             encode_router = PushRouter(enc_client)
+        migration = Migration(card.migration_limit)
         engine = build_pipeline(
             OpenAIPreprocessor(tokenizer, card.name, card.context_length,
                                tool_call_parser=card.tool_call_parser,
                                reasoning_parser=card.reasoning_parser,
                                encode_router=encode_router),
             Backend(tokenizer),
-            Migration(card.migration_limit),
+            migration,
             sink=router_engine,
         )
         entry = ModelEntry(card, engine, kv_router, client,
                            encode_client=encode_router.client
-                           if encode_router is not None else None)
+                           if encode_router is not None else None,
+                           token_engine=migration,
+                           eos_token_id=tokenizer.eos_token_id)
         entry.card_keys.add(card_key)
         self._models[card.name] = entry
         logger.info("model added: %s (router=%s)", card.name, card.router_mode)

@@ -39,6 +39,30 @@ class ImageEncoderConfig:
         return self.patch_size * self.patch_size * 3
 
 
+def load_trained_encoder(cfg: ImageEncoderConfig) -> dict | None:
+    """Packaged VQ-VAE weights (multimodal/train_encoder.py — trained
+    in-repo on synthetic structured images; this environment ships no
+    pretrained vision checkpoints). Returns None when the file is
+    missing or its shapes don't match `cfg` (caller falls back to
+    random init)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "encoder_weights.npz")
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            proj = z["proj"]
+            codebook = z["codebook"]
+    except (KeyError, OSError, ValueError):
+        # truncated/stale/differently-keyed file: fall back, don't kill
+        # the encode worker at startup
+        return None
+    if proj.shape != (cfg.patch_dim, cfg.embed_dim) or             codebook.shape != (cfg.codebook_size, cfg.embed_dim):
+        return None
+    return {"proj": jnp.asarray(proj), "codebook": jnp.asarray(codebook)}
+
+
 def init_encoder_params(rng: jax.Array,
                         cfg: ImageEncoderConfig) -> dict:
     k1, k2 = jax.random.split(rng)

@@ -1,0 +1,174 @@
+"""Train the image VQ encoder (straight-through VQ-VAE) and save its
+weights into the package.
+
+Why this exists: the serving pipeline (`multimodal/worker.py`) needs an
+encoder whose codes are CONTENT-meaningful — similar patches map to the
+same code, distinct textures to distinct codes — so repeated/related
+images hit the KV prefix cache and the LM sees stable vocabulary.
+This environment has zero egress and ships no pretrained vision
+checkpoints, so the encoder is trained HERE, reproducibly, on a
+synthetic corpus of structured images (gradients, checkers, stripes,
+disks, per-channel noise fields — the primitives real images are
+locally made of). Reference analog: `examples/multimodal`'s encode
+worker wraps a pretrained HF vision tower; ours is small and
+self-trained but plays the identical role in the pipeline.
+
+Objective (VQ-VAE, Oord et al.):
+    z  = (x - mean(x)) @ proj
+    q  = codebook[argmin ||z - c||]
+    x̂ = q @ dec
+    L  = ||x̂ - x||² + ||sg[z] - q||² + β||z - sg[q]||²
+with straight-through gradients through the quantizer and dead-code
+re-seeding (codes unused for a full epoch jump to a random batch
+vector — without it most of a 1024-code book stays dead).
+
+Run `python -m dynamo_tpu.multimodal.train_encoder` to regenerate
+`encoder_weights.npz` (deterministic: seed 0; ~1 min on CPU).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import numpy as np
+
+WEIGHTS_FILE = os.path.join(os.path.dirname(__file__),
+                            "encoder_weights.npz")
+
+
+def synth_images(rng: np.random.Generator, n: int, size: int
+                 ) -> np.ndarray:
+    """(n, size, size, 3) f32 in [0,1]: structured synthetic images."""
+    out = np.zeros((n, size, size, 3), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    for i in range(n):
+        kind = i % 5
+        c0, c1 = rng.random(3), rng.random(3)
+        if kind == 0:                      # linear gradient, random angle
+            a = rng.random() * 2 * np.pi
+            t = (np.cos(a) * xx + np.sin(a) * yy)
+            t = (t - t.min()) / (np.ptp(t) + 1e-6)
+            img = t[..., None] * c0 + (1 - t[..., None]) * c1
+        elif kind == 1:                    # checkerboard, random period
+            p = int(rng.integers(4, 33))
+            m = (((np.arange(size) // p)[:, None]
+                  + (np.arange(size) // p)[None, :]) % 2).astype(np.float32)
+            img = m[..., None] * c0 + (1 - m[..., None]) * c1
+        elif kind == 2:                    # stripes
+            p = rng.integers(3, 24)
+            m = (np.sin(2 * np.pi * xx * p) > 0).astype(np.float32)
+            img = m[..., None] * c0 + (1 - m[..., None]) * c1
+        elif kind == 3:                    # disks on a background
+            img = np.broadcast_to(c1, (size, size, 3)).copy()
+            for _ in range(int(rng.integers(1, 6))):
+                cy, cx = rng.random(2)
+                r = 0.05 + 0.2 * rng.random()
+                mask = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+                img[mask] = rng.random(3)
+        else:                              # smooth per-channel noise
+            low = rng.random((8, 8, 3)).astype(np.float32)
+            reps = size // 8
+            img = np.kron(low, np.ones((reps, reps, 1), np.float32))
+        out[i] = np.clip(img, 0.0, 1.0)
+    return out
+
+
+def train(seed: int = 0, n_images: int = 160, steps: int = 600,
+          lr: float = 3e-3, beta: float = 0.25, verbose: bool = False):
+    """Returns (params dict incl. decoder, final recon loss)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dynamo_tpu.multimodal.encoder import ImageEncoderConfig
+
+    cfg = ImageEncoderConfig()
+    rng = np.random.default_rng(seed)
+    imgs = synth_images(rng, n_images, cfg.image_size)
+    p, s = cfg.patch_size, cfg.image_size
+    n = s // p
+    patches = imgs.reshape(n_images, n, p, n, p, 3) \
+        .transpose(0, 1, 3, 2, 4, 5).reshape(-1, cfg.patch_dim)
+    patches = patches - patches.mean(axis=-1, keepdims=True)
+    patches = jnp.asarray(patches)
+
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = 1.0 / np.sqrt(cfg.patch_dim)
+    params = {
+        "proj": jax.random.normal(
+            k1, (cfg.patch_dim, cfg.embed_dim), jnp.float32) * scale,
+        "codebook": jax.random.normal(
+            k2, (cfg.codebook_size, cfg.embed_dim), jnp.float32) * 0.1,
+        "dec": jax.random.normal(
+            k3, (cfg.embed_dim, cfg.patch_dim), jnp.float32) * 0.05,
+    }
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    def codes_of(params_, x):
+        z = x @ params_["proj"]
+        d = (jnp.sum(params_["codebook"] ** 2, axis=-1)[None, :]
+             - 2.0 * z @ params_["codebook"].T)
+        return jnp.argmin(d, axis=-1), z
+
+    @jax.jit
+    def step(params_, opt_state_, x):
+        def loss_fn(p_):
+            z = x @ p_["proj"]
+            d = (jnp.sum(p_["codebook"] ** 2, axis=-1)[None, :]
+                 - 2.0 * z @ p_["codebook"].T)
+            idx = jnp.argmin(d, axis=-1)
+            q = p_["codebook"][idx]
+            st = z + jax.lax.stop_gradient(q - z)   # straight-through
+            recon = st @ p_["dec"]
+            l_rec = jnp.mean((recon - x) ** 2)
+            l_cb = jnp.mean((jax.lax.stop_gradient(z) - q) ** 2)
+            l_commit = jnp.mean((z - jax.lax.stop_gradient(q)) ** 2)
+            return l_rec + l_cb + beta * l_commit, (l_rec, idx)
+
+        (loss, (l_rec, idx)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_)
+        updates, opt_state_ = opt.update(grads, opt_state_)
+        return optax.apply_updates(params_, updates), opt_state_, \
+            l_rec, idx
+
+    bs = 4096
+    nb = patches.shape[0] // bs
+    used = np.zeros(cfg.codebook_size, bool)
+    l_rec = None
+    for it in range(steps):
+        x = patches[(it % nb) * bs:(it % nb + 1) * bs]
+        params, opt_state, l_rec, idx = step(params, opt_state, x)
+        used[np.asarray(idx)] = True
+        if (it + 1) % nb == 0:
+            # dead-code re-seed: unused codes jump onto random batch
+            # embeddings so the whole book participates
+            dead = np.flatnonzero(~used)
+            if dead.size:
+                z = np.asarray(x @ params["proj"])
+                pick = rng.integers(0, z.shape[0], dead.size)
+                cb = np.array(params["codebook"], copy=True)
+                cb[dead] = z[pick]
+                import jax.numpy as jnp2
+
+                params["codebook"] = jnp2.asarray(cb)
+            used[:] = False
+        if verbose and it % 100 == 0:
+            print(f"step {it}: recon {float(l_rec):.5f}")
+    return ({k: np.asarray(v) for k, v in params.items()},
+            float(l_rec))
+
+
+def main() -> None:
+    params, l_rec = train(verbose=True)
+    np.savez_compressed(WEIGHTS_FILE, **params,
+                        meta_recon_loss=np.float32(l_rec))
+    size = os.path.getsize(WEIGHTS_FILE)
+    print(f"saved {WEIGHTS_FILE} ({size / 2**20:.2f} MiB, "
+          f"recon {l_rec:.5f})")
+
+
+if __name__ == "__main__":
+    main()

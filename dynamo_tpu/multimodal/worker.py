@@ -31,9 +31,16 @@ class EncodeWorkerHandler:
 
     def __init__(self, cfg: Optional[ImageEncoderConfig] = None,
                  rng_seed: int = 0) -> None:
+        from dynamo_tpu.multimodal.encoder import load_trained_encoder
+
         self.cfg = cfg or ImageEncoderConfig()
-        self.params = init_encoder_params(
-            jax.random.PRNGKey(rng_seed), self.cfg)
+        # packaged trained weights by default (content-meaningful
+        # codes); random init only when the file is absent or the
+        # geometry was overridden past it
+        self.params = load_trained_encoder(self.cfg)
+        if self.params is None:
+            self.params = init_encoder_params(
+                jax.random.PRNGKey(rng_seed), self.cfg)
 
     async def generate(self, request: dict, context: Context
                        ) -> AsyncIterator[dict]:

@@ -180,6 +180,10 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
     card.endpoint = args.endpoint
     card.migration_limit = args.migration_limit
     card.router_mode = args.router_mode
+    # real-engine cards must carry the encode component too (the mock
+    # path sets it at construction) — without it `--encode-component`
+    # was silently ignored and image inputs 400'd on real models
+    card.encode_component = args.encode_component
     if event_sink is not None or metrics_sink is not None:
         engine.pool.event_sink = event_sink
         engine.metrics_sink = metrics_sink

@@ -184,3 +184,33 @@ def test_helm_chart_templates_render_to_valid_yaml():
             # renamed argparse flag must fail here, not CrashLoopBackOff
             for flag in [c for c in cmd if c.startswith("--")]:
                 assert flag in help_for(cmd[2]), (cmd[2], flag)
+
+
+def test_inference_gateway_and_tracing_manifests_parse():
+    """The Gateway API Inference Extension + tracing stacks (reference
+    deploy/inference-gateway, deploy/tracing) must be valid YAML and
+    internally consistent (pool/EPP/route names line up; the collector
+    tails the documented trace path)."""
+    import glob
+
+    import yaml
+
+    docs = {}
+    for f in glob.glob("deploy/inference-gateway/*.yaml") + \
+            glob.glob("deploy/tracing/*.yaml"):
+        docs[f] = list(yaml.safe_load_all(open(f)))
+    assert len(docs) >= 8
+
+    pool = docs["deploy/inference-gateway/inference-pool.yaml"]
+    names = {d["metadata"]["name"] for d in pool if d}
+    assert "dynamo-tpu-pool" in names and "dynamo-tpu-epp" in names
+    route = docs["deploy/inference-gateway/http-route.yaml"][0]
+    backend = route["spec"]["rules"][0]["backendRefs"][0]
+    assert backend["kind"] == "InferencePool"
+    assert backend["name"] == "dynamo-tpu-pool"
+    model = docs["deploy/inference-gateway/inference-model.yaml"][0]
+    assert model["spec"]["poolRef"]["name"] == "dynamo-tpu-pool"
+
+    col = docs["deploy/tracing/otel-collector.yaml"][0]
+    assert col["receivers"]["filelog"]["include"] == ["/traces/*.jsonl"]
+    assert col["exporters"]["otlp"]["endpoint"] == "tempo:4317"

@@ -132,8 +132,8 @@ class TpuEngineConfig:
     # each own a disjoint tp submesh (WorkerWithDpRank addressing).
     mesh: Optional[Any] = None
     # Weight quantization: None (bf16), "int8", or "int4" (per-channel
-    # weight-only, engine/quant.py; int4 packs two nibbles per byte via
-    # jnp.int4 — lm_head stays int8 for logit quality). Cuts the decode
+    # weight-only, engine/quant.py; int4 packs two nibbles per int8 byte
+    # — lm_head stays int8 for logit quality). Cuts the decode
     # weight-stream floor 2×/4×; applied device-side with donation after
     # params are placed.
     quantize: Optional[str] = None

@@ -40,25 +40,41 @@ QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QTensor:
-    """Weight stored int8 + per-output-channel fp32 scale.
+    """Weight stored as int8 + per-output-channel fp32 scale.
 
-    q: int8, the original weight shape (..., K, N)
+    bits=8: q is int8 in the original weight shape (..., K, N).
+    bits=4: q is int8 holding TWO 4-bit values per byte, packed
+        pairwise along the LAST axis — q.shape = (..., K, N//2), with
+        logical column 2j in the low nibble of packed column j and
+        column 2j+1 in the high nibble. The leaf dtype stays int8, so
+        nothing S4-typed ever crosses a jit / device_put boundary: on
+        the real-TPU runtime placing an S4 array from eager context
+        recurses forever in device_put (observed on jax 0.9 + the axon
+        plugin), and feeding a `bitcast_convert_type(..., int4)` result
+        straight into `dot` MIScompiles on Mosaic (probed: rel err 2.2
+        vs the exact shift/mask unpack). Unpacking is therefore plain
+        int8 shift arithmetic inside the consuming jit (see _unpack4).
     s: fp32, (..., 1, N) — broadcasts onto the matmul OUTPUT (x @ q) * s.
     """
 
     q: jax.Array
     s: jax.Array
+    bits: int = 8
 
     def tree_flatten(self):
-        return (self.q, self.s), None
+        return (self.q, self.s), self.bits
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(*children, bits=aux if aux else 8)
 
     @property
     def shape(self):
-        return self.q.shape
+        """LOGICAL weight shape (int4 reports the unpacked width)."""
+        sh = self.q.shape
+        if self.bits == 4:
+            return (*sh[:-1], sh[-1] * 2)
+        return sh
 
     @property
     def ndim(self):
@@ -66,6 +82,7 @@ class QTensor:
 
     @property
     def nbytes(self):
+        """Physical bytes (the honest HBM accounting: int4 = N/2)."""
         return self.q.nbytes + self.s.nbytes
 
     @property
@@ -73,19 +90,50 @@ class QTensor:
         return self.q.dtype
 
 
+def pack4(q: jax.Array) -> jax.Array:
+    """int8 values in [-7, 7] → nibble-packed int8, pairs along the
+    last axis (even logical index = low nibble).
+
+    The LOW nibble stores ``lo + 8`` (unsigned, [1, 15]); the HIGH
+    nibble stores ``hi`` two's-complement. This makes the signed byte
+    EXACTLY ``16*hi + (lo + 8)`` (range [-111, 127], no wrap), which is
+    what lets the pallas kernel skip unpacking entirely: it matmuls the
+    raw bytes and the AND-masked low nibbles and recovers the two
+    nibble products algebraically (engine/int4_mm.py)."""
+    assert q.shape[-1] % 2 == 0, q.shape
+    lo = jnp.bitwise_and(q[..., 0::2] + 8, 0xF)
+    hi = jnp.left_shift(q[..., 1::2], 4)
+    return jnp.bitwise_or(lo, hi).astype(jnp.int8)
+
+
+def _unpack4(p: jax.Array) -> jax.Array:
+    """Nibble-packed int8 (..., Np) → int8 values (..., 2*Np).
+
+    Low nibble is bias-8 unsigned (see pack4); high nibble is
+    recovered with an arithmetic shift (sign-extends). int8 end to
+    end — nothing S4-typed, which matters because S4 both breaks
+    device_put from eager context and MIScompiles as a dot operand
+    on this runtime (probed on v5e)."""
+    lo = jnp.bitwise_and(p, 0xF).astype(jnp.int8) - 8
+    hi = jnp.right_shift(p, 4)
+    return jnp.stack([lo, hi], axis=-1).reshape(
+        *p.shape[:-1], p.shape[-1] * 2)
+
+
 def quantize(w: jax.Array, bits: int = 8) -> QTensor:
     """Per-output-channel symmetric int quantization over the
-    contraction dim (-2). bits=8 → int8; bits=4 → int4 (jnp.int4 —
-    XLA packs two nibbles per byte on TPU, halving weight HBM traffic
-    again at a larger rounding error: the decode lever the r2 ablation
-    named after int8)."""
+    contraction dim (-2). bits=8 → int8; bits=4 → nibble-packed int8
+    (two values per byte, halving weight HBM traffic again over int8 at
+    a larger rounding error: the decode lever the r2 ablation named
+    after int8)."""
     assert bits in (8, 4), bits
     wf = jnp.asarray(w).astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     qmax = (1 << (bits - 1)) - 1
     s = jnp.maximum(amax, 1e-12) / qmax
-    dt = jnp.int8 if bits == 8 else jnp.int4
-    q = jnp.clip(jnp.round(wf / s), -qmax, qmax).astype(dt)
+    q = jnp.clip(jnp.round(wf / s), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        return QTensor(q=pack4(q), s=s, bits=4)
     return QTensor(q=q, s=s)
 
 
@@ -94,12 +142,31 @@ def qm(x: jax.Array, w: Any) -> jax.Array:
 
     For QTensor the convert int8→x.dtype fuses into the matmul operand
     read (weight HBM traffic = int8 bytes); the per-channel scale is one
-    elementwise multiply on the (small) output.
+    elementwise multiply on the (small) output. int4 unpacks nibbles
+    with int8 shifts first (see QTensor docstring for why not S4).
     """
     if isinstance(w, QTensor):
+        if w.bits == 4:
+            return _qm4(x, w)
         y = jnp.dot(x, w.q.astype(x.dtype))
         return y * w.s.astype(x.dtype)
     return x @ w
+
+
+def _qm4(x: jax.Array, w: QTensor) -> jax.Array:
+    """int4 matmul: pallas kernel on TPU (int4 HBM traffic), XLA
+    unpack elsewhere (CPU tests / odd shapes)."""
+    from dynamo_tpu.engine.attention import use_pallas
+
+    if use_pallas() and w.q.ndim == 2 and x.shape[-1] % 128 == 0 \
+            and w.q.shape[-1] % 128 == 0:
+        from dynamo_tpu.engine.int4_mm import int4_matmul
+
+        lead = x.shape[:-1]
+        y = int4_matmul(x.reshape(-1, x.shape[-1]), w.q, w.s)
+        return y.reshape(*lead, y.shape[-1])
+    y = jnp.dot(x, _unpack4(w.q).astype(x.dtype))
+    return y * w.s.astype(x.dtype)
 
 
 # Above this vocab width the int8 lm_head matmul sends the XLA/Mosaic

@@ -83,7 +83,8 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
             return QTensor(
                 q=jax.device_put(x.q, NamedSharding(mesh, s)),
                 s=jax.device_put(
-                    x.s, NamedSharding(mesh, scale_spec(s, x.s.ndim))))
+                    x.s, NamedSharding(mesh, scale_spec(s, x.s.ndim))),
+                bits=x.bits)
         return jax.device_put(x, NamedSharding(mesh, s))
 
     return jax.tree.map(

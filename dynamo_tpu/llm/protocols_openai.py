@@ -50,6 +50,18 @@ def _require(cond: bool, msg: str) -> None:
         raise OpenAIError(msg)
 
 
+def _as_int(v, name: str) -> int:
+    """Coerce a JSON field to int, 400ing (not 500ing) on 'abc'/[1]."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise OpenAIError(f"'{name}' must be an integer") from None
+
+
+def _opt_int(v, name: str):
+    return None if v is None else _as_int(v, name)
+
+
 def _guided_from(d: dict, nvext: dict) -> Optional[dict]:
     """Map OpenAI `response_format` + nvext guided_* onto the engine's
     guided spec ({"regex"|"choice"|"json": ...}); at most one source."""
@@ -119,14 +131,16 @@ class ChatCompletionRequest:
         for m in msgs:
             _require(isinstance(m, dict) and "role" in m,
                      "each message needs a 'role'")
-        _require(1 <= int(d.get("n", 1)) <= MAX_N,
+        _require(1 <= _as_int(d.get("n", 1), "n") <= MAX_N,
                  f"'n' must be between 1 and {MAX_N}")
         stop = d.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
         nvext = d.get("nvext") or {}
-        max_tokens = d.get("max_tokens", d.get("max_completion_tokens"))
-        top_lps = int(d.get("top_logprobs") or 0)
+        max_tokens = _opt_int(
+            d.get("max_tokens", d.get("max_completion_tokens")),
+            "max_tokens")
+        top_lps = _as_int(d.get("top_logprobs") or 0, "top_logprobs")
         _require(0 <= top_lps <= MAX_TOP_LOGPROBS,
                  f"'top_logprobs' must be between 0 and "
                  f"{MAX_TOP_LOGPROBS}")
@@ -143,7 +157,7 @@ class ChatCompletionRequest:
             seed=d.get("seed"), stop=list(stop),
             ignore_eos=bool(d.get("ignore_eos",
                                   nvext.get("ignore_eos", False))),
-            min_tokens=d.get("min_tokens"),
+            min_tokens=_opt_int(d.get("min_tokens"), "min_tokens"),
             logprobs=bool(d.get("logprobs")),
             top_logprobs=top_lps, n=int(d.get("n", 1)),
             guided=_guided_from(d, nvext),
@@ -206,7 +220,7 @@ class CompletionRequest:
         _require(bool(d.get("model")), "'model' is required")
         prompt = d.get("prompt")
         _require(prompt is not None, "'prompt' is required")
-        _require(1 <= int(d.get("n", 1)) <= MAX_N,
+        _require(1 <= _as_int(d.get("n", 1), "n") <= MAX_N,
                  f"'n' must be between 1 and {MAX_N}")
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
             _require(len(prompt) == 1, "batch prompts not supported yet")
@@ -216,12 +230,13 @@ class CompletionRequest:
             stop = [stop]
         nvext = d.get("nvext") or {}
         lps = d.get("logprobs")
-        _require(lps is None or 0 <= int(lps) <= MAX_TOP_LOGPROBS,
+        lps = None if lps is None else _as_int(lps, "logprobs")
+        _require(lps is None or 0 <= lps <= MAX_TOP_LOGPROBS,
                  f"'logprobs' must be between 0 and {MAX_TOP_LOGPROBS}")
-        lps = None if lps is None else int(lps)
         return cls(
             model=d["model"], prompt=prompt, stream=bool(d.get("stream")),
-            max_tokens=d.get("max_tokens"), temperature=d.get("temperature"),
+            max_tokens=_opt_int(d.get("max_tokens"), "max_tokens"),
+            temperature=d.get("temperature"),
             top_p=d.get("top_p"), top_k=d.get("top_k", nvext.get("top_k")),
             min_p=d.get("min_p"),
             frequency_penalty=d.get("frequency_penalty"),
@@ -229,7 +244,7 @@ class CompletionRequest:
             seed=d.get("seed"), stop=list(stop),
             ignore_eos=bool(d.get("ignore_eos",
                                   nvext.get("ignore_eos", False))),
-            min_tokens=d.get("min_tokens"),
+            min_tokens=_opt_int(d.get("min_tokens"), "min_tokens"),
             echo=bool(d.get("echo")),
             logprobs=lps,
             n=int(d.get("n", 1)),

@@ -111,7 +111,9 @@ def _param_in_specs(params, tp_axis):
 
     def spec_of(x, s):
         if isinstance(x, QTensor):
-            return QTensor(q=s, s=scale_spec(s, x.s.ndim))
+            # bits must match the param QTensor's aux or the spec tree's
+            # treedef diverges from the arg tree's under shard_map
+            return QTensor(q=s, s=scale_spec(s, x.s.ndim), bits=x.bits)
         return s
 
     return jax.tree.map(spec_of, params, param_specs(),

@@ -253,7 +253,8 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
                 qt.q.block_until_ready()
                 qs.append(qt.q)
                 ss.append(qt.s)
-            layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss))
+            layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss),
+                                  bits=bits)
             del qs, ss
         else:
             layers[key] = jnp.stack(

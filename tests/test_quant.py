@@ -200,18 +200,33 @@ def test_sharded_quantized_prefill_matches_unsharded(cpu_mesh_devices):
 
 
 def test_int4_quantize_roundtrip_and_qm():
+    from dynamo_tpu.engine.quant import _unpack4
+
     w = jax.random.normal(jax.random.PRNGKey(5), (64, 32), jnp.float32)
     qt = quantize(w, bits=4)
-    assert str(qt.q.dtype) == "int4" and qt.q.shape == w.shape
-    deq = qt.q.astype(jnp.float32) * qt.s
+    # physical leaf is nibble-packed int8 (no S4 dtype at any boundary)
+    assert str(qt.q.dtype) == "int8" and qt.bits == 4
+    assert qt.q.shape == (64, 16) and qt.shape == w.shape
+    unpacked = jax.jit(_unpack4)(qt.q)
+    assert unpacked.shape == w.shape
+    deq = unpacked.astype(jnp.float32) * qt.s
     # rounding error <= s/2 per element at 4 bits
     assert np.all(np.abs(np.asarray(deq - w)) <= np.asarray(qt.s) / 2
                   + 1e-6)
     x = jax.random.normal(jax.random.PRNGKey(6), (4, 64), jnp.float32)
     got = qm(x, qt)
-    want = x @ (qt.q.astype(jnp.float32) * qt.s)
+    want = x @ (np.asarray(unpacked, np.float32) * np.asarray(qt.s))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_int4_pack_unpack_exact():
+    from dynamo_tpu.engine.quant import _unpack4, pack4
+
+    q = jax.random.randint(jax.random.PRNGKey(7), (16, 32), -7, 8,
+                           jnp.int8)
+    assert np.array_equal(np.asarray(jax.jit(_unpack4)(pack4(q))),
+                          np.asarray(q))
 
 
 def test_int4_params_lm_head_stays_int8():
@@ -219,8 +234,8 @@ def test_int4_params_lm_head_stays_int8():
 
     params = init_params(jax.random.PRNGKey(0), CFG)
     q = quantize_params(params, mode="int4")
-    assert str(q["layers"]["w_gate"].q.dtype) == "int4"
-    assert str(q["lm_head"].q.dtype) == "int8"   # logit quality
+    assert q["layers"]["w_gate"].bits == 4
+    assert q["lm_head"].bits == 8                # logit quality
 
 
 async def test_engine_int4_serves_and_tracks_int8():

@@ -165,6 +165,19 @@ def test_completion_request_logprobs_int_maps_to_topk():
     with pytest.raises(OpenAIError):
         CompletionRequest.from_dict(
             {"model": "m", "prompt": "x", "logprobs": 50})
+    # non-numeric values must 400 (OpenAIError), not escape as a bare
+    # ValueError/TypeError and 500
+    for bad in ("abc", [3], {"k": 1}):
+        with pytest.raises(OpenAIError, match="logprobs"):
+            CompletionRequest.from_dict(
+                {"model": "m", "prompt": "x", "logprobs": bad})
+    with pytest.raises(OpenAIError, match="'n'"):
+        CompletionRequest.from_dict(
+            {"model": "m", "prompt": "x", "n": "lots"})
+    with pytest.raises(OpenAIError, match="top_logprobs"):
+        ChatCompletionRequest.from_dict(
+            {"model": "m", "messages": [{"role": "user", "content": "x"}],
+             "logprobs": True, "top_logprobs": "many"})
 
 
 # -- pipeline layer ---------------------------------------------------------

@@ -131,6 +131,19 @@ class TpuEngineConfig:
     # step runs SPMD over it. One engine = one rank's (sub)mesh; dp ranks
     # each own a disjoint tp submesh (WorkerWithDpRank addressing).
     mesh: Optional[Any] = None
+    # Pipeline parallelism (models/llama_pp.py): a 1-D ("pp",) Mesh.
+    # The layer stack (weights AND the paged KV cache) shards into
+    # contiguous stage slices; prefill pipelines prompt CHUNKS through
+    # the stages (pp_prefill_paged) and decode round-robins
+    # pp_microbatches lane groups with a psum token mailbox
+    # (pp_decode_multi_step). For models whose weights exceed a TP
+    # slice's HBM. Requires max_batch_size % pp_microbatches == 0 and
+    # pp_microbatches >= the stage count; spec/guided/min_p/penalty/
+    # top-logprob lanes are rejected (plain top_k/top_p sampling rides
+    # the pipeline). Reference serves PP via engine flags:
+    # trtllm_utils.py:39,167-170 --pipeline-parallel-size.
+    pp_mesh: Optional[Any] = None
+    pp_microbatches: int = 2
     # Weight quantization: None (bf16), "int8", or "int4" (per-channel
     # weight-only, engine/quant.py; int4 packs two nibbles per int8 byte
     # — lm_head stays int8 for logit quality). Cuts the decode
@@ -268,7 +281,50 @@ class TpuEngine:
                            for x in jax.tree.leaves(p))
             return jax.device_put(p), owned or all_host
 
-        if cfg.mesh is None:
+        if cfg.pp_mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from dynamo_tpu.models.llama_pp import (
+                pp_cache_specs,
+                pp_param_specs,
+            )
+
+            n_stages = cfg.pp_mesh.shape["pp"]
+            if cfg.mesh is not None or cfg.sp_mesh is not None:
+                raise ValueError("pp_mesh does not compose with mesh/"
+                                 "sp_mesh (one layout per engine)")
+            if cfg.draft_model is not None or cfg.quantize:
+                raise ValueError("pp_mesh does not yet support "
+                                 "speculative decoding or quantize")
+            if cfg.pp_microbatches < n_stages:
+                raise ValueError(
+                    f"pp_microbatches={cfg.pp_microbatches} must be >= "
+                    f"pp stages {n_stages} (the decode mailbox needs a "
+                    f"microbatch's token sampled before its next slot)")
+            if cfg.max_batch_size % cfg.pp_microbatches:
+                raise ValueError("max_batch_size must be divisible by "
+                                 "pp_microbatches")
+            if mcfg.num_layers % n_stages:
+                raise ValueError(f"{mcfg.num_layers} layers not "
+                                 f"divisible by pp={n_stages}")
+            if params is None:
+                params = init_params(jax.random.PRNGKey(cfg.rng_seed),
+                                     mcfg)
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(cfg.pp_mesh, s)),
+                params, pp_param_specs(),
+                is_leaf=lambda x: not isinstance(x, dict))
+            # paged KV stacked (L, KVH, N, P, D), layer axis over pp —
+            # each stage holds its slice's pages only
+            shape = (mcfg.num_layers, mcfg.num_kv_heads, cfg.num_pages,
+                     mcfg.page_size, mcfg.head_dim)
+            mk_cache = jax.jit(
+                lambda: jnp.zeros(shape, mcfg.dtype),
+                out_shardings=NamedSharding(cfg.pp_mesh,
+                                            pp_cache_specs()))
+            self.k_cache, self.v_cache = mk_cache(), mk_cache()
+        elif cfg.mesh is None:
             if params is None:
                 params = init_params(jax.random.PRNGKey(cfg.rng_seed), mcfg)
             else:
@@ -434,6 +490,16 @@ class TpuEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self._progress = 0  # scheduler forward-progress token (canary)
+        # Cumulative phase counters (bench/perf tooling reads deltas):
+        # wall time inside prefill / decode scheduler steps, prompt
+        # tokens newly prefilled (cache hits excluded), and tokens
+        # emitted overall vs by prefill (decode emits = difference).
+        # The reference separates these phases at the metrics layer too
+        # (TTFT vs ITL in aiperf; ForwardPassMetrics prefill/decode
+        # queues) — here the split is measured at the source.
+        self.perf = {"prefill_s": 0.0, "decode_s": 0.0,
+                     "prefill_new_tokens": 0, "prefill_emitted": 0,
+                     "tokens_emitted": 0}
         self._rng = np.random.RandomState(cfg.rng_seed)
         # Serializes device access: step functions donate the cache buffers
         # (the pre-step arrays die mid-call), so concurrent readers
@@ -484,6 +550,20 @@ class TpuEngine:
                 token_ids=[], finish_reason=FINISH_ERROR,
                 extra={"error": "empty prompt"}).to_dict()
             return
+        if cfg.pp_mesh is not None:
+            sp_ = req.sampling
+            if (sp_.guided or sp_.min_p > 0.0 or sp_.top_logprobs > 0
+                    or sp_.repetition_penalty != 1.0
+                    or sp_.frequency_penalty != 0.0
+                    or sp_.presence_penalty != 0.0):
+                # the pp decode pipeline runs the plain sampled burst
+                # only; reject up front rather than silently ignore
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": "pipeline-parallel engines do not "
+                                    "support guided/min_p/penalties/"
+                                    "top_logprobs"}).to_dict()
+                return
         guided_tables = None
         guided_key = None
         if req.sampling.guided:
@@ -675,8 +755,15 @@ class TpuEngine:
                     if fresh:
                         await asyncio.gather(
                             *(self.kvbm.onboard_remote(s) for s in fresh))
+                t0 = time.perf_counter()
                 progressed = await self._prefill_pending()
-                progressed |= await self._decode_iter()
+                t1 = time.perf_counter()
+                if progressed:
+                    self.perf["prefill_s"] += t1 - t0
+                decoded = await self._decode_iter()
+                if decoded:
+                    self.perf["decode_s"] += time.perf_counter() - t1
+                progressed |= decoded
                 self._publish_metrics()
                 if progressed:
                     self._progress += 1
@@ -776,8 +863,13 @@ class TpuEngine:
             offsets = {id(s): s.cached_len for s in pending}
             if self._sp_params is not None:
                 self._sp_bulk_prefill(pending, offsets)
-            self.k_cache, self.v_cache, last_logits = run_chunks(
-                self.params, mcfg, self.k_cache, self.v_cache, offsets)
+            if cfg.pp_mesh is not None:
+                self.k_cache, self.v_cache, last_logits = \
+                    self._pp_prefill_all(pending, offsets)
+            else:
+                self.k_cache, self.v_cache, last_logits = run_chunks(
+                    self.params, mcfg, self.k_cache, self.v_cache,
+                    offsets)
             if self.draft_params is not None:
                 # the draft's paged cache must hold the prompt KV too —
                 # over the FULL prompt, never trusting the cached prefix:
@@ -864,6 +956,9 @@ class TpuEngine:
                 topk_lp=tk)
             return np.asarray(sampled), tk                # ONE host sync
 
+        self.perf["prefill_new_tokens"] += sum(
+            max(len(s.prompt) - s.cached_len, 0) for s in pending)
+        self.perf["prefill_emitted"] += len(pending)
         async with self._device_lock:
             packed, tk = await asyncio.to_thread(prefill_all)
         tokens = packed[0].astype(np.int32)
@@ -1076,6 +1171,28 @@ class TpuEngine:
                         if 0 <= t < V:
                             out_counts[i, t] = c
 
+        if cfg.pp_mesh is not None:
+            from dynamo_tpu.models.llama_pp import pp_decode_multi_step
+
+            def run_pp_burst():
+                packed, kc, vc = pp_decode_multi_step(
+                    self.params, self.k_cache, self.v_cache,
+                    jax.numpy.asarray(tokens),
+                    jax.numpy.asarray(positions),
+                    jax.numpy.asarray(page_tables),
+                    jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                    jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
+                    mcfg, cfg.pp_mesh, k_steps,
+                    n_micro=cfg.pp_microbatches)
+                return np.asarray(packed), kc, vc     # ONE host sync
+
+            async with self._device_lock:
+                packed, self.k_cache, self.v_cache = \
+                    await asyncio.to_thread(run_pp_burst)
+            self._emit_burst(batch, packed, k_steps, 0)
+            return True
+
         if cfg.pipeline_bursts and not use_constrained:
             # plain fused burst, double-buffered: dispatch WITHOUT
             # syncing, then consume (which may speculate the next burst
@@ -1172,6 +1289,42 @@ class TpuEngine:
                             for j in range(width)]
                 self._emit_token(s, int(sampled[k, i]),
                                  float(logprobs[k, i]), topk=topk)
+
+    def _pp_prefill_all(self, pending: list[_Seq],
+                        offsets: dict[int, int]):
+        """Pipeline-parallel prefill of a pending wave: one
+        pp_prefill_paged call over a (B_pad, T_pad) padded batch —
+        chunks flow through the stages as GPipe microbatches and each
+        stage writes its layer slice's paged KV. Shapes are bucketed
+        (pow2 lanes × pow2-of-chunk tokens, floor n_stages chunks) so
+        the compile count stays bounded like the chunk-loop path's."""
+        from dynamo_tpu.models.llama_pp import pp_prefill_paged
+
+        cfg, mcfg = self.config, self.model_cfg
+        n_stages = cfg.pp_mesh.shape["pp"]
+        chunk = min(cfg.prefill_chunk, 128)
+        longest = max(len(s.prompt) - offsets[id(s)] for s in pending)
+        t_pad = _next_pow2(max(longest, chunk * n_stages), chunk,
+                           1 << 30)
+        b_pad = _next_pow2(len(pending), 1, cfg.max_batch_size)
+        max_pages = mcfg.max_pages_per_seq
+        tokens = np.zeros((b_pad, t_pad), dtype=np.int32)
+        tables = np.zeros((b_pad, max_pages), dtype=np.int32)
+        cached = np.zeros(b_pad, dtype=np.int32)
+        seq_lens = np.zeros(b_pad, dtype=np.int32)
+        for i, s in enumerate(pending):
+            off = offsets[id(s)]
+            new = s.prompt[off:]
+            tokens[i, :len(new)] = new
+            tables[i, :len(s.pages)] = s.pages
+            cached[i] = off
+            seq_lens[i] = len(s.prompt)
+        logits, self.k_cache, self.v_cache = pp_prefill_paged(
+            self.params, self.k_cache, self.v_cache,
+            jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
+            cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
+        last_logits = {id(s): logits[i] for i, s in enumerate(pending)}
+        return self.k_cache, self.v_cache, last_logits
 
     def _sp_bulk_prefill(self, pending: list[_Seq],
                          offsets: dict[int, int]) -> None:
@@ -1620,6 +1773,7 @@ class TpuEngine:
         seq.out_counter[token] = seq.out_counter.get(token, 0) + 1
         seq.next_token = token
         seq.generated += 1
+        self.perf["tokens_emitted"] += 1
         finish = None
         if seq.req.stop.stop_token_ids and \
                 token in seq.req.stop.stop_token_ids and \

@@ -199,6 +199,8 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      spec_iters_per_sync: int = 8, sp_degree: int = 0,
                      sp_threshold: int = 2048, sp_layout: str = "zigzag",
                      prefill_batch_widths=None,
+                     pipeline_parallel_size: int = 1,
+                     pp_microbatches: int = 0,
                      **model_overrides):
     """(TpuEngine, ModelDeploymentCard) for a real checkpoint.
 
@@ -246,6 +248,22 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
         from dynamo_tpu.engine.ring_attention import sp_mesh as make_sp
 
         sp_mesh = make_sp(sp_degree)
+    pp_mesh = None
+    if pipeline_parallel_size > 1:
+        # stage slices over the first N local devices (ref: trtllm
+        # --pipeline-parallel-size, trtllm_utils.py:39,167-170)
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh as _Mesh
+
+        devs = jax.devices()[:pipeline_parallel_size]
+        if len(devs) < pipeline_parallel_size:
+            raise ValueError(
+                f"pipeline_parallel_size={pipeline_parallel_size} "
+                f"exceeds local device count {len(jax.devices())}")
+        pp_mesh = _Mesh(_np.asarray(devs), axis_names=("pp",))
+        if not pp_microbatches:
+            pp_microbatches = pipeline_parallel_size
     draft_cfg = draft_params = None
     if draft_model is not None:
         dpath = resolve_model(draft_model)
@@ -290,7 +308,9 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                         sp_mesh=sp_mesh,
                         sp_threshold=sp_threshold if sp_mesh else 0,
                         sp_layout=sp_layout,
-                        prefill_batch_widths=prefill_batch_widths),
+                        prefill_batch_widths=prefill_batch_widths,
+                        pp_mesh=pp_mesh,
+                        pp_microbatches=pp_microbatches or 2),
         params=params, draft_params=draft_params,
         token_bytes=token_bytes, eos_token_id=eos_id)
     if kvbm_host_blocks:

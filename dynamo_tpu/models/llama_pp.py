@@ -154,6 +154,146 @@ def pp_prefill_logits(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 # ---------------------------------------------------------------------------
+# paged prefill pipeline (the serving path: writes paged KV per stage)
+# ---------------------------------------------------------------------------
+
+
+def _pp_prefill_paged_local(params, kc_all, vc_all, tokens_c,
+                            page_tables, cached_lens, seq_lens,
+                            cfg: LlamaConfig, axis: str, n_stages: int,
+                            n_chunks: int):
+    """Per-stage body: chunk-microbatched paged prefill.
+
+    Microbatches are CHUNKS of the same sequence batch in time order —
+    the GPipe schedule delivers chunk c to stage s one step before
+    chunk c+1, so every layer's KV for chunk c is written before chunk
+    c+1 attends it (same causality the engine's sequential chunk loop
+    provides, now pipelined across stages).
+
+    tokens_c: (C, B, Tc); caches (L_local, KVH, N, P, D) stage-local;
+    page_tables (B, max_pages); cached_lens/seq_lens (B,). Returns
+    ((1, B, V) last-token logits — real on the last stage, kc, vc).
+    """
+    from dynamo_tpu.engine.attention import prefill_attention
+
+    stage = lax.axis_index(axis)
+    C, B, Tc = tokens_c.shape
+    E, V, P_ = cfg.hidden_size, cfg.vocab_size, cfg.page_size
+    L_local = kc_all.shape[0]
+
+    out0 = jnp.zeros((B, V), jnp.float32)
+    x0 = jnp.zeros((B, Tc, E), cfg.dtype)
+    out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def step(carry, r):
+        x_recv, kc_all, vc_all, out = carry
+        c = r - stage
+        active = (c >= 0) & (c < C)
+        c_safe = jnp.clip(c, 0, C - 1)
+        toks = lax.dynamic_index_in_dim(tokens_c, c_safe, 0, False)
+        positions = (cached_lens[:, None] + c_safe * Tc
+                     + jnp.arange(Tc)[None, :])             # (B, Tc)
+        new_valid = (positions < seq_lens[:, None]) & active
+        page_ids = jnp.take_along_axis(page_tables, positions // P_,
+                                       axis=1)
+        offsets = positions % P_
+
+        def flat(a):
+            return a.reshape((B * Tc,) + a.shape[2:])
+
+        x = jnp.where(stage == 0, params["embed"][toks], x_recv)
+        new_k, new_v = [], []
+        for l in range(L_local):
+            lp = _layer_params(params, l)
+            kc, vc = kc_all[l], vc_all[l]
+            hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+            q = qm(hn, lp["wq"]).reshape(B, Tc, cfg.num_heads,
+                                         cfg.head_dim)
+            k = qm(hn, lp["wk"]).reshape(B, Tc, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            v = qm(hn, lp["wv"]).reshape(B, Tc, cfg.num_kv_heads,
+                                         cfg.head_dim)
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            kc, vc = _write_kv(kc, vc, flat(k), flat(v), flat(page_ids),
+                               flat(offsets), flat(new_valid))
+            attn = jax.vmap(
+                lambda q1, pt, pos1, sl: prefill_attention(
+                    q1, kc, vc, pt, q_positions=pos1, seq_len=sl,
+                    page_size=P_)
+            )(q, page_tables, positions, seq_lens)          # (B, Tc, H, D)
+            x = x + qm(attn.reshape(B, Tc, -1), lp["wo"])
+            hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+            x = x + _swiglu(hn, lp)
+            new_k.append(kc)
+            new_v.append(vc)
+        kc_all = jnp.stack(new_k)
+        vc_all = jnp.stack(new_v)
+
+        # last stage: lanes whose final new token lives in THIS chunk
+        # get their logits written
+        xf = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        last_rel = seq_lens - 1 - cached_lens - c_safe * Tc  # (B,)
+        in_chunk = (last_rel >= 0) & (last_rel < Tc) & active
+        idx = jnp.clip(last_rel, 0, Tc - 1)
+        x_last = jnp.take_along_axis(xf, idx[:, None, None],
+                                     axis=1)[:, 0]           # (B, E)
+        logits = qm(x_last, params["lm_head"]).astype(jnp.float32)
+        write = in_chunk & (stage == n_stages - 1)
+        out = jnp.where(write[:, None], logits, out)
+        x_next = lax.ppermute(x, axis, perm)
+        return (x_next, kc_all, vc_all, out), None
+
+    (_, kc_all, vc_all, out), _ = lax.scan(
+        step, (x0, kc_all, vc_all, out0),
+        jnp.arange(n_chunks + n_stages - 1))
+    return out[None], kc_all, vc_all
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "mesh", "axis", "n_chunks"),
+                   donate_argnums=(1, 2))
+def _pp_prefill_paged_jit(params, k_cache, v_cache, tokens_c,
+                          page_tables, cached_lens, seq_lens,
+                          cfg: LlamaConfig, mesh: Mesh, axis: str,
+                          n_chunks: int):
+    n_stages = mesh.shape[axis]
+    fn = jax.shard_map(
+        functools.partial(_pp_prefill_paged_local, cfg=cfg, axis=axis,
+                          n_stages=n_stages, n_chunks=n_chunks),
+        mesh=mesh,
+        in_specs=(pp_param_specs(), pp_cache_specs(), pp_cache_specs(),
+                  P(None, None, None), P(None, None), P(None), P(None)),
+        out_specs=(P(axis, None, None), pp_cache_specs(),
+                   pp_cache_specs()))
+    return fn(params, k_cache, v_cache, tokens_c, page_tables,
+              cached_lens, seq_lens)
+
+
+def pp_prefill_paged(params: dict, k_cache, v_cache, tokens: jax.Array,
+                     page_tables: jax.Array, cached_lens: jax.Array,
+                     seq_lens: jax.Array, cfg: LlamaConfig, mesh: Mesh,
+                     chunk: int, axis: str = "pp"):
+    """Serving prefill under pp: tokens (B, T) uncached suffixes (padded;
+    T a multiple of `chunk`), paged KV written stage-locally, last-token
+    logits (B, V) returned. Greedy-equivalent to the engine's sequential
+    chunk loop on the same weights (the schedule changes WHERE layers
+    run, not what they compute)."""
+    n_stages = mesh.shape[axis]
+    assert cfg.num_layers % n_stages == 0
+    B, T = tokens.shape
+    assert T % chunk == 0, (T, chunk)
+    C = T // chunk
+    tokens_c = jnp.swapaxes(tokens.reshape(B, C, chunk), 0, 1)  # (C,B,Tc)
+    out, k_cache, v_cache = _pp_prefill_paged_jit(
+        params, k_cache, v_cache, tokens_c, page_tables,
+        jnp.asarray(cached_lens), jnp.asarray(seq_lens), cfg, mesh, axis,
+        C)
+    return out[-1], k_cache, v_cache   # last stage holds the real rows
+
+
+# ---------------------------------------------------------------------------
 # decode pipeline
 # ---------------------------------------------------------------------------
 

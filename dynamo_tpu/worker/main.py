@@ -101,6 +101,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="host:port of node 0's jax coordinator")
     mn.add_argument("--tensor-parallel-size", type=int, default=1,
                     help="tp over the (possibly multi-host) device mesh")
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="GPipe stage count over local devices: layer "
+                        "stack + paged KV shard into stage slices "
+                        "(models/llama_pp.py; for weights past a TP "
+                        "slice's HBM)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="decode lane groups in flight through the pp "
+                        "stages (default: the stage count)")
     p.add_argument("--kvbm-host-blocks", type=int, default=0,
                    help="enable the KVBM host tier with this many blocks")
     # mocker knobs
@@ -171,7 +179,9 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         spec_gamma=args.spec_gamma,
         spec_iters_per_sync=args.spec_iters_per_sync,
         sp_degree=args.sp_degree, sp_threshold=args.sp_threshold,
-        sp_layout=args.sp_layout, **overrides)
+        sp_layout=args.sp_layout,
+        pipeline_parallel_size=args.pipeline_parallel_size,
+        pp_microbatches=args.pp_microbatches, **overrides)
     if mesh is not None:
         card.runtime_config.tensor_parallel_size = args.tensor_parallel_size
     engine.config.prefill_chunk = args.prefill_chunk

@@ -1,0 +1,116 @@
+"""Engine-integrated pipeline parallelism (TpuEngineConfig.pp_mesh).
+
+A pp=2 TpuEngine serves requests through pp_prefill_paged (chunk
+microbatches, stage-local paged KV) + pp_decode_multi_step (lane-group
+microbatches, psum token mailbox); greedy output must equal the plain
+engine's on the same weights — VERDICT r3 #6's done-criterion.
+Reference: trtllm --pipeline-parallel-size (trtllm_utils.py:39,167-170).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+# float32: pp pads prompts to different chunk widths than the plain
+# engine's buckets, which legitimately flips one-ulp bf16 near-ties on
+# random tiny-model logits (probed: stage-local layer outputs bit-match
+# in their own dtype; the drift enters at padded-shape-dependent XLA
+# fusion). f32 margins make greedy equality decisive.
+import jax.numpy as jnp
+
+CFG = LlamaConfig.tiny(num_layers=4, max_pages_per_seq=32,
+                       dtype=jnp.float32)
+
+
+def pp_mesh(devices, n=2):
+    return Mesh(np.asarray(devices[:n]), axis_names=("pp",))
+
+
+async def generate(eng, prompt, n_tokens=10, **sampling):
+    req = {"token_ids": list(prompt), "model": "m",
+           "sampling": {"temperature": 0.0, **sampling},
+           "stop": {"max_tokens": n_tokens}}
+    return [t async for o in eng.generate(req, Context())
+            for t in o.get("token_ids", [])]
+
+
+async def test_pp_engine_matches_plain_engine(cpu_mesh_devices):
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[(i * 7 + j) % 250 + 1 for j in range(21 + 5 * i)]
+               for i in range(3)]
+
+    plain = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4), params=params)
+    base = [await generate(plain, p) for p in prompts]
+    await plain.close()
+
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4, pp_mesh=pp_mesh(cpu_mesh_devices),
+        pp_microbatches=2), params=params)
+    got = [await generate(eng, p) for p in prompts]
+    assert got == base, (got, base)
+    await eng.close()
+
+
+async def test_pp_engine_concurrent_batch(cpu_mesh_devices):
+    """Concurrent lanes through the pp pipeline (batched prefill wave +
+    microbatched decode) match the plain engine lane-for-lane."""
+    import asyncio
+
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    prompts = [[(i * 11 + j) % 250 + 1 for j in range(17 + 3 * i)]
+               for i in range(4)]
+
+    plain = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4), params=params)
+    base = await asyncio.gather(*(generate(plain, p) for p in prompts))
+    await plain.close()
+
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4, pp_mesh=pp_mesh(cpu_mesh_devices),
+        pp_microbatches=2), params=params)
+    got = await asyncio.gather(*(generate(eng, p) for p in prompts))
+    assert got == base, (got, base)
+    await eng.close()
+
+
+async def test_pp_engine_rejects_unsupported_sampling(cpu_mesh_devices):
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4, pp_mesh=pp_mesh(cpu_mesh_devices),
+        pp_microbatches=2))
+    req = {"token_ids": [5, 6, 7], "model": "m",
+           "sampling": {"temperature": 0.0, "top_logprobs": 3},
+           "stop": {"max_tokens": 4}}
+    outs = [o async for o in eng.generate(req, Context())]
+    assert outs[0]["finish_reason"] == "error"
+    assert "pipeline-parallel" in outs[0]["extra"]["error"]
+    await eng.close()
+
+
+def test_pp_engine_config_validation(cpu_mesh_devices):
+    mesh = pp_mesh(cpu_mesh_devices)
+    with pytest.raises(ValueError, match="microbatches"):
+        TpuEngine(TpuEngineConfig(model=CFG, num_pages=16,
+                                  max_batch_size=4, pp_mesh=mesh,
+                                  pp_microbatches=1))
+    with pytest.raises(ValueError, match="divisible"):
+        TpuEngine(TpuEngineConfig(model=CFG, num_pages=16,
+                                  max_batch_size=3, pp_mesh=mesh,
+                                  pp_microbatches=2))
+    with pytest.raises(ValueError, match="quantize"):
+        TpuEngine(TpuEngineConfig(model=CFG, num_pages=16,
+                                  max_batch_size=4, pp_mesh=mesh,
+                                  pp_microbatches=2, quantize="int8"))

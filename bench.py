@@ -501,9 +501,37 @@ async def _phase_kv_body(eng, n_pages):
         (await eng.read_kv_pages_device(pages)).block_until_ready()
     dev_s = (time.perf_counter() - t0) / reps
     del dev
+    # device-to-device plane (jax.experimental.transfer): stage + pull
+    # through the transfer server — the cross-process KV path's cost on
+    # this chip (same-process here; cross-host adds the DCN hop)
+    plane_out = {}
+    try:
+        import asyncio as _aio
+
+        from dynamo_tpu.disagg.transfer_plane import get_plane
+
+        plane = get_plane()
+        target = list(eng.k_cache[0].devices())[0]
+
+        async def stage_pull(i):
+            arr = await eng.read_kv_pages_device(pages)
+            desc = plane.publish(f"bench-plane-{i}", arr)
+            return await _aio.to_thread(plane.pull, desc, target)
+
+        out = await stage_pull(0)                      # warm
+        del out
+        t0 = time.perf_counter()
+        for i in range(1, reps + 1):
+            del_me = await stage_pull(i)
+            del del_me
+        plane_s = (time.perf_counter() - t0) / reps
+        plane_out = {"kv_plane_gbps": round(nbytes / plane_s / 1e9, 2)}
+    except Exception as e:
+        plane_out = {"kv_plane_error": f"{type(e).__name__}: {e}"[:120]}
     return {"kv_transfer_mb": round(nbytes / 1e6, 1),
             "kv_host_gbps": round(nbytes / host_s / 1e9, 2),
-            "kv_device_gbps": round(nbytes / dev_s / 1e9, 2)}
+            "kv_device_gbps": round(nbytes / dev_s / 1e9, 2),
+            **plane_out}
 
 
 # ---------------------------------------------------------------------------

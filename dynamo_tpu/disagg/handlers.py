@@ -87,6 +87,29 @@ class PrefillWorkerHandler:
         except KeyError:
             yield {"error": f"unknown transfer {tid}"}
             return
+        if request.get("stage"):
+            # device-to-device plane (transfer_plane.py): stage a device
+            # copy for the peer to pull over ICI/DCN, release the pages
+            # now (the copy is independent), reply with the descriptor —
+            # no bulk bytes on this transport
+            from dynamo_tpu.disagg.transfer_plane import (
+                get_plane,
+                plane_enabled,
+            )
+
+            if not plane_enabled():
+                yield {"error": "kv plane disabled (DYN_KV_PLANE=0)"}
+                return
+            try:
+                arr = await self.engine.read_kv_pages_device(pages)
+                desc = get_plane().publish(tid, arr)
+            except Exception as e:
+                logger.exception("kv plane staging failed")
+                yield {"error": f"stage failed: {e}"}
+                return
+            self.engine.complete_transfer(tid)
+            yield {"plane": desc, "prefill_len": prefill_len}
+            return
         total = len(pages)
         # chunking is OPT-IN by the requester: a peer that doesn't send
         # chunk_pages (an older decode client reads exactly one frame)
@@ -164,7 +187,9 @@ class DecodeWorkerHandler:
         # pull-model alternative to prefill_router: jobs ride the durable
         # queue, any prefill worker takes them (prefill_queue.py)
         self.prefill_queue_client = prefill_queue_client
-        self.last_pull_path: Optional[str] = None  # "device" | "wire"
+        # "device" (same-process) | "plane" (cross-process
+        # device-to-device) | "wire" (chunked host frames)
+        self.last_pull_path: Optional[str] = None
 
     def _can_prefill_remote(self) -> bool:
         if self.kv_pull_router is None:
@@ -222,6 +247,58 @@ class DecodeWorkerHandler:
                 # pull it, and its failure path falls back to local serve
                 logger.exception("device-side KV pull failed; trying "
                                  "the transport")
+        # cross-process device-to-device plane: ask the owner to STAGE
+        # the pages on its transfer server, then pull them straight onto
+        # our devices (jax.experimental.transfer — no host bounce). Any
+        # failure falls through to the chunked host wire.
+        from dynamo_tpu.disagg.transfer_plane import (
+            get_plane,
+            plane_enabled,
+        )
+
+        if plane_enabled():
+            staged = False
+            try:
+                async for frame in self.kv_pull_router.direct(
+                        {"transfer_id": ktp["transfer_id"],
+                         "stage": True},
+                        ktp["instance_id"], context):
+                    desc = frame.get("plane")
+                    if desc is None:
+                        logger.info("peer has no kv plane (%s); using "
+                                    "the host wire", frame.get("error"))
+                        break
+                    staged = True
+                    import asyncio as _aio
+                    import jax as _jax
+
+                    dev = list(self.engine.k_cache[0].devices())[0]
+
+                    def pull_and_place():
+                        out = get_plane().pull(desc, dev)
+                        # reshard to the decode engine's cache layout
+                        # (kv heads over "tp" on mesh engines) — the
+                        # same placement the same-process path does
+                        out = _jax.device_put(
+                            out, self.engine.kv_import_sharding())
+                        out.block_until_ready()
+                        return out
+
+                    out = await _aio.to_thread(pull_and_place)
+                    self.last_pull_path = "plane"
+                    return out
+            except ConnectionError:
+                return None
+            except Exception:
+                if staged:
+                    # the producer released its pages at staging — the
+                    # wire has nothing left to pull, and the staged
+                    # copy is leaked on its device (no cancel API)
+                    logger.exception("kv plane pull failed after "
+                                     "staging; serving locally")
+                    return None
+                logger.exception("kv plane staging failed; trying the "
+                                 "host wire")
         # host/DCN path: assemble chunked frames in arrival order
         buf: Optional[np.ndarray] = None
         got = 0
@@ -309,6 +386,9 @@ class DecodeWorkerHandler:
 
         # --- 2. pull the KV pages from the owning prefill worker ---
         kv_data = await self._pull_kv(ktp, context)
+        if kv_data is not None:
+            logger.info("kv pull path: %s (%d tokens)",
+                        self.last_pull_path, int(ktp["prefill_len"]))
         if kv_data is None:
             logger.warning("KV pull failed; serving locally")
             async for out in self.engine.generate(request, context):

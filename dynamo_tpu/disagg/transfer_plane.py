@@ -1,0 +1,112 @@
+"""Cross-process device-to-device KV transfer plane.
+
+The NIXL analog (reference: `lib/llm/src/block_manager/distributed/
+leader.rs:126`, `components/src/dynamo/vllm/handlers.py:166-215` — the
+reference's KV data plane is GPU↔GPU RDMA between separate engine
+processes). TPU-first shape: `jax.experimental.transfer` — each process
+runs one TransferServer bound to its backend; the producer schedules a
+device array for pull (`await_pull(uuid, ...)`), the consumer connects
+to the producer's address and pulls straight into its own devices. On
+one host/pod the bytes ride the local interconnect (ICI/DMA); across
+hosts the server's transport sockets (DCN). No host numpy copy on
+either side.
+
+Protocol (rides the EXISTING kv_pull endpoint — `disagg/handlers.py`):
+the decode worker sends ``{"transfer_id", "stage": true}``; the prefill
+worker gathers the pinned pages device-side, schedules them on its
+plane server, releases the pages (the staged copy is independent), and
+replies with one descriptor frame ``{"plane": {"addr", "uuid", "shape",
+"dtype"}}``. The decode worker pulls and writes the pages into its own
+cache. A consumer that dies between stage and pull leaks that one
+staged copy (the transfer API has no cancel) — bounded by one
+sequence's KV; DYN_KV_PLANE=0 disables the plane on either side, and
+the chunked host wire remains the fallback throughout.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def plane_enabled() -> bool:
+    return os.environ.get("DYN_KV_PLANE", "1") != "0"
+
+
+def _uuid_of(transfer_id: str) -> int:
+    """Stable 60-bit uuid from the engine's hex transfer id."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2s(transfer_id.encode(), digest_size=8).digest(),
+        "big") >> 4
+
+
+class TransferPlane:
+    """Per-process transfer server + connection cache (both roles)."""
+
+    def __init__(self) -> None:
+        self._server = None
+        self._conns: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _server_for(self, client):
+        with self._lock:
+            if self._server is None:
+                from jax.experimental.transfer import start_transfer_server
+
+                host = os.environ.get("DYN_TRANSFER_HOST", "127.0.0.1")
+                # explicit transport address: without one the data plane
+                # has no socket and pulls die with ENOTCONN (probed)
+                self._server = start_transfer_server(
+                    client, f"{host}:0", [f"{host}:0"])
+            return self._server
+
+    def publish(self, transfer_id: str, arr) -> dict:
+        """Schedule an already-gathered device array for remote pull
+        (producer side; callers gather via engine.read_kv_pages_device
+        so the one locked gather path serves every transfer flavor).
+        Returns the descriptor the consumer needs; the caller may
+        release the source pages — `arr` is an independent copy."""
+        client = list(arr.devices())[0].client
+        server = self._server_for(client)
+        uuid = _uuid_of(transfer_id)
+        server.await_pull(uuid, [arr])
+        return {"addr": server.address(), "uuid": uuid,
+                "shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+    def pull(self, descriptor: dict, device) -> Any:
+        """Pull a staged transfer onto `device` (consumer side; blocking
+        — call from a thread). Returns the device-resident array."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        client = device.client
+        server = self._server_for(client)
+        addr = descriptor["addr"]
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._conns[addr] = server.connect(addr)
+        sds = jax.ShapeDtypeStruct(
+            tuple(descriptor["shape"]),
+            jnp.dtype(descriptor["dtype"]),
+            sharding=SingleDeviceSharding(device))
+        out = conn.pull(int(descriptor["uuid"]), [sds])[0]
+        out.block_until_ready()
+        return out
+
+
+_plane: Optional[TransferPlane] = None
+
+
+def get_plane() -> TransferPlane:
+    global _plane
+    if _plane is None:
+        _plane = TransferPlane()
+    return _plane

@@ -268,9 +268,11 @@ async def test_disagg_device_path_e2e():
         await de.close()
 
 
-async def test_disagg_chunked_wire_path():
+async def test_disagg_chunked_wire_path(monkeypatch):
     """Wire path with 1-page chunks: many frames, assembled in order,
-    output still matches aggregated."""
+    output still matches aggregated. (Plane disabled: the wire is the
+    DYN_KV_PLANE=0 / degraded path now.)"""
+    monkeypatch.setenv("DYN_KV_PLANE", "0")
     prompt = list(range(1, 14))
     agg = make_engine()
     ref = await collect_tokens(agg, req(prompt, max_tokens=6))
@@ -285,6 +287,44 @@ async def test_disagg_chunked_wire_path():
         assert toks == ref
         assert handler.last_pull_path == "wire"
         assert pe.pool.active_pages == 0
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_transfer_plane_path():
+    """Device-to-device plane (jax.experimental.transfer): decode pulls
+    the staged KV without a host bounce; output matches aggregated and
+    the prefill worker's pages are released at staging time."""
+    prompt = list(range(1, 14))
+    agg = make_engine()
+    ref = await collect_tokens(agg, req(prompt, max_tokens=6))
+    await agg.close()
+
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    try:
+        outs = [o async for o in handler.generate(req(prompt, max_tokens=6),
+                                                  Context())]
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        assert toks == ref
+        assert handler.last_pull_path == "plane"
+        assert pe.pool.active_pages == 0
+        assert not pe._transfers          # completed at staging
+    finally:
+        await rt.close()
+        await pe.close()
+        await de.close()
+
+
+async def test_disagg_plane_stage_unknown_transfer():
+    """A stage request for an expired transfer errors cleanly (the
+    decode side then falls back to local serving)."""
+    rt, pe, de, handler = await setup_disagg_stack(max_local=0)
+    try:
+        frames = [f async for f in handler.kv_pull_router.direct(
+            {"transfer_id": "deadbeef", "stage": True}, 11, Context())]
+        assert "error" in frames[0]
     finally:
         await rt.close()
         await pe.close()

@@ -76,6 +76,14 @@ def _sp_writeback(k_cache: tuple, v_cache: tuple, k_all, v_all,
     return new_k, new_v
 
 
+def _topk_list(ids_vec, lps_vec, width: int) -> list:
+    """[[token_id, logprob], ...] from parallel packed top-k vectors —
+    the ONE unpacker for every burst flavor's packed rows (prefill,
+    plain/pipelined burst, spec), so a layout change can't silently
+    skew one path's alternatives."""
+    return [[int(ids_vec[j]), float(lps_vec[j])] for j in range(width)]
+
+
 def _next_bucket(n: int, lo: int, hi: int, align: int = 1) -> int:
     """Smallest bucket >= n from {lo·2^k, lo·3·2^(k-1)}: pow2-only
     buckets waste up to 50% padding (ISL 96 → 128 pads a third of the
@@ -224,14 +232,15 @@ class _Seq:
     def spec_blocked(self) -> bool:
         """True when this lane can NOT ride a spec burst. Narrower than
         needs_constrained: guided lanes CAN (the spec kernel masks
-        draft proposals and verification through the DFA row); min_p /
-        penalties / top-k-logprob lanes still can't."""
+        draft proposals and verification through the DFA row), and
+        top-k-logprob lanes CAN (the target verify forward's logits are
+        already computed; the kernel packs top-k rows per emitted
+        position). min_p / penalty lanes still can't."""
         sp = self.req.sampling
         return (sp.min_p > 0.0
                 or sp.repetition_penalty != 1.0
                 or sp.frequency_penalty != 0.0
-                or sp.presence_penalty != 0.0
-                or self.wants_topk)
+                or sp.presence_penalty != 0.0)
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
     finished: bool = False
@@ -977,10 +986,9 @@ class TpuEngine:
             seq.draft_pos = len(seq.prompt)
             topk = None
             if tk and seq.wants_topk:
-                width = min(seq.req.sampling.top_logprobs, tk)
-                topk = [[int(packed[2 + j, i]),
-                         float(packed[2 + tk + j, i])]
-                        for j in range(width)]
+                topk = _topk_list(
+                    packed[2:2 + tk, i], packed[2 + tk:2 + 2 * tk, i],
+                    min(seq.req.sampling.top_logprobs, tk))
             self._emit_token(seq, int(token), float(lp), topk=topk)
         return True
 
@@ -1108,7 +1116,7 @@ class TpuEngine:
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
                     jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
                     mcfg, cfg.draft_model, cfg.spec_gamma,
-                    cfg.spec_iters_per_sync, **gkw)
+                    cfg.spec_iters_per_sync, topk_lp=tk, **gkw)
                 return np.asarray(packed), kc, vc, dk, dv  # ONE host sync
 
             async with self._device_lock:
@@ -1117,6 +1125,10 @@ class TpuEngine:
             toks_out = packed[0].astype(np.int32)   # (S, gamma+1, B)
             lps_out = packed[1]                     # (S, gamma+1, B)
             counts = packed[2, :, 0, :].astype(np.int32)  # (S, B)
+            stk_ids = stk_lps = None
+            if tk:
+                stk_ids = packed[3:3 + tk].astype(np.int32)
+                stk_lps = packed[3 + tk:3 + 2 * tk]
             st = self._spec_stats
             for i, s in enumerate(batch):
                 for it in range(cfg.spec_iters_per_sync):
@@ -1133,8 +1145,15 @@ class TpuEngine:
                             self.pool.register_page(
                                 s.pages[block.block_index], block.seq_hash,
                                 block.local_hash, block.parent_seq_hash)
+                        topk = None
+                        if tk and s.wants_topk:
+                            topk = _topk_list(
+                                stk_ids[:, it, k, i],
+                                stk_lps[:, it, k, i],
+                                min(s.req.sampling.top_logprobs, tk))
                         self._emit_token(s, int(toks_out[it, k, i]),
-                                         float(lps_out[it, k, i]))
+                                         float(lps_out[it, k, i]),
+                                         topk=topk)
                 s.draft_pos = s.pos
             return True
 
@@ -1283,10 +1302,9 @@ class TpuEngine:
                         block.local_hash, block.parent_seq_hash)
                 topk = None
                 if tk and s.wants_topk:
-                    width = min(s.req.sampling.top_logprobs, tk)
-                    topk = [[int(tk_ids[j, k, i]),
-                             float(tk_lps[j, k, i])]
-                            for j in range(width)]
+                    topk = _topk_list(
+                        tk_ids[:, k, i], tk_lps[:, k, i],
+                        min(s.req.sampling.top_logprobs, tk))
                 self._emit_token(s, int(sampled[k, i]),
                                  float(logprobs[k, i]), topk=topk)
 

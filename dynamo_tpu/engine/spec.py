@@ -77,7 +77,7 @@ def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
 
 @partial(jax.jit,
          static_argnames=("cfg", "draft_cfg", "gamma", "num_iters",
-                          "use_guided"),
+                          "use_guided", "topk_lp"),
          donate_argnums=(2, 3, 4, 5))
 def spec_decode_multi_step(
         params: dict, draft_params: dict,
@@ -90,7 +90,8 @@ def spec_decode_multi_step(
         gamma: int, num_iters: int,
         use_guided: bool = False,
         g_bits=None, g_next=None, g_eos_ok=None,
-        g_ids=None, g_states=None, stop_ids=None):
+        g_ids=None, g_states=None, stop_ids=None,
+        topk_lp: int = 0):
     """`num_iters` fused draft→verify→accept iterations, ONE host sync.
 
     tokens/positions/valid/seeds/steps0/temperature: (B,). Pages for
@@ -107,10 +108,14 @@ def spec_decode_multi_step(
     stop tokens become legal where the grammar accepts (g_eos_ok), same
     overlay as decode_multi_step_guided.
 
-    Returns (packed (3, num_iters, gamma+1, B) f32, k_cache, v_cache,
-    dk_cache, dv_cache, new_positions (B,)); packed rows: token ids /
-    target logprobs / emitted-count per (iter, lane) (count broadcast
-    along the gamma+1 axis; slots >= count are padding).
+    Returns (packed (3 + 2*topk_lp, num_iters, gamma+1, B) f32,
+    k_cache, v_cache, dk_cache, dv_cache, new_positions (B,)); packed
+    rows: token ids / target logprobs / emitted-count per (iter, lane)
+    (count broadcast along the gamma+1 axis; slots >= count are
+    padding). topk_lp > 0 appends top-k alternative ids then their
+    logprobs (same log_softmax as the chosen row — the target verify
+    forward's distribution, so spec and plain bursts report identical
+    alternatives under greedy).
     """
     B = tokens.shape[0]
     G1 = gamma + 1
@@ -250,6 +255,19 @@ def spec_decode_multi_step(
         out = out.at[1, it].set(chosen_lp.T)
         out = out.at[2, it].set(
             jnp.broadcast_to(count[None, :].astype(jnp.float32), (G1, B)))
+        if topk_lp:
+            # top-k alternatives of every verified position, from the
+            # same (possibly DFA-masked) target distribution the chosen
+            # logprob uses; the engine slices the emitted prefix. Two
+            # row-block writes, not 2*k scatters (trace size matters in
+            # this already-large fused kernel).
+            tk_vals, tk_ids = jax.lax.top_k(logp_all, topk_lp)
+            out = lax.dynamic_update_slice(
+                out, jnp.transpose(tk_ids, (2, 1, 0))[:, None]
+                .astype(jnp.float32), (3, it, 0, 0))
+            out = lax.dynamic_update_slice(
+                out, jnp.transpose(tk_vals, (2, 1, 0))[:, None],
+                (3 + topk_lp, it, 0, 0))
 
         last = emitted[jnp.arange(B), n_acc]
         new_pos = jnp.where(valid, pos + count, pos)
@@ -265,7 +283,8 @@ def spec_decode_multi_step(
         return (last, new_pos, kc, vc, dk, dv,
                 steps + count.astype(jnp.uint32), new_gst, out)
 
-    out0 = jnp.zeros((3, num_iters, G1, B), dtype=jnp.float32)
+    out0 = jnp.zeros((3 + 2 * topk_lp, num_iters, G1, B),
+                     dtype=jnp.float32)
     gst0 = (g_states.astype(jnp.int32) if use_guided
             else jnp.zeros((B,), jnp.int32))
     (cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, _,

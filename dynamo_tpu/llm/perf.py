@@ -83,3 +83,156 @@ async def record_stream(stream: AsyncIterator[Any],
     async for item in stream:
         perf.observe(item)
         yield item
+
+
+# ---------------------------------------------------------------------------
+# logprob sensitivity analysis
+# ---------------------------------------------------------------------------
+# Reference: `lib/llm/src/perf/logprobs.rs:1` — record per-position
+# chosen-vs-alternative logprobs from a response stream, then analyze
+# how close the model was to emitting something else (greedy detection,
+# close-position counting, sampling-temperature forensics). Same
+# analysis here over this stack's two native shapes: engine
+# EngineOutput dicts (token_ids/log_probs/top_logprobs) and OpenAI chat
+# chunks (choices[].logprobs.content[]), plus the runtime Recorder's
+# JSONL envelope for offline analysis.
+
+
+@dataclass
+class PositionLogprobs:
+    """One sequence position: the chosen token + sorted alternatives."""
+
+    token: Any                       # id (engine) or string (OpenAI)
+    logprob: float
+    top: list[tuple[Any, float]]     # sorted desc, may include chosen
+
+    @property
+    def alternatives(self) -> list[tuple[Any, float]]:
+        return [(t, lp) for t, lp in self.top if t != self.token]
+
+    @property
+    def margin(self) -> float:
+        """chosen logprob minus the best alternative's (negative when
+        the model preferred a token it did not emit)."""
+        alts = self.alternatives
+        return self.logprob - alts[0][1] if alts else float("inf")
+
+
+def _positions_from_engine_item(item: dict) -> list[PositionLogprobs]:
+    toks = item.get("token_ids") or []
+    lps = item.get("log_probs") or []
+    tops = item.get("top_logprobs") or []
+    out = []
+    for i, tok in enumerate(toks):
+        lp = float(lps[i]) if i < len(lps) else float("nan")
+        top = [(t, float(v)) for t, v in (tops[i] if i < len(tops)
+                                          else [])]
+        out.append(PositionLogprobs(token=tok, logprob=lp, top=top))
+    return out
+
+
+def _positions_from_openai_chunk(item: dict) -> list[PositionLogprobs]:
+    out = []
+    for ch in item.get("choices") or []:
+        content = ((ch.get("logprobs") or {}).get("content")) or []
+        for entry in content:
+            top = [(t.get("token"), float(t.get("logprob", 0.0)))
+                   for t in entry.get("top_logprobs") or []]
+            out.append(PositionLogprobs(
+                token=entry.get("token"),
+                logprob=float(entry.get("logprob", 0.0)), top=top))
+    return out
+
+
+@dataclass
+class LogprobAnalysis:
+    """Positional logprob record + the reference analyzer's questions."""
+
+    positions: list[PositionLogprobs] = field(default_factory=list)
+
+    def observe(self, item: Any) -> None:
+        """Accept an engine output dict or an OpenAI chat chunk."""
+        if not isinstance(item, dict):
+            return
+        if "choices" in item:
+            self.positions.extend(_positions_from_openai_chunk(item))
+        else:
+            self.positions.extend(_positions_from_engine_item(item))
+
+    @classmethod
+    def from_items(cls, items) -> "LogprobAnalysis":
+        a = cls()
+        for it in items:
+            a.observe(it)
+        return a
+
+    @classmethod
+    def from_recorder_jsonl(cls, path) -> "LogprobAnalysis":
+        """Analyze a runtime Recorder capture ({'timestamp', 'event'}
+        JSONL lines; events are stream items)."""
+        from dynamo_tpu.runtime.recorder import Recorder
+
+        return cls.from_items(ev for _, ev in Recorder.iter_events(path))
+
+    # -- analysis (logprobs.rs SensitivityAnalysis analog) ------------------
+
+    def greedy_selection_pct(self) -> float:
+        """Fraction of positions whose chosen token IS the top-1
+        (~1.0 ⇒ the stream was greedy-decoded; logprobs.rs
+        detect_likely_greedy_decoding)."""
+        scored = [p for p in self.positions if p.top]
+        if not scored:
+            return float("nan")
+        hits = sum(1 for p in scored
+                   if p.top[0][0] == p.token
+                   or p.logprob >= p.top[0][1] - 1e-6)
+        return hits / len(scored)
+
+    def close_positions(self, threshold: float = 0.1
+                        ) -> list[tuple[int, float]]:
+        """(index, margin) of positions where an alternative was within
+        `threshold` nats of the chosen token — the places a tiny logit
+        perturbation (quantization, different chunking, temperature)
+        flips the output (logprobs.rs get_close_positions)."""
+        return [(i, p.margin) for i, p in enumerate(self.positions)
+                if p.alternatives and p.margin <= threshold]
+
+    def close_position_pct(self, threshold: float = 0.1) -> float:
+        scored = [p for p in self.positions if p.alternatives]
+        if not scored:
+            return float("nan")
+        return len(self.close_positions(threshold)) / len(scored)
+
+    def perplexity(self) -> float:
+        """exp(-mean chosen logprob) over scored positions."""
+        import math
+
+        lps = [p.logprob for p in self.positions
+               if p.logprob == p.logprob]          # drop NaN
+        if not lps:
+            return float("nan")
+        return math.exp(-sum(lps) / len(lps))
+
+    def topk_overlap(self, other: "LogprobAnalysis") -> float:
+        """Mean positional Jaccard overlap of the top-k candidate sets
+        across two runs — the determinism/quantization-drift witness
+        (two greedy runs of the same weights should be ~1.0)."""
+        pairs = [(a, b) for a, b in zip(self.positions, other.positions)
+                 if a.top and b.top]
+        if not pairs:
+            return float("nan")
+        total = 0.0
+        for a, b in pairs:
+            sa = {t for t, _ in a.top}
+            sb = {t for t, _ in b.top}
+            total += len(sa & sb) / len(sa | sb)
+        return total / len(pairs)
+
+    def summary(self) -> dict:
+        return {
+            "positions": len(self.positions),
+            "greedy_selection_pct": self.greedy_selection_pct(),
+            "close_position_pct_0p1": self.close_position_pct(0.1),
+            "close_position_pct_0p5": self.close_position_pct(0.5),
+            "perplexity": self.perplexity(),
+        }

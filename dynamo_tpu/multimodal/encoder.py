@@ -40,14 +40,38 @@ class ImageEncoderConfig:
 
 
 def load_trained_encoder(cfg: ImageEncoderConfig) -> dict | None:
-    """Packaged VQ-VAE weights (multimodal/train_encoder.py — trained
-    in-repo on synthetic structured images; this environment ships no
-    pretrained vision checkpoints). Returns None when the file is
-    missing or its shapes don't match `cfg` (caller falls back to
-    random init)."""
+    """VQ-VAE weights (multimodal/train_encoder.py — trained in-repo on
+    synthetic structured images; this environment ships no pretrained
+    vision checkpoints). The weights file is a BUILD ARTIFACT, not
+    committed: missing ⇒ it is trained on first use (deterministic
+    seed 0, ~1 min CPU, atomic rename so concurrent workers race
+    safely). DYN_TRAIN_ENCODER=0 skips that (callers fall back to
+    random init — deterministic tokens, weaker semantics). Returns
+    None when unavailable or shapes don't match `cfg`."""
     import os
 
     path = os.path.join(os.path.dirname(__file__), "encoder_weights.npz")
+    if not os.path.exists(path) \
+            and os.environ.get("DYN_TRAIN_ENCODER", "1") != "0":
+        try:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "training the VQ image encoder (first use; ~1 min, "
+                "cached at %s)", path)
+            from dynamo_tpu.multimodal.train_encoder import train
+
+            params, l_rec = train()
+            # savez appends ".npz" when the name lacks it — keep the
+            # suffix so the rename source actually exists
+            tmp = f"{path}.{os.getpid()}.tmp.npz"
+            np.savez_compressed(tmp, **params,
+                                meta_recon_loss=np.float32(l_rec))
+            os.replace(tmp, path)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "encoder training failed; using random init")
+            return None
     if not os.path.exists(path):
         return None
     try:
@@ -58,8 +82,22 @@ def load_trained_encoder(cfg: ImageEncoderConfig) -> dict | None:
         # truncated/stale/differently-keyed file: fall back, don't kill
         # the encode worker at startup
         return None
-    if proj.shape != (cfg.patch_dim, cfg.embed_dim) or             codebook.shape != (cfg.codebook_size, cfg.embed_dim):
+    if proj.shape != (cfg.patch_dim, cfg.embed_dim) or \
+            codebook.shape != (cfg.codebook_size, cfg.embed_dim):
         return None
+    # Cross-worker identity witness: image-token ids are only stable
+    # across a deployment when every pod holds the SAME weights. Seed-0
+    # training is deterministic per build, but float reductions are not
+    # bit-stable across XLA versions/backends — multi-pod deployments
+    # should bake the artifact into the image
+    # (`python -m dynamo_tpu.multimodal.train_encoder` at build) and
+    # can compare this logged hash across pods to detect divergence.
+    import hashlib
+    import logging
+
+    logging.getLogger(__name__).info(
+        "VQ encoder codebook hash: %s",
+        hashlib.blake2s(codebook.tobytes(), digest_size=8).hexdigest())
     return {"proj": jnp.asarray(proj), "codebook": jnp.asarray(codebook)}
 
 

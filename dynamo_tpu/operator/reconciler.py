@@ -92,9 +92,84 @@ def _service_ports(spec: ComponentSpec) -> list[dict]:
     return []
 
 
+_JAX_COORD_PORT = 8476   # node 0's jax.distributed coordinator
+
+
+def _multinode_members(spec: ComponentSpec):
+    """(group, rank) pairs: `replicas` independent pod GROUPS of
+    `num_nodes` ranked pods each (the LWS shape)."""
+    return [(g, r) for g in range(spec.replicas)
+            for r in range(spec.num_nodes)]
+
+
+def _multinode_names(child_name: str, app: str, group: int,
+                     rank: int) -> tuple[str, str]:
+    """(Deployment name, app label) for one group member. Group 0 keeps
+    the unsuffixed -nodeN names (the replicas=1 common case reads
+    clean); further groups add -gG."""
+    g = "" if group == 0 else f"-g{group}"
+    return f"{child_name}{g}-node{rank}", f"{app}{g}-node{rank}"
+
+
+def _multinode_leader_svc(child_name: str, group: int) -> str:
+    g = "" if group == 0 else f"-g{group}"
+    return f"{child_name}{g}-leader"
+
+
+def _render_one(dgd: DynamoGraphDeployment, name: str,
+                spec: ComponentSpec, child_name: str, labels: dict,
+                command: list[str], replicas: int) -> dict:
+    env = [{"name": k, "value": v}
+           for k, v in {**dgd.envs, **spec.envs}.items()]
+    container = {
+        "name": name,
+        "image": spec.image,
+        "command": command,
+    }
+    if env:
+        container["env"] = env
+    pod_spec: dict = {"containers": [container]}
+    if spec.component_type == "frontend":
+        port = spec.port or _HTTP_PORT
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/health", "port": port}}
+        container["livenessProbe"] = {
+            "httpGet": {"path": "/live", "port": port}}
+    if spec.tpu_chips:
+        tpu = {"google.com/tpu": str(spec.tpu_chips)}
+        container["resources"] = {"requests": tpu, "limits": tpu}
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator":
+                spec.tpu_accelerator,
+            "cloud.google.com/gke-tpu-topology": spec.tpu_topology,
+        }
+    if spec.extra_pod_spec:
+        pod_spec.update(spec.extra_pod_spec)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": child_name, "namespace": dgd.namespace,
+                     "labels": labels,
+                     "ownerReferences": [_owner_ref(dgd)]},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": labels["app"]}},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
 def render_children(dgd: DynamoGraphDeployment) -> list[tuple[str, dict]]:
     """Desired (kind, manifest) children for a graph CR, deterministic
-    order (coordinator first so dependents resolve its Service DNS)."""
+    order (coordinator first so dependents resolve its Service DNS).
+
+    Multinode workers (spec.num_nodes > 1) render one ranked Deployment
+    per node plus a leader Service for node 0's jax.distributed
+    coordinator — the LWS-style pod group the reference operator builds
+    (dynamocomponentdeployment_controller.go multinode path)."""
     order = {"coordinator": 0, "frontend": 2}
     out: list[tuple[str, dict]] = []
     for name, spec in sorted(
@@ -102,47 +177,44 @@ def render_children(dgd: DynamoGraphDeployment) -> list[tuple[str, dict]]:
             key=lambda kv: order.get(kv[1].component_type, 1)):
         labels = _labels(dgd, name)
         child_name = f"{dgd.name}-{name}"
-        env = [{"name": k, "value": v}
-               for k, v in {**dgd.envs, **spec.envs}.items()]
-        container = {
-            "name": name,
-            "image": spec.image,
-            "command": _command(dgd, name, spec),
-        }
-        if env:
-            container["env"] = env
-        pod_spec: dict = {"containers": [container]}
-        if spec.component_type == "frontend":
-            port = spec.port or _HTTP_PORT
-            container["readinessProbe"] = {
-                "httpGet": {"path": "/health", "port": port}}
-            container["livenessProbe"] = {
-                "httpGet": {"path": "/live", "port": port}}
-        if spec.tpu_chips:
-            tpu = {"google.com/tpu": str(spec.tpu_chips)}
-            container["resources"] = {"requests": tpu, "limits": tpu}
-            pod_spec["nodeSelector"] = {
-                "cloud.google.com/gke-tpu-accelerator":
-                    spec.tpu_accelerator,
-                "cloud.google.com/gke-tpu-topology": spec.tpu_topology,
-            }
-        if spec.extra_pod_spec:
-            pod_spec.update(spec.extra_pod_spec)
-        out.append(("Deployment", {
-            "apiVersion": "apps/v1",
-            "kind": "Deployment",
-            "metadata": {"name": child_name, "namespace": dgd.namespace,
-                         "labels": labels,
-                         "ownerReferences": [_owner_ref(dgd)]},
-            "spec": {
-                "replicas": spec.replicas,
-                "selector": {"matchLabels": {"app": labels["app"]}},
-                "template": {
-                    "metadata": {"labels": labels},
-                    "spec": pod_spec,
-                },
-            },
-        }))
+        if spec.is_multinode:
+            for group, rank in _multinode_members(spec):
+                g_child, g_app = _multinode_names(
+                    child_name, labels["app"], group, rank)
+                rank_labels = {**labels,
+                               "dynamo.tpu/node-rank": str(rank),
+                               "dynamo.tpu/group": str(group),
+                               "app": g_app}
+                leader_svc = _multinode_leader_svc(child_name, group)
+                cmd = _command(dgd, name, spec) + [
+                    "--num-nodes", str(spec.num_nodes),
+                    "--node-rank", str(rank),
+                    "--leader-addr",
+                    f"{leader_svc}:{_JAX_COORD_PORT}",
+                ]
+                out.append(("Deployment", _render_one(
+                    dgd, name, spec, g_child, rank_labels, cmd,
+                    replicas=1)))
+            for group in range(spec.replicas):
+                _, leader_app = _multinode_names(
+                    child_name, labels["app"], group, 0)
+                out.append(("Service", {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {
+                        "name": _multinode_leader_svc(child_name, group),
+                        "namespace": dgd.namespace,
+                        "labels": labels,
+                        "ownerReferences": [_owner_ref(dgd)]},
+                    "spec": {"selector": {"app": leader_app},
+                             "clusterIP": "None",  # headless: pod DNS
+                             "ports": [{"name": "jax-coord",
+                                        "port": _JAX_COORD_PORT}]},
+                }))
+            continue
+        out.append(("Deployment", _render_one(
+            dgd, name, spec, child_name, labels,
+            _command(dgd, name, spec), spec.replicas)))
         ports = _service_ports(spec)
         if ports:
             out.append(("Service", {
@@ -206,14 +278,18 @@ class GraphReconciler:
 
     def _rollup(self, dgd: DynamoGraphDeployment, namespace: str) -> str:
         for name, spec in dgd.services.items():
-            try:
-                dep = self.client.get("Deployment", namespace,
-                                      f"{dgd.name}-{name}")
-            except KubeError:
-                return "pending"
-            ready = dep.get("status", {}).get("readyReplicas", 0) or 0
-            if ready < dep.get("spec", {}).get("replicas", 1):
-                return "pending"
+            child_names = (
+                [_multinode_names(f"{dgd.name}-{name}", "", g, r)[0]
+                 for g, r in _multinode_members(spec)]
+                if spec.is_multinode else [f"{dgd.name}-{name}"])
+            for child in child_names:
+                try:
+                    dep = self.client.get("Deployment", namespace, child)
+                except KubeError:
+                    return "pending"
+                ready = dep.get("status", {}).get("readyReplicas", 0) or 0
+                if ready < dep.get("spec", {}).get("replicas", 1):
+                    return "pending"
         return "ready"
 
 

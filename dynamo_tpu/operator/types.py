@@ -45,6 +45,20 @@ class ComponentSpec:
     port: Optional[int] = None          # service port override
     autoscaling: Optional[Autoscaling] = None
     extra_pod_spec: dict = field(default_factory=dict)  # merged verbatim
+    # multi-host engine sharding: ranked pod groups per worker
+    # (reference operator reconciles these via LWS/Grove —
+    # dynamocomponentdeployment_controller.go; here the reconciler
+    # renders one Deployment per (group, rank) + a leader Service per
+    # group, and the worker CLI's --num-nodes/--node-rank/--leader-addr
+    # assemble each group's global jax.distributed mesh; `replicas`
+    # scales the GROUP count, LWS-style)
+    num_nodes: int = 1
+
+    @property
+    def is_multinode(self) -> bool:
+        """The one multinode predicate (render + rollup must agree)."""
+        return self.num_nodes > 1 and self.component_type in (
+            "worker", "prefill_worker")
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -72,6 +86,8 @@ class ComponentSpec:
             }
         if self.extra_pod_spec:
             d["extraPodSpec"] = dict(self.extra_pod_spec)
+        if self.num_nodes > 1:
+            d["multinode"] = {"numNodes": self.num_nodes}
         return d
 
     @classmethod
@@ -95,6 +111,7 @@ class ComponentSpec:
                 max_replicas=int(auto.get("maxReplicas", 8)),
             ) if auto else None,
             extra_pod_spec=dict(d.get("extraPodSpec", {})),
+            num_nodes=int((d.get("multinode") or {}).get("numNodes", 1)),
         )
 
 

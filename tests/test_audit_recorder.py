@@ -256,3 +256,68 @@ async def test_audit_captures_tool_calls(tmp_path):
     assert recs[0]["tool_calls"][0]["function"]["name"] == "f"
     assert recs[0]["reasoning_text"] == "plan"
     assert recs[0]["finish_reason"] == "tool_calls"
+
+
+def test_logprob_analysis_engine_items(tmp_path):
+    """LogprobAnalysis over engine outputs + a Recorder JSONL capture:
+    greedy detection, close positions, perplexity, top-k overlap
+    (lib/llm/src/perf/logprobs.rs analog)."""
+    from dynamo_tpu.llm.perf import LogprobAnalysis
+
+    items = [
+        {"token_ids": [5, 9], "log_probs": [-0.1, -0.6],
+         "top_logprobs": [[[5, -0.1], [7, -2.5]],
+                          [[9, -0.6], [2, -0.65]]]},
+        {"token_ids": [3], "log_probs": [-1.2],
+         "top_logprobs": [[[4, -0.9], [3, -1.2]]]},  # non-greedy pick
+        {"token_ids": [], "finish_reason": "length"},
+    ]
+    a = LogprobAnalysis.from_items(items)
+    assert len(a.positions) == 3
+    assert abs(a.greedy_selection_pct() - 2 / 3) < 1e-9
+    close = a.close_positions(threshold=0.1)
+    # pos 1: margin 0.05; pos 2: margin -0.3 (non-greedy pick — by
+    # definition a flipped position)
+    assert [i for i, _ in close] == [1, 2]
+    assert a.close_position_pct(10.0) == 1.0
+    assert a.perplexity() > 1.0
+    s = a.summary()
+    assert s["positions"] == 3
+
+    # identical run → overlap 1.0; shifted alternatives → < 1.0
+    b = LogprobAnalysis.from_items(items)
+    assert a.topk_overlap(b) == 1.0
+    items2 = [dict(items[0], top_logprobs=[[[5, -0.1], [8, -2.0]],
+                                           [[9, -0.6], [2, -0.65]]])]
+    c = LogprobAnalysis.from_items(items2)
+    assert a.topk_overlap(c) < 1.0
+
+    # recorder JSONL round trip
+    import asyncio
+
+    from dynamo_tpu.runtime.recorder import Recorder
+
+    p = tmp_path / "cap.jsonl"
+    rec = Recorder(p)
+    for it in items:
+        rec.record(it)
+    asyncio.run(rec.close())
+    d = LogprobAnalysis.from_recorder_jsonl(p)
+    assert len(d.positions) == 3
+    assert d.summary() == s
+
+
+def test_logprob_analysis_openai_chunks():
+    import pytest
+
+    from dynamo_tpu.llm.perf import LogprobAnalysis
+
+    chunk = {"choices": [{"logprobs": {"content": [
+        {"token": "a", "logprob": -0.2,
+         "top_logprobs": [{"token": "a", "logprob": -0.2},
+                          {"token": "b", "logprob": -1.9}]},
+    ]}}]}
+    a = LogprobAnalysis.from_items([chunk])
+    assert a.positions[0].token == "a"
+    assert a.greedy_selection_pct() == 1.0
+    assert a.positions[0].margin == pytest.approx(1.7)

@@ -21,6 +21,16 @@ from dynamo_tpu.multimodal import (
 )
 
 
+# The weights file is a build artifact (not committed): materialize it
+# ONCE up front, not concurrently inside the multi-process e2e (two
+# trainings racing on the 1-core box time the worker out).
+@pytest.fixture(scope="module", autouse=True)
+def _encoder_weights():
+    from dynamo_tpu.multimodal.encoder import load_trained_encoder
+
+    load_trained_encoder(ImageEncoderConfig())
+
+
 def png_data_url(seed=0, size=32) -> str:
     from PIL import Image
 

@@ -209,3 +209,84 @@ def test_print_crds_cli(capsys):
     assert main(["--print-crds"]) == 0
     out = capsys.readouterr().out
     assert "dynamographdeployments.dynamo.tpu" in out
+
+
+def test_multinode_worker_renders_ranked_pods_and_leader_service():
+    """A 2-node worker reconciles into one Deployment per rank with
+    --num-nodes/--node-rank/--leader-addr wired, plus a headless leader
+    Service for node 0's jax coordinator (reference operator's
+    LWS multinode analog)."""
+    dgd = graph(backend=ComponentSpec(
+        component_type="worker", model="meta-llama/Llama-3.1-8B",
+        tpu_chips=4, num_nodes=2,
+        args=["--tensor-parallel-size", "8"]))
+    kube = FakeKube()
+    put_cr(kube, dgd)
+    state = GraphReconciler(kube).reconcile("default", "demo")
+    assert state == "ready"
+
+    d0 = kube.get("Deployment", "default", "demo-backend-node0")
+    d1 = kube.get("Deployment", "default", "demo-backend-node1")
+    for rank, d in ((0, d0), (1, d1)):
+        cmd = d["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert cmd[cmd.index("--num-nodes") + 1] == "2"
+        assert cmd[cmd.index("--node-rank") + 1] == str(rank)
+        assert cmd[cmd.index("--leader-addr") + 1] == \
+            "demo-backend-leader:8476"
+        assert cmd[cmd.index("--tensor-parallel-size") + 1] == "8"
+        assert d["spec"]["replicas"] == 1
+        assert d["spec"]["template"]["spec"]["containers"][0][
+            "resources"]["requests"]["google.com/tpu"] == "4"
+
+    svc = kube.get("Service", "default", "demo-backend-leader")
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["selector"]["app"] == "demo-backend-node0"
+    assert svc["spec"]["ports"][0]["port"] == 8476
+
+    # round-trip: the CR serialization preserves numNodes
+    from dynamo_tpu.operator.types import DynamoGraphDeployment as DGD
+    back = DGD.from_dict(dgd.to_dict())
+    assert back.services["backend"].num_nodes == 2
+
+
+def test_multinode_scale_down_deletes_orphan_rank():
+    """num_nodes 2 -> 1 removes the rank-1 Deployment and the leader
+    Service (level-triggered orphan cleanup covers the pod group)."""
+    kube = FakeKube()
+    dgd = graph(backend=ComponentSpec(component_type="worker",
+                                      num_nodes=2))
+    put_cr(kube, dgd)
+    GraphReconciler(kube).reconcile("default", "demo")
+    assert kube.get("Deployment", "default", "demo-backend-node1")
+
+    dgd.services["backend"].num_nodes = 1
+    dgd.generation += 1
+    put_cr(kube, dgd)
+    GraphReconciler(kube).reconcile("default", "demo")
+    assert kube.get("Deployment", "default", "demo-backend")
+    with pytest.raises(KubeError):
+        kube.get("Deployment", "default", "demo-backend-node1")
+    with pytest.raises(KubeError):
+        kube.get("Service", "default", "demo-backend-leader")
+
+
+def test_multinode_replicas_scale_pod_groups():
+    """replicas on a multinode worker renders that many independent
+    ranked GROUPS, each with its own leader Service (LWS replicas)."""
+    kube = FakeKube()
+    dgd = graph(backend=ComponentSpec(component_type="worker",
+                                      num_nodes=2, replicas=2))
+    put_cr(kube, dgd)
+    state = GraphReconciler(kube).reconcile("default", "demo")
+    assert state == "ready"
+    for name in ("demo-backend-node0", "demo-backend-node1",
+                 "demo-backend-g1-node0", "demo-backend-g1-node1"):
+        assert kube.get("Deployment", "default", name)
+    assert kube.get("Service", "default", "demo-backend-leader")
+    svc1 = kube.get("Service", "default", "demo-backend-g1-leader")
+    assert svc1["spec"]["selector"]["app"] == "demo-backend-g1-node0"
+    # group 1's ranks point at THEIR leader, not group 0's
+    d = kube.get("Deployment", "default", "demo-backend-g1-node1")
+    cmd = d["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[cmd.index("--leader-addr") + 1] == \
+        "demo-backend-g1-leader:8476"

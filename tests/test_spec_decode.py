@@ -348,3 +348,43 @@ async def test_spec_sampled_distribution_matches_target_only():
     tv = 0.5 * np.abs(emp - ref).sum()
     # 256 samples over <=8 support: TV ~ O(sqrt(k/n)) ~ 0.12 expected
     assert tv < 0.25, (tv, np.nonzero(counts)[0], ref.max())
+
+
+async def test_spec_topk_logprobs_match_no_spec():
+    """Top-k logprob lanes RIDE the spec burst now (r3 excluded them):
+    under greedy the packed top-k rows must match the no-spec engine's
+    alternatives token for token, and speculation must actually engage."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+
+    async def run(draft):
+        eng = TpuEngine(TpuEngineConfig(
+            model=CFG, num_pages=96, max_batch_size=2,
+            default_max_tokens=12, decode_steps_per_sync=4,
+            draft_model=CFG if draft else None, spec_gamma=3,
+            spec_iters_per_sync=2),
+            params=params, draft_params=params if draft else None)
+        req = {"token_ids": list(PROMPT), "model": "m",
+               "sampling": {"temperature": 0.0, "top_logprobs": 3},
+               "stop": {"max_tokens": 12}}
+        toks, lps, topks = [], [], []
+        async for o in eng.generate(req, Context()):
+            toks += o.get("token_ids", [])
+            lps += o.get("log_probs", []) or []
+            topks += o.get("top_logprobs", []) or []
+        stats = eng._spec_stats
+        await eng.close()
+        return toks, lps, topks, stats
+
+    base_toks, base_lps, base_topks, _ = await run(draft=False)
+    spec_toks, spec_lps, spec_topks, stats = await run(draft=True)
+    assert spec_toks == base_toks
+    assert stats is not None and stats.num_accepted_tokens > 0, \
+        "top-k lanes must keep speculation, not fall back"
+    assert len(spec_topks) == len(base_topks) == 12
+    for st, bt in zip(spec_topks, base_topks):
+        assert [e[0] for e in st] == [e[0] for e in bt]
+        np.testing.assert_allclose([e[1] for e in st],
+                                   [e[1] for e in bt], atol=2e-2)
+        # top-1 is the chosen token under greedy
+    for t, st in zip(spec_toks, spec_topks):
+        assert st[0][0] == t

@@ -67,8 +67,11 @@ QUANTIZE = "int8"
 
 # short phase (r1/r2 continuity)
 ISL, OSL, N_REQS, BATCH, K_STEPS = 96, 64, 32, 16, 32
-# wide phase (decode-throughput configuration)
-W_BATCH, W_NREQ = 48, 96
+# wide phase (decode-throughput configuration). OSL is 3× the short
+# phase's: at OSL 64 a b48 lane retires every ~2 bursts and admission
+# churn keeps the decode windows underfull — the phase would measure
+# scheduling, not decode (r2 saw the same: "prefill-bound at ISL96").
+W_BATCH, W_NREQ, W_OSL = 48, 96, 192
 # long phase
 L_ISL, L_OSL, L_BATCH, L_NREQ, L_SHARED = 1024, 256, 32, 64, 768
 
@@ -266,23 +269,23 @@ async def phase_wide():
     return await engine_phase(
         lambda: TpuEngine(TpuEngineConfig(
             model=cfg, num_pages=2048, max_batch_size=W_BATCH,
-            prefill_chunk=128, default_max_tokens=OSL,
+            prefill_chunk=128, default_max_tokens=W_OSL,
             decode_steps_per_sync=K_STEPS, quantize=QUANTIZE)),
         lambda eng: _phase_wide_body(cfg, eng))
 
 
 async def _phase_wide_body(cfg, eng):
-    await serve_n(eng, 1, ISL, OSL, base=0)
+    await serve_n(eng, 1, ISL, W_OSL, base=0)
     for wave, base in ((2, 430), (4, 440), (8, 450), (16, 460),
                        (32, 480), (W_BATCH, 520)):
         await serve_n(eng, wave, ISL, 4, base=base)
     p0 = dict(eng.perf)
-    n_tok, dt = await serve_n(eng, W_NREQ, ISL, OSL, base=600)
+    n_tok, dt = await serve_n(eng, W_NREQ, ISL, W_OSL, base=600)
     p1 = dict(eng.perf)
     tok_s = n_tok / dt
     params = eng.params
     loop_tok_s, loop_step_s = device_loop_rate(
-        cfg, params, W_BATCH, K_STEPS, ISL + OSL // 2, 2048)
+        cfg, params, W_BATCH, K_STEPS, ISL + W_OSL // 2, 2048)
     dec_s = p1["decode_s"] - p0["decode_s"]
     dec_tok = (p1["tokens_emitted"] - p0["tokens_emitted"]
                - (p1["prefill_emitted"] - p0["prefill_emitted"]))
@@ -295,8 +298,9 @@ async def _phase_wide_body(cfg, eng):
             round(dec_tok / dec_s / loop_tok_s, 3) if dec_s else None,
         "device_ms_per_step": round(loop_step_s * 1000, 2),
         "hbm_util_pct": round(hbm_util_pct(
-            params, cfg, W_BATCH, ISL + OSL // 2, loop_step_s), 1),
-        "isl": ISL, "osl": OSL, "n_requests": W_NREQ, "batch": W_BATCH,
+            params, cfg, W_BATCH, ISL + W_OSL // 2, loop_step_s), 1),
+        "isl": ISL, "osl": W_OSL, "n_requests": W_NREQ,
+        "batch": W_BATCH,
         "quantize": QUANTIZE,
     }
     del params
@@ -601,53 +605,100 @@ async def phase_int4():
     return out
 
 
-_enable_compile_cache()          # at import: phases are callable directly
+PHASES = {"short": phase_short, "wide": phase_wide, "long": phase_long,
+          "ckpt": phase_ckpt, "kv": phase_kv, "int4": phase_int4}
+
+_MARK = "BENCH_PHASE_JSON: "
+
+# generous wall-clock boxes per phase (tunnel compiles are minutes;
+# the 8B ckpt phase has its own inner DYN_BENCH_CKPT_TIMEOUT too)
+_PHASE_TIMEOUT_S = {"ckpt": 2400.0}
+_DEFAULT_TIMEOUT_S = 1200.0
+
+
+def run_one_phase(name: str) -> None:
+    """Child mode: run ONE phase against the chip, print its JSON."""
+    _enable_compile_cache()
+    try:
+        result = asyncio.run(PHASES[name]())
+    except Exception as e:
+        import traceback
+
+        traceback.print_exc()
+        result = {"error": f"{type(e).__name__}: {e}"}
+    print(_MARK + json.dumps(result), flush=True)
+    # a timed-out phase may leave a to_thread worker blocked on a hung
+    # device op; a normal interpreter exit would join it forever
+    os._exit(0)
+
+
+def _spawn_phase(name: str) -> dict:
+    """Run a phase in a fresh SUBPROCESS. Absolute fault isolation on
+    the one shared chip: whatever a failed phase strands (a partially
+    built engine, a wedged compile thread, HBM pinned by exception
+    frames) dies with its process — in-process gc demonstrably could
+    not guarantee that (r3 and an r4 rerun both cascaded
+    RESOURCE_EXHAUSTED into every later phase). The parent never
+    touches the TPU; the persistent compile cache keeps warm compiles
+    shared across children."""
+    import subprocess
+    import sys
+
+    budget = _PHASE_TIMEOUT_S.get(name, _DEFAULT_TIMEOUT_S)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            capture_output=True, text=True, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"error": f"phase timed out after {budget:.0f}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MARK):
+            try:
+                return json.loads(line[len(_MARK):])
+            except json.JSONDecodeError:
+                break   # truncated marker (child killed mid-write)
+    tail = (proc.stderr or proc.stdout or "")[-300:]
+    return {"error": f"phase process rc={proc.returncode}: {tail}"}
 
 
 def main():
+    import sys
+
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        run_one_phase(sys.argv[2])
+        return
+
     skip = set(filter(None,
                       os.environ.get("DYN_BENCH_SKIP", "").split(",")))
     out = {"metric": "engine_output_tokens_per_sec_per_chip",
            "unit": "tok/s/chip"}
 
-    def run(name, coro_fn, retries=1):
+    def run(name, retries=1):
         if name in skip:
             return {"skipped": True}
         for attempt in range(retries + 1):
-            err = None
-            try:
-                return asyncio.run(coro_fn())
-            except Exception as e:
-                import traceback
-
-                traceback.print_exc()
-                err = f"{type(e).__name__}: {e}"
-            # OUTSIDE the except block: the live traceback pins the
-            # failing frame (including a partially-built engine's
-            # device buffers) until the handler exits — a collect
-            # inside it could not free the HBM the next phase needs
-            gc.collect()
-            if attempt == retries:
-                return {"error": err}
-            print(f"bench: phase {name} failed; retrying", flush=True)
+            result = _spawn_phase(name)
+            if "error" not in result:
+                return result
+            print(f"bench: phase {name} attempt {attempt} failed: "
+                  f"{result['error'][:200]}", flush=True)
+        return result
 
     # the tunneled chip occasionally drops one call mid-run; each phase
-    # retries once rather than record a broken round
-    short = run("short", phase_short)
+    # retries once (in a fresh process) rather than record a broken round
+    short = run("short")
     out.update(short if "error" not in short and "skipped" not in short
                else {"value": 0.0, "vs_baseline": 0.0,
                      "short_error": short.get("error", "skipped")})
-    out["wide"] = run("wide", phase_wide)
-    out["long"] = run("long", phase_long)
-    out["ckpt"] = run("ckpt", phase_ckpt)
-    kv = run("kv", phase_kv)
+    out["wide"] = run("wide")
+    out["long"] = run("long")
+    out["ckpt"] = run("ckpt")
+    kv = run("kv")
     out.update(kv if "error" not in kv and "skipped" not in kv
                else {"kv_error": kv.get("error", "skipped")})
-    out["int4"] = run("int4", phase_int4)
+    out["int4"] = run("int4")
     print(json.dumps(out), flush=True)
-    # a timed-out phase may leave a to_thread worker blocked on a hung
-    # device op; a normal interpreter exit would join it forever
-    os._exit(0)
 
 
 if __name__ == "__main__":

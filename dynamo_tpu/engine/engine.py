@@ -1497,11 +1497,15 @@ class TpuEngine:
         it runs in a thread and is cached by the spec's canonical JSON.
         Tables are EOS-agnostic (stop tokens overlay per lane), so the
         spec alone is a sound cache key."""
+        from dynamo_tpu.runtime.compute import run_cpu
+
         if callable(self._guided_vocab):
             # lazy: the O(vocab) token-bytes map is only built when the
-            # first guided request arrives, not at engine startup
-            self._guided_vocab = await asyncio.to_thread(
-                self._guided_vocab)
+            # first guided request arrives, not at engine startup.
+            # CPU-bound ⇒ the bounded compute pool (runtime/compute.py),
+            # not the unbounded to_thread executor the DEVICE-blocking
+            # dispatches use
+            self._guided_vocab = await run_cpu(self._guided_vocab)
         if self._guided_vocab is None:
             raise ValueError(
                 "engine has no tokenizer vocabulary (token_bytes) — "
@@ -1512,8 +1516,7 @@ class TpuEngine:
             return tables
         from dynamo_tpu.llm.guided import compile_guided
 
-        tables = await asyncio.to_thread(
-            compile_guided, spec, self._guided_vocab)
+        tables = await run_cpu(compile_guided, spec, self._guided_vocab)
         # re-check: a concurrent compile of the same spec may have won
         # the race while we were in the thread — double-assigning the
         # slot would alias a later grammar onto it

@@ -147,6 +147,10 @@ class KvbmDistributed:
                     "data": np.ascontiguousarray(data).tobytes()}
 
         for h in request.get("seq_hashes", []):
+            # stays on to_thread, NOT the bounded compute pool: the G3
+            # disk tier's get() sleeps on file I/O, and parking CPU
+            # permits on idle-on-disk threads would starve genuinely
+            # CPU-bound work (the pool's own design rule)
             frame = await asyncio.to_thread(read_frame, int(h))
             if frame is None:
                 break

@@ -1,0 +1,94 @@
+"""Bounded compute pool (runtime/compute.py — tokio-rayon analog)."""
+
+import asyncio
+import threading
+import time
+
+
+async def test_compute_pool_runs_and_counts():
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    pool = ComputePool(workers=2)
+    try:
+        out = await pool.run(lambda a, b: a + b, 2, 3)
+        assert out == 5
+        s = pool.stats()
+        assert s["workers"] == 2 and s["completed"] == 1
+        assert s["active"] == 0
+    finally:
+        pool.shutdown()
+
+
+async def test_compute_pool_bounds_concurrency():
+    """No more than `workers` jobs run simultaneously, and admission
+    backpressures past 2x workers instead of growing a hidden queue."""
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    pool = ComputePool(workers=2)
+    peak = 0
+    active = 0
+    lock = threading.Lock()
+
+    def job():
+        nonlocal peak, active
+        with lock:
+            active += 1
+            peak = max(peak, active)
+        time.sleep(0.02)
+        with lock:
+            active -= 1
+
+    try:
+        await asyncio.gather(*(pool.run(job) for _ in range(10)))
+        assert peak <= 2, peak
+        assert pool.stats()["completed"] == 10
+    finally:
+        pool.shutdown()
+
+
+async def test_run_cpu_singleton():
+    from dynamo_tpu.runtime.compute import compute_pool, run_cpu
+
+    assert await run_cpu(len, [1, 2, 3]) == 3
+    assert compute_pool() is compute_pool()
+
+
+async def test_compute_pool_propagates_exceptions():
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    pool = ComputePool(workers=1)
+
+    def boom():
+        raise RuntimeError("cpu job failed")
+
+    try:
+        try:
+            await pool.run(boom)
+            raise AssertionError("should have raised")
+        except RuntimeError as e:
+            assert "cpu job failed" in str(e)
+        # pool still usable after a failure
+        assert await pool.run(lambda: 7) == 7
+    finally:
+        pool.shutdown()
+
+
+def test_compute_pool_survives_multiple_event_loops():
+    """The exact singleton failure mode: contention on loop A must not
+    bind the pool to it — a second asyncio.run in the same process
+    gets its own admission semaphore."""
+    from dynamo_tpu.runtime.compute import ComputePool
+
+    pool = ComputePool(workers=1)
+
+    async def contend():
+        await asyncio.gather(*(pool.run(time.sleep, 0.01)
+                               for _ in range(6)))
+        return True
+
+    try:
+        assert asyncio.run(contend())
+        assert asyncio.run(contend())     # fresh loop, same pool
+        assert pool.stats()["completed"] == 12
+    finally:
+        pool.shutdown()

@@ -295,7 +295,7 @@ class TpuEngine:
 
             from dynamo_tpu.models.llama_pp import (
                 pp_cache_specs,
-                pp_param_specs,
+                pp_specs_for,
             )
 
             n_stages = cfg.pp_mesh.shape["pp"]
@@ -322,7 +322,7 @@ class TpuEngine:
             self.params = jax.tree.map(
                 lambda x, s: jax.device_put(
                     x, NamedSharding(cfg.pp_mesh, s)),
-                params, pp_param_specs(),
+                params, pp_specs_for(params),
                 is_leaf=lambda x: not isinstance(x, dict))
             # paged KV stacked (L, KVH, N, P, D), layer axis over pp —
             # each stage holds its slice's pages only
@@ -353,7 +353,8 @@ class TpuEngine:
                 # 8B bf16 model alone would OOM a single v5e chip
                 params = jax.jit(
                     lambda key: init_params(key, mcfg),
-                    out_shardings=param_sharding(cfg.mesh),
+                    out_shardings=param_sharding(
+                        cfg.mesh, mcfg.attention_bias),
                 )(jax.random.PRNGKey(cfg.rng_seed))
                 self.params = params
             else:
@@ -394,7 +395,8 @@ class TpuEngine:
                 if draft_params is None:
                     self.draft_params = jax.jit(
                         lambda key: init_params(key, dm),
-                        out_shardings=param_sharding(cfg.mesh),
+                        out_shardings=param_sharding(
+                            cfg.mesh, dm.attention_bias),
                     )(jax.random.PRNGKey(cfg.rng_seed + 1))
                 else:
                     self.draft_params = shard_params(draft_params, cfg.mesh)

@@ -34,11 +34,31 @@ from dynamo_tpu.protocols import (
 EventSink = Callable[[KvCacheEvent], None]
 
 
+class BlockStateInvalid(RuntimeError):
+    """An illegal block-lifecycle transition (ref `block_manager/block/
+    state.rs` BlockStateInvalid). Raising loudly here is the point:
+    the silent version of each of these (double-release corrupting a
+    refcount, registering a freed page, evicting an in-use block) ships
+    ANOTHER sequence's KV to a reader with no error."""
+
+
+# Block lifecycle (ref state.rs BlockState::{Reset,Partial,Complete,
+# Registered}): RESET pages live in the free list with no _Page entry;
+# an allocated page is PARTIAL (being written); register_page seals it
+# COMPLETE (hashes fixed, immutable) and — when it wins the seq_hash —
+# REGISTERED (published for prefix reuse). Only COMPLETE/REGISTERED
+# pages may go inactive and be evicted; eviction returns them to RESET.
+PARTIAL = "partial"
+COMPLETE = "complete"          # sealed, but another page owns the hash
+REGISTERED = "registered"      # sealed + published in _registered
+
+
 @dataclass
 class _Page:
     page_id: int
     refcount: int = 0
-    seq_hash: Optional[int] = None       # set when registered
+    state: str = PARTIAL
+    seq_hash: Optional[int] = None       # set when sealed
     local_hash: Optional[int] = None
     parent_seq_hash: Optional[int] = None
 
@@ -98,7 +118,10 @@ class PagePool:
         return out
 
     def acquire(self, page_id: int) -> None:
-        page = self._pages[page_id]
+        page = self._pages.get(page_id)
+        if page is None:
+            raise BlockStateInvalid(
+                f"acquire of freed/unknown page {page_id}")
         if page.refcount == 0:
             self._inactive.pop(page_id, None)
         page.refcount += 1
@@ -147,16 +170,30 @@ class PagePool:
 
     def register_page(self, page_id: int, seq_hash: int, local_hash: int,
                       parent_seq_hash: int) -> None:
-        """Mark a page complete+immutable; publish the stored event."""
-        page = self._pages[page_id]
+        """Seal a PARTIAL page (complete+immutable; ref state.rs
+        Partial→Complete→Registered) and publish the stored event."""
+        page = self._pages.get(page_id)
+        if page is None:
+            raise BlockStateInvalid(
+                f"register of freed/unknown page {page_id}")
         if page.seq_hash is not None:
+            # idempotent re-registration of the SAME content (shared
+            # prefix pages re-walked by a second sequence) is legal;
+            # resealing with different hashes is the corruption case
+            if page.seq_hash != seq_hash:
+                raise BlockStateInvalid(
+                    f"page {page_id} already sealed as "
+                    f"{page.seq_hash:#x}, re-register as {seq_hash:#x}")
             return
         page.seq_hash = seq_hash
         page.local_hash = local_hash
         page.parent_seq_hash = parent_seq_hash
         # first writer wins; duplicate content on another page stays
-        # unregistered-for-reuse but still evictable via its own entry
-        self._registered.setdefault(seq_hash, page_id)
+        # COMPLETE (unregistered-for-reuse) but still evictable
+        if self._registered.setdefault(seq_hash, page_id) == page_id:
+            page.state = REGISTERED
+        else:
+            page.state = COMPLETE
         if self.event_sink is not None:
             self.event_sink(KvCacheEvent(
                 kind=KV_STORED, worker_id=self.worker_id,
@@ -169,6 +206,12 @@ class PagePool:
             page = self._pages.get(pid)
             if page is None:
                 continue
+            if page.refcount <= 0:
+                # double-release: silently decrementing would let the
+                # page be freed while a later holder still writes it
+                raise BlockStateInvalid(
+                    f"release of page {pid} with refcount "
+                    f"{page.refcount}")
             page.refcount -= 1
             if page.refcount > 0:
                 continue
@@ -201,7 +244,15 @@ class PagePool:
         victims: list[_Page] = []
         while len(victims) < n and self._inactive:
             pid, _ = self._inactive.popitem(last=False)   # LRU
-            victims.append(self._pages[pid])
+            victim = self._pages[pid]
+            if victim.refcount != 0 or victim.state == PARTIAL:
+                # the inactive LRU must only ever hold sealed, idle
+                # pages — evicting an in-use or still-writable block
+                # would hand its device data to the next allocator
+                raise BlockStateInvalid(
+                    f"evicting page {pid} in state {victim.state} "
+                    f"refcount {victim.refcount}")
+            victims.append(victim)
         registered = [p for p in victims if p.seq_hash is not None]
         if registered and fire_hook and self.evict_hook is not None:
             self.evict_hook([(p.page_id, p.seq_hash) for p in registered])

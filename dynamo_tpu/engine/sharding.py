@@ -36,24 +36,39 @@ def make_mesh(dp: int = 1, tp: int = 1,
     return Mesh(arr, axis_names=("dp", "tp"))
 
 
-def param_specs() -> dict:
-    """PartitionSpecs matching init_params' pytree structure."""
+def param_specs(attention_bias: bool = False) -> dict:
+    """PartitionSpecs matching init_params' pytree structure.
+    `attention_bias` (Qwen2 family) adds bq/bk/bv rows — biases shard
+    like their weight's OUTPUT dim (megatron column-parallel)."""
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(None, None),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    }
+    if attention_bias:
+        layers.update({"bq": P(None, "tp"), "bk": P(None, "tp"),
+                       "bv": P(None, "tp")})
     return {
         "embed": P("tp", None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
-        },
+        "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }
+
+
+def specs_for(params: dict) -> dict:
+    """param_specs pruned/extended to match THIS param tree's layer
+    keys (the bias rows exist only for attention_bias configs; a
+    tree.map over mismatched dicts raises)."""
+    specs = param_specs(attention_bias="bq" in params["layers"])
+    specs["layers"] = {k: specs["layers"][k] for k in params["layers"]}
+    return specs
 
 
 def cache_spec() -> P:
@@ -61,9 +76,10 @@ def cache_spec() -> P:
     return P("tp", None, None, None)
 
 
-def param_sharding(mesh: Mesh) -> dict:
+def param_sharding(mesh: Mesh, attention_bias: bool = False) -> dict:
     """NamedSharding tree matching init_params' structure."""
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(),
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        param_specs(attention_bias),
                         is_leaf=lambda x: isinstance(x, P))
 
 
@@ -74,7 +90,7 @@ def cache_sharding(mesh: Mesh) -> NamedSharding:
 def shard_params(params: dict, mesh: Mesh) -> dict:
     from dynamo_tpu.engine.quant import QTensor, scale_spec
 
-    specs = param_specs()
+    specs = specs_for(params)
 
     def place(x, s):
         if isinstance(x, QTensor):

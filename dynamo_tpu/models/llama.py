@@ -49,6 +49,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Qwen2-family attention: q/k/v projections carry additive biases
+    # (config.json "Qwen2ForCausalLM"; llama/mistral set no bias). The
+    # layer dict gains bq/bk/bv leaves and every forward adds them via
+    # qkv_proj — one switch covers paged, dense, sp, and pp paths.
+    attention_bias: bool = False
     # paged KV cache geometry
     page_size: int = 16
     max_pages_per_seq: int = 512          # context = page_size * this
@@ -99,21 +104,33 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
         return (jax.random.normal(key, shape, dtype=jnp.float32)
                 * scale).astype(cfg.dtype)
 
+    # key-draw order matches the pre-bias layout (embed, wq..w_down,
+    # lm_head, then biases) so seeded inits of bias-free configs are
+    # unchanged across versions
+    embed = dense(next(k), E, cfg.vocab_size, E)
+    layers = {
+        "attn_norm": norm(L, E),
+        "wq": dense(next(k), E, L, E, H * D),
+        "wk": dense(next(k), E, L, E, KVH * D),
+        "wv": dense(next(k), E, L, E, KVH * D),
+        "wo": dense(next(k), H * D, L, H * D, E),
+        "mlp_norm": norm(L, E),
+        "w_gate": dense(next(k), E, L, E, F),
+        "w_up": dense(next(k), E, L, E, F),
+        "w_down": dense(next(k), F, L, F, E),
+    }
+    lm_head = dense(next(k), E, E, cfg.vocab_size)
+    if cfg.attention_bias:
+        # nonzero so tests exercising the bias plumbing can't pass on a
+        # silently-dropped bias
+        layers["bq"] = dense(next(k), E, L, H * D)
+        layers["bk"] = dense(next(k), E, L, KVH * D)
+        layers["bv"] = dense(next(k), E, L, KVH * D)
     return {
-        "embed": dense(next(k), E, cfg.vocab_size, E),
-        "layers": {
-            "attn_norm": norm(L, E),
-            "wq": dense(next(k), E, L, E, H * D),
-            "wk": dense(next(k), E, L, E, KVH * D),
-            "wv": dense(next(k), E, L, E, KVH * D),
-            "wo": dense(next(k), H * D, L, H * D, E),
-            "mlp_norm": norm(L, E),
-            "w_gate": dense(next(k), E, L, E, F),
-            "w_up": dense(next(k), E, L, E, F),
-            "w_down": dense(next(k), F, L, F, E),
-        },
+        "embed": embed,
+        "layers": layers,
         "final_norm": norm(E),
-        "lm_head": dense(next(k), E, E, cfg.vocab_size),
+        "lm_head": lm_head,
     }
 
 
@@ -196,6 +213,22 @@ def _layer_params(params: dict, l: int) -> dict:
     return jax.tree.map(lambda w: w[l], params["layers"])
 
 
+def qkv_proj(hn: jax.Array, lp: dict, cfg: LlamaConfig
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """q/k/v projections with the optional Qwen2-family additive bias —
+    the ONE site every forward flavor (paged prefill/decode, dense,
+    sp ring, pp stages) routes through, so a family's attention quirks
+    can never diverge between serving paths."""
+    q = qm(hn, lp["wq"])
+    k = qm(hn, lp["wk"])
+    v = qm(hn, lp["wv"])
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return q, k, v
+
+
 def prefill_step(params: dict, k_cache: tuple, v_cache: tuple,
                  tokens: jax.Array, page_table: jax.Array,
                  cached_len: jax.Array, seq_len: jax.Array,
@@ -261,9 +294,10 @@ def paged_forward(params: dict, k_cache: tuple, v_cache: tuple,
         lp = _layer_params(params, l)
         kc, vc = k_cache[l], v_cache[l]
         hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = qm(hn, lp["wq"]).reshape(Bp, T, cfg.num_heads, cfg.head_dim)
-        k = qm(hn, lp["wk"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
-        v = qm(hn, lp["wv"]).reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = qkv_proj(hn, lp, cfg)
+        q = q.reshape(Bp, T, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         if page_path:
@@ -335,9 +369,10 @@ def _decode_once(params: dict, k_cache: tuple, v_cache: tuple,
         lp = _layer_params(params, l)
         kc, vc = k_cache[l], v_cache[l]
         hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = qm(hn, lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
-        k = qm(hn, lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        v = qm(hn, lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = qkv_proj(hn, lp, cfg)
+        q = q.reshape(B, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
         q = rope(q[:, None], positions[:, None], cfg.rope_theta)[:, 0]
         k = rope(k[:, None], positions[:, None], cfg.rope_theta)[:, 0]
         kc, vc = _write_kv(kc, vc, k, v, page_ids, offsets, valid)
@@ -530,10 +565,10 @@ def dense_attention(x: jax.Array, lp: dict, positions: jax.Array,
     B, T, _ = x.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-    q = rope(qm(h, lp["wq"]).reshape(B, T, H, D), positions, cfg.rope_theta)
-    k = rope(qm(h, lp["wk"]).reshape(B, T, KVH, D), positions,
-             cfg.rope_theta)
-    v = qm(h, lp["wv"]).reshape(B, T, KVH, D)
+    q, k, v = qkv_proj(h, lp, cfg)
+    q = rope(q.reshape(B, T, H, D), positions, cfg.rope_theta)
+    k = rope(k.reshape(B, T, KVH, D), positions, cfg.rope_theta)
+    v = v.reshape(B, T, KVH, D)
     if KVH != H:
         k = jnp.repeat(k, H // KVH, axis=2)
         v = jnp.repeat(v, H // KVH, axis=2)

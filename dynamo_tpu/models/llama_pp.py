@@ -40,6 +40,7 @@ from dynamo_tpu.models.llama import (
     _swiglu,
     _write_kv,
     dense_attention,
+    qkv_proj,
     rms_norm,
     rope,
 )
@@ -111,11 +112,20 @@ def _pp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     return out[None]  # (1, M, Bm, V) → stacked over pp by out_specs
 
 
-def pp_param_specs() -> dict:
-    """Layer stacks sharded over "pp" (stage slices); the rest replicated."""
-    layer = {k: P("pp", *([None] * n)) for k, n in (
-        ("attn_norm", 1), ("wq", 2), ("wk", 2), ("wv", 2), ("wo", 2),
-        ("mlp_norm", 1), ("w_gate", 2), ("w_up", 2), ("w_down", 2))}
+def pp_specs_for(params: dict) -> dict:
+    """pp_param_specs matching THIS param tree (bias rows only when the
+    family has them) — the one probe site, mirroring sharding.specs_for."""
+    return pp_param_specs("bq" in params["layers"])
+
+
+def pp_param_specs(with_bias: bool = False) -> dict:
+    """Layer stacks sharded over "pp" (stage slices); the rest replicated.
+    `with_bias` (Qwen2 family) adds the bq/bk/bv stacks."""
+    rows = [("attn_norm", 1), ("wq", 2), ("wk", 2), ("wv", 2), ("wo", 2),
+            ("mlp_norm", 1), ("w_gate", 2), ("w_up", 2), ("w_down", 2)]
+    if with_bias:
+        rows += [("bq", 1), ("bk", 1), ("bv", 1)]
+    layer = {k: P("pp", *([None] * n)) for k, n in rows}
     return {"embed": P(None, None), "layers": layer,
             "final_norm": P(None), "lm_head": P(None, None)}
 
@@ -129,7 +139,7 @@ def _pp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
         functools.partial(_pp_forward_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_micro=n_micro),
         mesh=mesh,
-        in_specs=(pp_param_specs(), P(None, None, None)),
+        in_specs=(pp_specs_for(params), P(None, None, None)),
         out_specs=P(axis, None, None, None))
     return fn(params, tokens)
 
@@ -147,7 +157,7 @@ def pp_prefill_logits(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     mb = tokens.reshape(n_micro, B // n_micro, T)
     sharded_params = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, pp_param_specs(),
+        params, pp_specs_for(params),
         is_leaf=lambda x: not isinstance(x, dict))
     out = _pp_prefill_jit(sharded_params, mb, cfg, mesh, axis, n_micro)
     return out[-1].reshape(B, cfg.vocab_size)
@@ -208,12 +218,10 @@ def _pp_prefill_paged_local(params, kc_all, vc_all, tokens_c,
             lp = _layer_params(params, l)
             kc, vc = kc_all[l], vc_all[l]
             hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-            q = qm(hn, lp["wq"]).reshape(B, Tc, cfg.num_heads,
-                                         cfg.head_dim)
-            k = qm(hn, lp["wk"]).reshape(B, Tc, cfg.num_kv_heads,
-                                         cfg.head_dim)
-            v = qm(hn, lp["wv"]).reshape(B, Tc, cfg.num_kv_heads,
-                                         cfg.head_dim)
+            q, k, v = qkv_proj(hn, lp, cfg)
+            q = q.reshape(B, Tc, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(B, Tc, cfg.num_kv_heads, cfg.head_dim)
+            v = v.reshape(B, Tc, cfg.num_kv_heads, cfg.head_dim)
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
             kc, vc = _write_kv(kc, vc, flat(k), flat(v), flat(page_ids),
@@ -263,7 +271,7 @@ def _pp_prefill_paged_jit(params, k_cache, v_cache, tokens_c,
         functools.partial(_pp_prefill_paged_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_chunks=n_chunks),
         mesh=mesh,
-        in_specs=(pp_param_specs(), pp_cache_specs(), pp_cache_specs(),
+        in_specs=(pp_specs_for(params), pp_cache_specs(), pp_cache_specs(),
                   P(None, None, None), P(None, None), P(None), P(None)),
         out_specs=(P(axis, None, None), pp_cache_specs(),
                    pp_cache_specs()))
@@ -350,11 +358,10 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
             lp = _layer_params(params, l)
             kc, vc = kc_all[l], vc_all[l]
             hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-            q = qm(hn, lp["wq"]).reshape(Bm, cfg.num_heads, cfg.head_dim)
-            k = qm(hn, lp["wk"]).reshape(Bm, cfg.num_kv_heads,
-                                         cfg.head_dim)
-            v = qm(hn, lp["wv"]).reshape(Bm, cfg.num_kv_heads,
-                                         cfg.head_dim)
+            q, k, v = qkv_proj(hn, lp, cfg)
+            q = q.reshape(Bm, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(Bm, cfg.num_kv_heads, cfg.head_dim)
+            v = v.reshape(Bm, cfg.num_kv_heads, cfg.head_dim)
             q = rope(q[:, None], pos_m[:, None], cfg.rope_theta)[:, 0]
             k = rope(k[:, None], pos_m[:, None], cfg.rope_theta)[:, 0]
             kc, vc = _write_kv(kc, vc, k, v, page_ids, offsets, valid_m)
@@ -419,7 +426,7 @@ def _pp_decode_jit(params, k_cache, v_cache, tokens, positions,
                           n_stages=n_stages, n_micro=n_micro,
                           num_steps=num_steps),
         mesh=mesh,
-        in_specs=(pp_param_specs(), pp_cache_specs(), pp_cache_specs(),
+        in_specs=(pp_specs_for(params), pp_cache_specs(), pp_cache_specs(),
                   P(None, None), P(None, None), P(None, None, None),
                   P(None, None), P(None, None), P(None, None),
                   P(None, None), P(None, None), P(None, None)),
@@ -464,7 +471,7 @@ def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
 
     sharded_params = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, pp_param_specs(),
+        params, pp_specs_for(params),
         is_leaf=lambda x: not isinstance(x, dict))
     cache_ns = NamedSharding(mesh, pp_cache_specs())
     k_cache = jax.device_put(k_cache, cache_ns)

@@ -35,6 +35,7 @@ from dynamo_tpu.models.llama import (
     LlamaConfig,
     _layer_params,
     _swiglu,
+    qkv_proj,
     rms_norm,
     rope,
 )
@@ -82,11 +83,10 @@ def _sp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     for l in range(cfg.num_layers):
         lp = _layer_params(params, l)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = rope(qm(h, lp["wq"]).reshape(B, Tc, -1, D), positions,
-                 cfg.rope_theta)
-        k = rope(qm(h, lp["wk"]).reshape(B, Tc, -1, D), positions,
-                 cfg.rope_theta)
-        v = qm(h, lp["wv"]).reshape(B, Tc, -1, D)
+        q, k, v = qkv_proj(h, lp, cfg)
+        q = rope(q.reshape(B, Tc, -1, D), positions, cfg.rope_theta)
+        k = rope(k.reshape(B, Tc, -1, D), positions, cfg.rope_theta)
+        v = v.reshape(B, Tc, -1, D)
         ks.append(k)
         vs.append(v)
         attn = ring_attention_local(q, k, v, axis, causal=True,
@@ -107,7 +107,7 @@ def _param_in_specs(params, tp_axis):
     if tp_axis is None:
         return jax.tree.map(lambda _: P(), params)
     from dynamo_tpu.engine.quant import QTensor, scale_spec
-    from dynamo_tpu.engine.sharding import param_specs
+    from dynamo_tpu.engine.sharding import specs_for
 
     def spec_of(x, s):
         if isinstance(x, QTensor):
@@ -116,7 +116,7 @@ def _param_in_specs(params, tp_axis):
             return QTensor(q=s, s=scale_spec(s, x.s.ndim), bits=x.bits)
         return s
 
-    return jax.tree.map(spec_of, params, param_specs(),
+    return jax.tree.map(spec_of, params, specs_for(params),
                         is_leaf=lambda x: not isinstance(x, dict))
 
 

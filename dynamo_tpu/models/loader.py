@@ -69,7 +69,8 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-    if "llama" not in arch.lower() and "mistral" not in arch.lower():
+    known = ("llama", "mistral", "qwen2")
+    if not any(f in arch.lower() for f in known):
         logger.warning("loading %s with the llama-family loader", arch)
     hidden = hf["hidden_size"]
     heads = hf["num_attention_heads"]
@@ -83,6 +84,11 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
         head_dim=hf.get("head_dim") or hidden // heads,
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        # Qwen2 attention carries q/k/v biases architecturally (its
+        # config.json has no attention_bias key); llama3-style configs
+        # state it explicitly
+        attention_bias=bool(hf.get("attention_bias",
+                                   "qwen2" in arch.lower())),
     )
     cfg.update(overrides)
     return LlamaConfig(**cfg)
@@ -171,6 +177,13 @@ def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
         },
         "final_norm": idx.get("model.norm.weight").astype(np.float32),
     }
+    if cfg.attention_bias:
+        # Qwen2 family: q/k/v carry additive biases (1-D, no transpose)
+        for key, name in (("bq", "q_proj"), ("bk", "k_proj"),
+                          ("bv", "v_proj")):
+            params["layers"][key] = np.stack(
+                [idx.get(p.format(i) + f"self_attn.{name}.bias")
+                 .astype(w_dtype) for i in range(L)])
     if "lm_head.weight" in idx:
         params["lm_head"] = dense("lm_head.weight")
     else:  # tie_word_embeddings
@@ -264,6 +277,14 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
         layers[key] = jnp.stack(
             [jnp.asarray(idx.get(fmt.format(i)), dtype=jnp.float32)
              for i in range(L)])
+    if cfg.attention_bias:
+        # Qwen2 family: 1-D q/k/v biases (tiny — host stack is fine)
+        for key, name in (("bq", "q_proj"), ("bk", "k_proj"),
+                          ("bv", "v_proj")):
+            layers[key] = jnp.stack(
+                [jnp.asarray(idx.get(p.format(i) + f"self_attn.{name}"
+                                     f".bias"), dtype=cfg.dtype)
+                 for i in range(L)])
     params: dict[str, Any] = {
         "embed": dense("model.embed_tokens.weight", transpose=False),
         "layers": layers,

@@ -29,6 +29,10 @@ PRESETS = {
     "llama3-3b": (3072, 8192, 28, 24, 8, 128256),
     "llama2-1b": (2048, 8192, 16, 16, 8, 32000),
     "tiny": (64, 128, 2, 4, 2, 300),
+    # Qwen2 family: same geometry class but q/k/v projections carry
+    # biases (arch "Qwen2ForCausalLM" → loader sets attention_bias)
+    "qwen2-tiny": (64, 128, 2, 4, 2, 300),
+    "qwen2-1b": (2048, 8192, 16, 16, 8, 32000),
 }
 
 _POOL_ELEMS = 1 << 24        # 16M bf16 = 32 MB shared noise pool
@@ -76,10 +80,12 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
         return path
     hidden, inter, layers, heads, kv_heads, vocab = PRESETS[preset]
     head_dim = hidden // heads
+    qwen = preset.startswith("qwen2")
     os.makedirs(path, exist_ok=True)
     cfg = {
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
+        "architectures": ["Qwen2ForCausalLM" if qwen
+                          else "LlamaForCausalLM"],
+        "model_type": "qwen2" if qwen else "llama",
         "hidden_size": hidden, "intermediate_size": inter,
         "num_hidden_layers": layers, "num_attention_heads": heads,
         "num_key_value_heads": kv_heads, "head_dim": head_dim,
@@ -106,6 +112,12 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
                 (kv_heads * head_dim, hidden)
             yield p + "self_attn.v_proj.weight", \
                 (kv_heads * head_dim, hidden)
+            if qwen:
+                yield p + "self_attn.q_proj.bias", (heads * head_dim,)
+                yield p + "self_attn.k_proj.bias", \
+                    (kv_heads * head_dim,)
+                yield p + "self_attn.v_proj.bias", \
+                    (kv_heads * head_dim,)
             yield p + "self_attn.o_proj.weight", \
                 (hidden, heads * head_dim)
             yield p + "post_attention_layernorm.weight", (hidden,)
@@ -130,8 +142,9 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
         shard_id += 1
 
     for name, shape in tensors():
-        # norms must be ~1.0 (RMSNorm gains), not noise
-        if shape == (hidden,) or shape == (inter,):
+        # norms must be ~1.0 (RMSNorm gains), not noise — match by NAME:
+        # qwen bias vectors can share the (hidden,) shape
+        if name.endswith("norm.weight"):
             t = np.ones(shape, dtype=pool.dtype)
         else:
             off = int(rng.integers(0, _POOL_ELEMS))

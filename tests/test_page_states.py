@@ -1,0 +1,77 @@
+"""Block lifecycle state machine (engine/pages.py — state.rs analog).
+
+The silent version of each rejected transition ships another
+sequence's KV with no error; the pool now raises BlockStateInvalid.
+"""
+
+import pytest
+
+from dynamo_tpu.engine.pages import (
+    COMPLETE,
+    PARTIAL,
+    REGISTERED,
+    BlockStateInvalid,
+    PagePool,
+)
+
+
+def test_partial_to_registered_lifecycle():
+    pool = PagePool(num_pages=8, page_size=4)
+    pid = pool.allocate_page()
+    assert pool._pages[pid].state == PARTIAL
+    pool.register_page(pid, seq_hash=0xA1, local_hash=1, parent_seq_hash=0)
+    assert pool._pages[pid].state == REGISTERED
+    # idempotent same-content re-register is legal (shared prefixes)
+    pool.register_page(pid, seq_hash=0xA1, local_hash=1, parent_seq_hash=0)
+    # resealing with different content is the corruption case
+    with pytest.raises(BlockStateInvalid, match="already sealed"):
+        pool.register_page(pid, seq_hash=0xB2, local_hash=2,
+                           parent_seq_hash=0)
+
+
+def test_duplicate_content_stays_complete_not_registered():
+    pool = PagePool(num_pages=8, page_size=4)
+    p1 = pool.allocate_page()
+    p2 = pool.allocate_page()
+    pool.register_page(p1, 0xC3, 3, 0)
+    pool.register_page(p2, 0xC3, 3, 0)      # same hash, lost the race
+    assert pool._pages[p1].state == REGISTERED
+    assert pool._pages[p2].state == COMPLETE
+    assert pool.match_prefix([0xC3]) == [p1]
+
+
+def test_double_release_raises():
+    pool = PagePool(num_pages=8, page_size=4)
+    pid = pool.allocate_page()
+    pool.register_page(pid, 0xD4, 4, 0)
+    pool.release_sequence([pid])
+    with pytest.raises(BlockStateInvalid, match="refcount"):
+        pool.release_sequence([pid])
+
+
+def test_acquire_freed_page_raises():
+    pool = PagePool(num_pages=8, page_size=4)
+    pid = pool.allocate_page()
+    pool.release_sequence([pid])            # unregistered -> freed
+    with pytest.raises(BlockStateInvalid, match="freed"):
+        pool.acquire(pid)
+
+
+def test_register_freed_page_raises():
+    pool = PagePool(num_pages=8, page_size=4)
+    pid = pool.allocate_page()
+    pool.release_sequence([pid])
+    with pytest.raises(BlockStateInvalid, match="freed"):
+        pool.register_page(pid, 0xE5, 5, 0)
+
+
+def test_eviction_returns_pages_and_respects_states():
+    pool = PagePool(num_pages=4, page_size=4)   # 3 usable
+    pids = [pool.allocate_page() for _ in range(3)]
+    for i, pid in enumerate(pids):
+        pool.register_page(pid, 0xF0 + i, i, 0)
+    pool.release_sequence(pids)                 # all inactive LRU
+    # allocating evicts LRU (sealed, idle) pages back to RESET
+    fresh = [pool.allocate_page() for _ in range(3)]
+    assert all(f is not None for f in fresh)
+    assert len(pool._registered) == 0

@@ -1,0 +1,149 @@
+"""Qwen2 model family: llama architecture + q/k/v attention biases.
+
+Reference parity: the reference serves Qwen-family checkpoints through
+its engines (e.g. examples' Qwen recipes); here the family rides the
+shared llama stack via LlamaConfig.attention_bias and the one qkv_proj
+site, so every serving path (paged, dense, sp, pp) gets it at once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.attention import set_attention_impl
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    init_cache,
+    init_params,
+    prefill_batch,
+)
+from dynamo_tpu.runtime.context import Context
+
+set_attention_impl("xla")
+
+CFG_B = LlamaConfig.tiny(attention_bias=True)
+
+
+def test_init_params_has_bias_leaves_only_when_enabled():
+    p = init_params(jax.random.PRNGKey(0), CFG_B)
+    assert {"bq", "bk", "bv"} <= set(p["layers"])
+    assert p["layers"]["bq"].shape == (2, 64)      # (L, H*D)
+    p0 = init_params(jax.random.PRNGKey(0), LlamaConfig.tiny())
+    assert "bq" not in p0["layers"]
+
+
+def test_bias_changes_logits_and_zero_bias_matches_plain():
+    """A zeroed bias must reproduce the plain model exactly; a nonzero
+    bias must not be silently dropped by any forward."""
+    rng = np.random.default_rng(0)
+    toks = rng.integers(1, 250, (2, 8)).astype(np.int32)
+    tables = np.zeros((2, CFG_B.max_pages_per_seq), np.int32)
+    for i in range(2):
+        tables[i, :2] = 1 + 2 * i + np.arange(2)
+    cached = jnp.zeros(2, jnp.int32)
+    lens = jnp.full(2, 8, jnp.int32)
+
+    pb = init_params(jax.random.PRNGKey(1), CFG_B)
+    plain = {**pb, "layers": {k: v for k, v in pb["layers"].items()
+                              if k not in ("bq", "bk", "bv")}}
+    zeroed = {**pb, "layers": {
+        **pb["layers"],
+        **{k: jnp.zeros_like(pb["layers"][k])
+           for k in ("bq", "bk", "bv")}}}
+
+    def logits(params, cfg):
+        kc, vc = init_cache(cfg, 8)
+        out, _, _ = prefill_batch(params, kc, vc, jnp.asarray(toks),
+                                  jnp.asarray(tables), cached, lens, cfg)
+        return np.asarray(out, np.float32)
+
+    l_zero = logits(zeroed, CFG_B)
+    l_plain = logits(plain, LlamaConfig.tiny())
+    np.testing.assert_array_equal(l_zero, l_plain)
+    l_bias = logits(pb, CFG_B)
+    assert np.abs(l_bias - l_plain).max() > 1e-3
+
+
+def test_qwen2_synth_ckpt_loads_and_serves(tmp_path):
+    """End to end through the REAL loader: Qwen2 config.json detection,
+    bias tensors in safetensors, engine serves greedy tokens, and the
+    host and device loader paths agree."""
+    import asyncio
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.models.loader import (
+        config_from_hf,
+        load_llama_params,
+        load_llama_params_device,
+    )
+    from dynamo_tpu.models.synth_ckpt import write_synthetic_hf_checkpoint
+
+    path = write_synthetic_hf_checkpoint(str(tmp_path / "q2"),
+                                         "qwen2-tiny")
+    cfg = config_from_hf(path, page_size=4, max_pages_per_seq=16)
+    assert cfg.attention_bias
+    params = load_llama_params(path, cfg)
+    assert "bq" in params["layers"]
+    dev_params = load_llama_params_device(path, cfg)
+    np.testing.assert_allclose(
+        np.asarray(dev_params["layers"]["bq"], np.float32),
+        params["layers"]["bq"].astype(np.float32), atol=1e-6)
+
+    async def serve(p):
+        eng = TpuEngine(TpuEngineConfig(model=cfg, num_pages=32,
+                                        max_batch_size=2,
+                                        decode_steps_per_sync=4),
+                        params=p)
+        req = {"token_ids": [5, 6, 7, 8], "model": "q",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 8}}
+        toks = [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", ())]
+        await eng.close()
+        return toks
+
+    t_host = asyncio.run(serve(params))
+    t_dev = asyncio.run(serve(dev_params))
+    assert len(t_host) == 8 and t_host == t_dev
+
+
+def test_qwen2_sharded_and_pp_paths(cpu_mesh_devices):
+    """Bias params shard under tp (specs_for) and flow through the pp
+    paged prefill — outputs match the unsharded forward."""
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.engine.sharding import make_mesh, shard_cache, shard_params
+    from dynamo_tpu.models.llama_pp import pp_prefill_paged
+
+    cfg = LlamaConfig.tiny(attention_bias=True, num_layers=2,
+                           dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    toks = np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0)
+    tables = np.zeros((2, cfg.max_pages_per_seq), np.int32)
+    for i in range(2):
+        tables[i, :2] = 1 + 2 * i + np.arange(2)
+    cached = jnp.zeros(2, jnp.int32)
+    lens = jnp.full(2, 8, jnp.int32)
+
+    kc, vc = init_cache(cfg, 8)
+    ref, _, _ = prefill_batch(params, kc, vc, jnp.asarray(toks),
+                              jnp.asarray(tables), cached, lens, cfg)
+
+    mesh = make_mesh(dp=1, tp=2, devices=cpu_mesh_devices[:2])
+    sp = shard_params(params, mesh)
+    skc, svc = shard_cache(init_cache(cfg, 8), mesh)
+    got, _, _ = prefill_batch(sp, skc, svc, jnp.asarray(toks),
+                              jnp.asarray(tables), cached, lens, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+    pp_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]), axis_names=("pp",))
+    shape = (cfg.num_layers, cfg.num_kv_heads, 8, cfg.page_size,
+             cfg.head_dim)
+    logits, _, _ = pp_prefill_paged(
+        params, jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+        jnp.asarray(toks), jnp.asarray(tables), cached, lens, cfg,
+        pp_mesh, chunk=4)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               atol=1e-3, rtol=1e-3)

@@ -60,6 +60,7 @@ import gc
 import json
 import os
 import time
+from typing import Optional
 
 R1_DEVICE_LOOP_CEILING_TOK_S = 606.0  # round-1 ceiling: decode_multi_step K=16,B=16
 V5E_HBM_GBPS = 819.0
@@ -662,6 +663,44 @@ def _spawn_phase(name: str) -> dict:
     return {"error": f"phase process rc={proc.returncode}: {tail}"}
 
 
+def _device_preflight(attempts: int = 2) -> Optional[str]:
+    """A cheap child that must init the backend and run a trivial op.
+    If the axon relay is wedged (`import jax` can hang at interpreter
+    start — observed after a client was SIGKILLed mid-device-op), every
+    phase child would hang to its full timeout; better to record the
+    outage once and fast. Retried once (same policy as the phases: one
+    transient tunnel drop must not record a broken round), and a hung
+    child gets SIGTERM + a grace period before SIGKILL — killing a
+    process mid-device-op is exactly what wedges the relay."""
+    import subprocess
+    import sys
+
+    last = "device preflight never ran"
+    for _ in range(attempts):
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax, numpy; "
+             "numpy.asarray(jax.numpy.ones(4) + 1); print('DEV_OK')"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            out_s, err_s = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                out_s, err_s = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out_s = err_s = ""
+            last = ("device preflight timed out (axon relay wedged? "
+                    "see docs/ROUND4_NOTES.md)")
+            continue
+        if "DEV_OK" in (out_s or ""):
+            return None
+        last = ("device preflight failed: "
+                f"{(err_s or out_s or '')[-200:]}")
+    return last
+
+
 def main():
     import sys
 
@@ -673,6 +712,12 @@ def main():
                       os.environ.get("DYN_BENCH_SKIP", "").split(",")))
     out = {"metric": "engine_output_tokens_per_sec_per_chip",
            "unit": "tok/s/chip"}
+    if set(PHASES) - skip:          # all-skipped runs never touch the chip
+        pf = _device_preflight()
+        if pf is not None:
+            out.update({"value": 0.0, "vs_baseline": 0.0, "error": pf})
+            print(json.dumps(out), flush=True)
+            return
 
     def run(name, retries=1):
         if name in skip:

@@ -405,7 +405,7 @@ class TpuEngine:
                     out_shardings=cache_sharding(cfg.mesh),
                 )()
         if cfg.quantize:
-            if cfg.quantize not in ("int8", "int4"):
+            if cfg.quantize not in ("int8", "w8a8", "int4"):
                 raise ValueError(f"unknown quantize mode {cfg.quantize!r}")
             from dynamo_tpu.engine.quant import QTensor, quantize_params_jit
 
@@ -422,15 +422,37 @@ class TpuEngine:
             # created (or sharded-copied) them — donating caller-provided
             # device arrays would destroy the caller's objects (e.g. a
             # second engine built from the same params)
+            def remark_act_bits(p: dict) -> dict:
+                # pre-quantized checkpoints skip the jit pass, so the
+                # w8a8 marker must be applied HERE or the mode silently
+                # serves W8A16 (aux-only rewrap: no device ops). lm_head
+                # stays A16 by the same rule quantize_params applies.
+                import dataclasses as _dc
+
+                from dynamo_tpu.engine.quant import QUANT_KEYS
+
+                out = dict(p)
+                out["layers"] = {
+                    k: (_dc.replace(v, act_bits=8)
+                        if k in QUANT_KEYS and isinstance(v, QTensor)
+                        and v.bits == 8 else v)
+                    for k, v in p["layers"].items()
+                }
+                return out
+
             if not pre_quantized(self.params):
                 self.params = quantize_params_jit(self.params,
                                                   donate=owned_params,
                                                   mode=cfg.quantize)
-            if self.draft_params is not None \
-                    and not pre_quantized(self.draft_params):
-                self.draft_params = quantize_params_jit(
-                    self.draft_params, donate=owned_draft,
-                    mode=cfg.quantize)
+            elif cfg.quantize == "w8a8":
+                self.params = remark_act_bits(self.params)
+            if self.draft_params is not None:
+                if not pre_quantized(self.draft_params):
+                    self.draft_params = quantize_params_jit(
+                        self.draft_params, donate=owned_draft,
+                        mode=cfg.quantize)
+                elif cfg.quantize == "w8a8":
+                    self.draft_params = remark_act_bits(self.draft_params)
         self._sp_params = None
         self._sp_tp = None     # "tp" when sp_mesh is 2-D ("sp", "tp")
         if cfg.sp_mesh is not None and cfg.sp_threshold > 0:

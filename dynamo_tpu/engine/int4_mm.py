@@ -73,6 +73,70 @@ def _kernel(x_ref, p_ref, yp_ref, yl_ref):
     yl_ref[:] += jnp.dot(x, lou, preferred_element_type=jnp.int32)
 
 
+def _a8_prologue(x):
+    """Shared W-A8 activation prologue: pad M to the int8 sublane tile,
+    per-row dynamic int8 quantization. Returns (xq, sx, m0, m) — both
+    A8 kernels (int4 and w8a8) must quantize identically or their
+    quality/perf comparisons stop meaning anything."""
+    m0 = x.shape[0]
+    m = max(32, ((m0 + 31) // 32) * 32)
+    if m != m0:
+        x = jnp.pad(x, ((0, m - m0), (0, 0)))
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                     keepdims=True)
+    sx = jnp.maximum(absmax, 1e-12) / 127.0                   # (m, 1)
+    xq = jnp.round(x.astype(jnp.float32) / sx).astype(jnp.int8)
+    return xq, sx, m0, m
+
+
+def _w8a8_kernel(x_ref, w_ref, y_ref):
+    """Grid (m_tiles, n_tiles, k_tiles); y accumulates int32 across k.
+    One native int8×int8→int32 MXU dot — 2× the bf16 pass rate on v5e,
+    and decode at serving batch sizes is MXU-pass-bound (ROUND4_NOTES),
+    so this (not weight bytes) is where quantized decode gains live."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        y_ref[:] = jnp.zeros_like(y_ref)
+
+    y_ref[:] += jnp.dot(x_ref[:], w_ref[:],
+                        preferred_element_type=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype",))
+def w8a8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
+                out_dtype=None) -> jax.Array:
+    """y ≈ x @ (q * s) with the matmul on the int8 MXU path.
+
+    x: (M, K) float; q: (K, N) int8 weights; s: (1, N) f32 per-channel
+    scales. Activations are per-row dynamically quantized to int8 (the
+    one approximation vs the exact W8A16 path); everything after is
+    exact integer arithmetic until the final scale."""
+    kdim = x.shape[1]
+    n = q.shape[1]
+    out_dtype = out_dtype or x.dtype
+    xq, sx, m0, m = _a8_prologue(x)
+    bm = _pick_block(m, 256, 32)
+    bk = _pick_block(kdim, int(os.environ.get("DYN_INT4_BK", "2048")),
+                     128)
+    bn = _pick_block(n, 512, 128)
+    grid = (m // bm, n // bn, kdim // bk)
+    y = pl.pallas_call(
+        _w8a8_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(xq, q)
+    return (y.astype(jnp.float32) * sx * s)[:m0].astype(out_dtype)
+
+
 def _pick_block(dim: int, want: int, tile: int) -> int:
     """Largest divisor of `dim` that is <= want and a multiple of the
     Mosaic tile (dim itself if small). Callers guarantee dim % tile == 0
@@ -95,19 +159,13 @@ def int4_matmul(x: jax.Array, p: jax.Array, s: jax.Array,
     M is padded to a sublane multiple internally; prefill-sized M is
     tiled by the first grid axis.
     """
-    m0, kdim = x.shape
+    kdim = x.shape[1]
     n2 = p.shape[1]
     out_dtype = out_dtype or x.dtype
-    m = max(32, ((m0 + 31) // 32) * 32)      # int8 sublane tile is 32
-    if m != m0:
-        x = jnp.pad(x, ((0, m - m0), (0, 0)))
-    # W4A8: per-row dynamic activation quantization (XLA prologue).
+    # W4A8: per-row dynamic activation quantization (shared prologue).
     # Everything after it is EXACT integer algebra, so the only error
     # vs W4A16 is this one rounding (|x| <= 127 levels per row).
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
-                     keepdims=True)
-    sx = jnp.maximum(absmax, 1e-12) / 127.0                   # (m, 1)
-    xq = jnp.round(x.astype(jnp.float32) / sx).astype(jnp.int8)
+    xq, sx, m0, m = _a8_prologue(x)
     rsq = xq.astype(jnp.int32).sum(axis=-1, keepdims=True)    # (m, 1)
     bm = _pick_block(m, 256, 32)         # int8 sublane tile
     bk = _pick_block(kdim, int(os.environ.get("DYN_INT4_BK", "2048")),

@@ -60,12 +60,20 @@ class QTensor:
     q: jax.Array
     s: jax.Array
     bits: int = 8
+    # activation precision for the matmul: 16 = exact W8A16/W4A16
+    # (convert weights up, dot in the activation dtype); 8 = W8A8 —
+    # per-row dynamic int8 activations on the MXU's NATIVE int8 path
+    # (2× the bf16 pass rate on v5e; decode is pass-bound). int4 always
+    # runs A8 in its pallas kernel regardless of this field.
+    act_bits: int = 16
 
     def tree_flatten(self):
-        return (self.q, self.s), self.bits
+        return (self.q, self.s), (self.bits, self.act_bits)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        if isinstance(aux, tuple):
+            return cls(*children, bits=aux[0], act_bits=aux[1])
         return cls(*children, bits=aux if aux else 8)
 
     @property
@@ -120,13 +128,16 @@ def _unpack4(p: jax.Array) -> jax.Array:
         *p.shape[:-1], p.shape[-1] * 2)
 
 
-def quantize(w: jax.Array, bits: int = 8) -> QTensor:
+def quantize(w: jax.Array, bits: int = 8, act_bits: int = 16) -> QTensor:
     """Per-output-channel symmetric int quantization over the
     contraction dim (-2). bits=8 → int8; bits=4 → nibble-packed int8
     (two values per byte, halving weight HBM traffic again over int8 at
-    a larger rounding error: the decode lever the r2 ablation named
-    after int8)."""
+    a larger rounding error). act_bits=8 marks the weight for the W8A8
+    native-int8-MXU matmul path (qm dispatch); int4 always runs its own
+    A8 kernel, so act_bits must stay 16 there (asserted — silently
+    dropping the flag would be worse)."""
     assert bits in (8, 4), bits
+    assert bits == 8 or act_bits == 16, (bits, act_bits)
     wf = jnp.asarray(w).astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     qmax = (1 << (bits - 1)) - 1
@@ -134,7 +145,7 @@ def quantize(w: jax.Array, bits: int = 8) -> QTensor:
     q = jnp.clip(jnp.round(wf / s), -qmax, qmax).astype(jnp.int8)
     if bits == 4:
         return QTensor(q=pack4(q), s=s, bits=4)
-    return QTensor(q=q, s=s)
+    return QTensor(q=q, s=s, act_bits=act_bits)
 
 
 def qm(x: jax.Array, w: Any) -> jax.Array:
@@ -148,9 +159,28 @@ def qm(x: jax.Array, w: Any) -> jax.Array:
     if isinstance(w, QTensor):
         if w.bits == 4:
             return _qm4(x, w)
+        if w.act_bits == 8:
+            return _qm8a8(x, w)
         y = jnp.dot(x, w.q.astype(x.dtype))
         return y * w.s.astype(x.dtype)
     return x @ w
+
+
+def _qm8a8(x: jax.Array, w: QTensor) -> jax.Array:
+    """W8A8: native int8 MXU dot on TPU (engine/int4_mm.w8a8_matmul);
+    plain W8A16 math elsewhere (CPU tests) — activation quantization is
+    a TPU-kernel-path approximation, like the int4 path's."""
+    from dynamo_tpu.engine.attention import use_pallas
+
+    if use_pallas() and w.q.ndim == 2 and x.shape[-1] % 128 == 0 \
+            and w.q.shape[-1] % 128 == 0:
+        from dynamo_tpu.engine.int4_mm import w8a8_matmul
+
+        lead = x.shape[:-1]
+        y = w8a8_matmul(x.reshape(-1, x.shape[-1]), w.q, w.s)
+        return y.reshape(*lead, y.shape[-1])
+    y = jnp.dot(x, w.q.astype(x.dtype))
+    return y * w.s.astype(x.dtype)
 
 
 def _qm4(x: jax.Array, w: QTensor) -> jax.Array:
@@ -185,6 +215,10 @@ def _bits_of(mode) -> int:
     return 4 if mode in (4, "int4") else 8
 
 
+def _act_bits_of(mode) -> int:
+    return 8 if mode == "w8a8" else 16
+
+
 def quantize_params(params: dict, quantize_lm_head: bool = True,
                     mode: str = "int8") -> dict:
     """Quantize the llama-layout param pytree (models/llama.py init_params).
@@ -196,9 +230,10 @@ def quantize_params(params: dict, quantize_lm_head: bool = True,
     through an engine configured with quantize="int8" unchanged.
     """
     bits = _bits_of(mode)
+    act_bits = _act_bits_of(mode)
     out = dict(params)
     out["layers"] = {
-        k: (quantize(v, bits)
+        k: (quantize(v, bits, act_bits)
             if k in QUANT_KEYS and not isinstance(v, QTensor) else v)
         for k, v in params["layers"].items()
     }

@@ -100,7 +100,7 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
                 q=jax.device_put(x.q, NamedSharding(mesh, s)),
                 s=jax.device_put(
                     x.s, NamedSharding(mesh, scale_spec(s, x.s.ndim))),
-                bits=x.bits)
+                bits=x.bits, act_bits=x.act_bits)
         return jax.device_put(x, NamedSharding(mesh, s))
 
     return jax.tree.map(

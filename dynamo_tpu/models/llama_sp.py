@@ -113,7 +113,8 @@ def _param_in_specs(params, tp_axis):
         if isinstance(x, QTensor):
             # bits must match the param QTensor's aux or the spec tree's
             # treedef diverges from the arg tree's under shard_map
-            return QTensor(q=s, s=scale_spec(s, x.s.ndim), bits=x.bits)
+            return QTensor(q=s, s=scale_spec(s, x.s.ndim), bits=x.bits,
+                           act_bits=x.act_bits)
         return s
 
     return jax.tree.map(spec_of, params, specs_for(params),

@@ -213,11 +213,13 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
 
     from dynamo_tpu.engine.quant import (
         QUANT_KEYS,
+        _act_bits_of,
         _bits_of,
         quantize as quant_fn,
     )
 
-    bits = _bits_of(quantize)      # quantize: falsy | "int8" | "int4"
+    bits = _bits_of(quantize)      # falsy | "int8" | "w8a8" | "int4"
+    act_bits = _act_bits_of(quantize)
 
     idx = _TensorIndex(path)
     L = cfg.num_layers
@@ -248,7 +250,8 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     }
     from dynamo_tpu.engine.quant import QTensor
 
-    q_layer = jax.jit(functools.partial(quant_fn, bits=bits),
+    q_layer = jax.jit(functools.partial(quant_fn, bits=bits,
+                                        act_bits=act_bits),
                       donate_argnums=(0,))
     import logging
 
@@ -267,7 +270,7 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
                 qs.append(qt.q)
                 ss.append(qt.s)
             layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss),
-                                  bits=bits)
+                                  bits=bits, act_bits=act_bits)
             del qs, ss
         else:
             layers[key] = jnp.stack(

@@ -71,7 +71,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--context-length", type=int, default=None,
                    help="override model context (max_pages_per_seq)")
-    p.add_argument("--quantize", default=None, choices=["int8", "int4"],
+    p.add_argument("--quantize", default=None,
+                   choices=["int8", "w8a8", "int4"],
                    help="weight-only quantization for the TPU engine")
     p.add_argument("--draft-model", default=None,
                    help="small checkpoint for speculative decoding")

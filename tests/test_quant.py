@@ -266,3 +266,42 @@ async def test_engine_int4_serves_and_tracks_int8():
     # must agree; sequence-level quality lives in the bench extra on
     # the big model.
     assert t4[0] == t8[0], (t8, t4)
+
+
+def test_w8a8_mode_marks_act_bits_and_serves():
+    """quantize_params(mode="w8a8") marks weights for the native-int8
+    MXU matmul path; off-TPU qm falls back to the exact W8A16 math, so
+    a w8a8 engine on CPU matches the int8 engine token for token (the
+    activation quantization is a TPU-kernel-path approximation)."""
+    import asyncio
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.engine.quant import quantize_params
+    from dynamo_tpu.runtime.context import Context
+
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    qp = quantize_params(params, mode="w8a8")
+    assert qp["layers"]["w_gate"].act_bits == 8
+    assert qp["layers"]["w_gate"].bits == 8
+    assert qp["lm_head"].act_bits == 16        # logit quality
+    # aux survives tree round-trips (jit/donation/sharding flatten it)
+    leaves, treedef = jax.tree.flatten(qp)
+    back = jax.tree.unflatten(treedef, leaves)
+    assert back["layers"]["w_gate"].act_bits == 8
+
+    async def run(mode):
+        eng = TpuEngine(TpuEngineConfig(model=CFG, num_pages=32,
+                                        max_batch_size=2,
+                                        decode_steps_per_sync=4,
+                                        quantize=mode), params=params)
+        req = {"token_ids": [5, 6, 7], "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 10}}
+        toks = [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", ())]
+        await eng.close()
+        return toks
+
+    t8 = asyncio.run(run("int8"))
+    t88 = asyncio.run(run("w8a8"))
+    assert t88 == t8 and len(t88) == 10

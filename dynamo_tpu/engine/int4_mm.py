@@ -104,9 +104,17 @@ def _w8a8_kernel(x_ref, w_ref, y_ref):
                         preferred_element_type=jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype",))
 def w8a8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
                 out_dtype=None) -> jax.Array:
+    """Resolve the interpret flag at CALL time so it participates in
+    the jit cache key (a trace-time env read would pin whichever mode
+    traced first per shape)."""
+    return _w8a8_matmul_jit(x, q, s, out_dtype, _interpret())
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def _w8a8_matmul_jit(x: jax.Array, q: jax.Array, s: jax.Array,
+                     out_dtype, interpret: bool) -> jax.Array:
     """y ≈ x @ (q * s) with the matmul on the int8 MXU path.
 
     x: (M, K) float; q: (K, N) int8 weights; s: (1, N) f32 per-channel
@@ -124,6 +132,7 @@ def w8a8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
     grid = (m // bm, n // bn, kdim // bk)
     y = pl.pallas_call(
         _w8a8_kernel,
+        interpret=interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
@@ -135,6 +144,12 @@ def w8a8_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(xq, q)
     return (y.astype(jnp.float32) * sx * s)[:m0].astype(out_dtype)
+
+
+def _interpret() -> bool:
+    """DYN_PALLAS_INTERPRET=1 runs the kernels in pallas interpret mode
+    (any backend) — hermetic correctness tests without a chip."""
+    return os.environ.get("DYN_PALLAS_INTERPRET") == "1"
 
 
 def _pick_block(dim: int, want: int, tile: int) -> int:
@@ -150,9 +165,15 @@ def _pick_block(dim: int, want: int, tile: int) -> int:
     return dim
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype",))
 def int4_matmul(x: jax.Array, p: jax.Array, s: jax.Array,
                 out_dtype=None) -> jax.Array:
+    """See w8a8_matmul: interpret resolves at call time (cache key)."""
+    return _int4_matmul_jit(x, p, s, out_dtype, _interpret())
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def _int4_matmul_jit(x: jax.Array, p: jax.Array, s: jax.Array,
+                     out_dtype, interpret: bool) -> jax.Array:
     """y = x @ unpack4(p) * s with int4 weight HBM traffic.
 
     x: (M, K) float; p: (K, N//2) nibble-packed int8; s: (1, N) f32.
@@ -175,6 +196,7 @@ def int4_matmul(x: jax.Array, p: jax.Array, s: jax.Array,
     grid = (m // bm, n2 // bn2, kdim // bk)
     y_p, y_lou = pl.pallas_call(
         _kernel,
+        interpret=interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),

@@ -305,3 +305,32 @@ def test_w8a8_mode_marks_act_bits_and_serves():
     t8 = asyncio.run(run("int8"))
     t88 = asyncio.run(run("w8a8"))
     assert t88 == t8 and len(t88) == 10
+
+
+def test_pallas_a8_kernels_interpret_mode(monkeypatch):
+    """Hermetic correctness of the int4 (W4A8) and w8a8 pallas kernels
+    via interpret mode: outputs must match the plain-XLA quantized
+    reference to A8-rounding tolerance."""
+    monkeypatch.setenv("DYN_PALLAS_INTERPRET", "1")
+    from dynamo_tpu.engine.int4_mm import int4_matmul, w8a8_matmul
+    from dynamo_tpu.engine.quant import _unpack4
+
+    key = jax.random.PRNGKey(0)
+    K, N = 256, 256
+    w = jax.random.normal(key, (K, N), jnp.float32) / 20
+    x = (jax.random.normal(jax.random.PRNGKey(1), (8, K),
+                           jnp.float32) / 8).astype(jnp.float32)
+
+    qt8 = quantize(w, bits=8)
+    y88 = np.asarray(w8a8_matmul(x, qt8.q, qt8.s), np.float32)
+    ref8 = np.asarray(x @ (qt8.q.astype(jnp.float32) * qt8.s),
+                      np.float32)
+    rel8 = np.abs(y88 - ref8).max() / np.abs(ref8).max()
+    assert rel8 < 0.02, rel8          # A8 rounding only
+
+    qt4 = quantize(w, bits=4)
+    y4 = np.asarray(int4_matmul(x, qt4.q, qt4.s), np.float32)
+    wq4 = np.asarray(jax.jit(_unpack4)(qt4.q), np.float32)
+    ref4 = np.asarray(x, np.float32) @ (wq4 * np.asarray(qt4.s))
+    rel4 = np.abs(y4 - ref4).max() / np.abs(ref4).max()
+    assert rel4 < 0.02, rel4

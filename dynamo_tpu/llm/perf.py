@@ -12,6 +12,20 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
 
+def count_tokens(item: Any) -> int:
+    """Tokens carried by one stream item — engine dicts (token_ids) or
+    OpenAI chunks (content deltas count 1). The ONE counting rule for
+    live capture (StreamPerf.observe) and the offline CLI."""
+    if not isinstance(item, dict):
+        return 0
+    n = len(item.get("token_ids", ()) or ())
+    if not n:
+        for ch in item.get("choices", ()):
+            if ch.get("delta", {}).get("content") or ch.get("text"):
+                return 1
+    return n
+
+
 @dataclass
 class RecordedItem:
     at: float                       # perf_counter arrival
@@ -26,16 +40,8 @@ class StreamPerf:
     keep_items: bool = False
 
     def observe(self, item: Any) -> None:
-        n = 0
-        if isinstance(item, dict):
-            n = len(item.get("token_ids", ()) or ())
-            if not n:
-                for ch in item.get("choices", ()):
-                    if ch.get("delta", {}).get("content") or ch.get("text"):
-                        n = 1
-                        break
         self.items.append(RecordedItem(
-            at=time.perf_counter(), n_tokens=n,
+            at=time.perf_counter(), n_tokens=count_tokens(item),
             data=item if self.keep_items else None))
 
     # -- analysis ------------------------------------------------------------
@@ -236,3 +242,57 @@ class LogprobAnalysis:
             "close_position_pct_0p5": self.close_position_pct(0.5),
             "perplexity": self.perplexity(),
         }
+
+
+def main(argv=None) -> None:
+    """``python -m dynamo_tpu.llm.perf capture.jsonl`` — analyze a
+    runtime Recorder capture: latency stats when timestamps are present
+    and the logprob sensitivity summary when logprobs are (the CLI face
+    of StreamPerf + LogprobAnalysis; ref `lib/llm/src/perf/`)."""
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.llm.perf",
+        description="latency + logprob analysis over recorder JSONL")
+    p.add_argument("path", help="Recorder capture "
+                                "({'timestamp','event'} JSONL)")
+    p.add_argument("--close-threshold", type=float, default=0.1,
+                   help="margin (nats) below which a position counts "
+                        "as close/flippable")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.runtime.recorder import Recorder
+
+    perf = StreamPerf()
+    lp = LogprobAnalysis()
+    for ts, ev in Recorder.iter_events(args.path):
+        if not perf.items:
+            perf.started_at = ts
+        perf.items.append(RecordedItem(at=ts,
+                                       n_tokens=count_tokens(ev)))
+        lp.observe(ev)
+    latency = perf.summary()
+    # the capture starts at its first event — a request's true TTFT is
+    # unknowable offline, so don't report a misleading 0.0
+    latency.pop("ttft_s", None)
+    out = {"latency": latency, "logprobs": lp.summary(),
+           "note": "ttft_s omitted: offline captures start at the "
+                   "first event"}
+    close = lp.close_positions(args.close_threshold)
+    out["logprobs"]["close_positions"] = close[:20]
+
+    def no_nan(o):
+        if isinstance(o, dict):
+            return {k: no_nan(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [no_nan(v) for v in o]
+        if isinstance(o, float) and o != o:
+            return None                 # NaN is not valid JSON
+        return o
+
+    print(_json.dumps(no_nan(out), indent=1))
+
+
+if __name__ == "__main__":
+    main()

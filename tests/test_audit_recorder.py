@@ -321,3 +321,24 @@ def test_logprob_analysis_openai_chunks():
     assert a.positions[0].token == "a"
     assert a.greedy_selection_pct() == 1.0
     assert a.positions[0].margin == pytest.approx(1.7)
+
+
+def test_perf_cli_over_recorder_capture(tmp_path, capsys):
+    import asyncio
+    import json
+
+    from dynamo_tpu.llm.perf import main
+    from dynamo_tpu.runtime.recorder import Recorder
+
+    p = tmp_path / "cap.jsonl"
+    rec = Recorder(p)
+    rec.record({"token_ids": [5], "log_probs": [-0.2],
+                "top_logprobs": [[[5, -0.2], [6, -0.25]]]})
+    rec.record({"token_ids": [7, 8], "log_probs": [-0.5, -0.1]})
+    asyncio.run(rec.close())
+    main([str(p)])
+    out = json.loads(capsys.readouterr().out)
+    assert out["latency"]["total_tokens"] == 3
+    assert out["logprobs"]["positions"] == 3
+    (idx, margin), = out["logprobs"]["close_positions"]
+    assert idx == 0 and abs(margin - 0.05) < 1e-9

@@ -1528,8 +1528,14 @@ class TpuEngine:
             # first guided request arrives, not at engine startup.
             # CPU-bound ⇒ the bounded compute pool (runtime/compute.py),
             # not the unbounded to_thread executor the DEVICE-blocking
-            # dispatches use
-            self._guided_vocab = await run_cpu(self._guided_vocab)
+            # dispatches use. Serialized: N concurrent first guided
+            # requests must not build the O(vocab) map N times.
+            if not hasattr(self, "_guided_vocab_lock"):
+                self._guided_vocab_lock = asyncio.Lock()
+            async with self._guided_vocab_lock:
+                if callable(self._guided_vocab):
+                    self._guided_vocab = await run_cpu(
+                        self._guided_vocab)
         if self._guided_vocab is None:
             raise ValueError(
                 "engine has no tokenizer vocabulary (token_bytes) — "

@@ -162,12 +162,12 @@ class TpuEngineConfig:
     # spec_gamma tokens per iteration, the target verifies them in ONE
     # forward. Must share the target's page geometry (page_size,
     # max_pages_per_seq) — draft caches are indexed by the same page
-    # tables. Spec bursts serve ALL sampling configs (greedy and
-    # temperature/top-p/top-k lanes, via per-lane Leviathan rejection
-    # sampling over each lane's actual filtered distribution); only
-    # batches with a lane needing the constrained burst (guided grammar,
-    # min_p, or penalties — _Seq.needs_constrained) fall back to the
-    # normal fused decode path.
+    # tables. Spec bursts serve ALL sampling configs: greedy and
+    # temperature/top-p/top-k/min_p lanes via per-lane Leviathan
+    # rejection sampling over each lane's actual filtered distribution,
+    # guided-grammar lanes through the DFA mask, and penalty lanes
+    # through a tentative-counts chain — a draft engine never falls
+    # back to the unfused path for sampling reasons.
     draft_model: Optional[LlamaConfig] = None
     spec_gamma: int = 4
     spec_iters_per_sync: int = 8
@@ -212,6 +212,7 @@ class _Seq:
     guided_state: int = 0                 # authoritative DFA state (host)
     out_counter: dict = field(default_factory=dict)  # token -> emit count
     next_token: int = -1                  # sampled, KV not yet written
+    _hist: Optional[tuple] = None         # (len(prompt), (V,) histogram)
 
     @property
     def wants_topk(self) -> bool:
@@ -221,26 +222,34 @@ class _Seq:
     @property
     def needs_constrained(self) -> bool:
         """True when this lane needs the constrained decode burst
-        (grammar mask, min_p, or any sampling penalty)."""
+        (grammar mask, min_p, or any sampling penalty). Spec bursts
+        serve ALL of these (engine/spec.py threads the same masks/
+        penalties/filters through draft and verify), so this gates only
+        the NON-spec burst choice."""
+        return (self.guided is not None
+                or self.req.sampling.min_p > 0.0 or self.has_penalties)
+
+    @property
+    def has_penalties(self) -> bool:
         sp = self.req.sampling
-        return (self.guided is not None or sp.min_p > 0.0
-                or sp.repetition_penalty != 1.0
+        return (sp.repetition_penalty != 1.0
                 or sp.frequency_penalty != 0.0
                 or sp.presence_penalty != 0.0)
 
-    @property
-    def spec_blocked(self) -> bool:
-        """True when this lane can NOT ride a spec burst. Narrower than
-        needs_constrained: guided lanes CAN (the spec kernel masks
-        draft proposals and verification through the DFA row), and
-        top-k-logprob lanes CAN (the target verify forward's logits are
-        already computed; the kernel packs top-k rows per emitted
-        position). min_p / penalty lanes still can't."""
-        sp = self.req.sampling
-        return (sp.min_p > 0.0
-                or sp.repetition_penalty != 1.0
-                or sp.frequency_penalty != 0.0
-                or sp.presence_penalty != 0.0)
+    def prompt_hist(self, vocab: int) -> "np.ndarray":
+        """Cached (V,) prompt-token histogram for the penalty paths —
+        the prompt only changes on preemption (tokens fold in, length
+        strictly grows), so length is a sound cache key. Recomputing
+        np.unique over a long prompt on EVERY decode burst is host work
+        on the critical path."""
+        if self._hist is None or self._hist[0] != len(self.prompt):
+            ids, cnts = np.unique(
+                np.asarray(self.prompt, dtype=np.int64) % vocab,
+                return_counts=True)
+            arr = np.zeros(vocab, dtype=np.int32)
+            arr[ids] = cnts
+            self._hist = (len(self.prompt), arr)
+        return self._hist[1]
     generated: int = 0                    # sampled tokens streamed
     prefilled: bool = False
     finished: bool = False
@@ -930,29 +939,11 @@ class TpuEngine:
                         ok = self._guided_allowed_row(s.guided, s, V)
                         guided_mask[i, ~ok] = -1e30
             penalty_args = None
-            if any(s.req.sampling.repetition_penalty != 1.0
-                   or s.req.sampling.frequency_penalty != 0.0
-                   or s.req.sampling.presence_penalty != 0.0
-                   for s in pending):
+            if any(s.has_penalties for s in pending):
                 # the FIRST sampled token must see the same penalties as
                 # every decode-burst token (vLLM semantics: repetition
                 # covers prompt tokens)
-                V = mcfg.vocab_size
-                pc = np.zeros((width, V), dtype=np.int32)
-                oc = np.zeros((width, V), dtype=np.int32)
-                for i, s in enumerate(pending):
-                    sp_ = s.req.sampling
-                    if (sp_.repetition_penalty != 1.0
-                            or sp_.frequency_penalty != 0.0
-                            or sp_.presence_penalty != 0.0):
-                        ids, cnts = np.unique(
-                            np.asarray(s.prompt, dtype=np.int64) % V,
-                            return_counts=True)
-                        pc[i, ids] = cnts
-                        for t, c in s.out_counter.items():
-                            if 0 <= t < V:
-                                oc[i, t] = c
-                penalty_args = (pc, oc)
+                penalty_args = self._penalty_arrays(pending, width)
 
             def arr(fn, dtype):
                 vals = [fn(s) for s in pending]
@@ -963,16 +954,12 @@ class TpuEngine:
             if penalty_args is not None:
                 from dynamo_tpu.engine.sampling import apply_penalties
 
-                pc, oc = penalty_args
+                rep_a, freq_a, pres_a, pc, oc = penalty_args
                 logits_stack = apply_penalties(
                     logits_stack, jax.numpy.asarray(pc),
                     jax.numpy.asarray(oc),
-                    arr(lambda s: s.req.sampling.repetition_penalty,
-                        np.float32),
-                    arr(lambda s: s.req.sampling.frequency_penalty,
-                        np.float32),
-                    arr(lambda s: s.req.sampling.presence_penalty,
-                        np.float32))
+                    jax.numpy.asarray(rep_a), jax.numpy.asarray(freq_a),
+                    jax.numpy.asarray(pres_a))
             if guided_mask is not None:
                 logits_stack = logits_stack + jax.numpy.asarray(
                     guided_mask)
@@ -1028,14 +1015,12 @@ class TpuEngine:
         # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
         # compilation for the engine's lifetime. Underfull lanes/steps waste
         # a little compute; recompiles (tens of seconds) waste far more.
-        # Spec bursts serve greedy/temperature/top-p/top-k lanes (the
-        # rejection test runs on each lane's FILTERED distribution);
-        # min_p/penalty/guided lanes still need the constrained burst.
-        # Checked over ALL runnable lanes (not just the first
-        # batch-width): preemption inside the page-allocation loop below
-        # can promote a later lane into the batch
-        use_spec = self.draft_params is not None and all(
-            not s.spec_blocked for s in runnable)
+        # Spec bursts serve EVERY sampling config (the rejection test
+        # runs on each lane's FILTERED, penalty-adjusted, DFA-masked
+        # distribution — engine/spec.py), so a draft engine always
+        # speculates; only non-spec engines route constrained lanes to
+        # the constrained burst.
+        use_spec = self.draft_params is not None
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
         # every runnable seq needs pages covering pos .. pos+k_steps-1
@@ -1128,6 +1113,21 @@ class TpuEngine:
                            g_ids=jax.numpy.asarray(g_ids),
                            g_states=jax.numpy.asarray(g_states),
                            stop_ids=jax.numpy.asarray(stop_ids_a))
+            if any(s.req.sampling.min_p > 0.0 for s in batch):
+                min_ps = np.zeros(b, dtype=np.float32)
+                for i, s in enumerate(batch):
+                    min_ps[i] = s.req.sampling.min_p
+                gkw["min_p"] = jax.numpy.asarray(min_ps)
+            if any(s.has_penalties for s in batch):
+                rep_p, freq_p, pres_p, p_cnt, o_cnt = \
+                    self._penalty_arrays(batch, b)
+                gkw.update(
+                    use_penalties=True,
+                    rep_pen=jax.numpy.asarray(rep_p),
+                    freq_pen=jax.numpy.asarray(freq_p),
+                    pres_pen=jax.numpy.asarray(pres_p),
+                    prompt_counts=jax.numpy.asarray(p_cnt),
+                    out_counts=jax.numpy.asarray(o_cnt))
 
             def run_spec_burst():
                 packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
@@ -1185,34 +1185,16 @@ class TpuEngine:
         if use_constrained:
             from dynamo_tpu.models.llama import decode_multi_step_guided
 
-            V = mcfg.vocab_size
             # slots are stable here: every batch grammar was registered
             # (and any backstop renumbering settled) at the top of
             # _decode_iter, before any lane arrays were built
             g_ids, g_states, stop_ids = self._guided_lane_arrays(batch, b)
             g_bits, g_next, g_eos_ok = self._guided_device_stack()
+            rep_pens, freq_pens, pres_pens, prompt_counts, out_counts = \
+                self._penalty_arrays(batch, b)
             min_ps = np.zeros(b, dtype=np.float32)
-            rep_pens = np.ones(b, dtype=np.float32)
-            freq_pens = np.zeros(b, dtype=np.float32)
-            pres_pens = np.zeros(b, dtype=np.float32)
-            prompt_counts = np.zeros((b, V), dtype=np.int32)
-            out_counts = np.zeros((b, V), dtype=np.int32)
             for i, s in enumerate(batch):
-                sp = s.req.sampling
-                min_ps[i] = sp.min_p
-                rep_pens[i] = sp.repetition_penalty
-                freq_pens[i] = sp.frequency_penalty
-                pres_pens[i] = sp.presence_penalty
-                if (sp.repetition_penalty != 1.0
-                        or sp.frequency_penalty != 0.0
-                        or sp.presence_penalty != 0.0):
-                    ids, cnts = np.unique(
-                        np.asarray(s.prompt, dtype=np.int64) % V,
-                        return_counts=True)
-                    prompt_counts[i, ids] = cnts
-                    for t, c in s.out_counter.items():
-                        if 0 <= t < V:
-                            out_counts[i, t] = c
+                min_ps[i] = s.req.sampling.min_p
 
         if cfg.pp_mesh is not None:
             from dynamo_tpu.models.llama_pp import pp_decode_multi_step
@@ -1589,6 +1571,31 @@ class TpuEngine:
         import json as _json
 
         return _json.dumps(spec, sort_keys=True)
+
+    def _penalty_arrays(self, lanes: list, width: int):
+        """(rep, freq, pres (width,) f32, prompt_counts, out_counts
+        (width, V) i32) for a wave's lanes — THE one packing all three
+        penalty consumers (prefill first-token, constrained burst, spec
+        burst) build from, so penalty semantics can never diverge
+        between paths. Lanes without penalties get exact no-op values
+        (rep=1, freq/pres=0, zero histograms)."""
+        V = self.model_cfg.vocab_size
+        rep = np.ones(width, dtype=np.float32)
+        freq = np.zeros(width, dtype=np.float32)
+        pres = np.zeros(width, dtype=np.float32)
+        pc = np.zeros((width, V), dtype=np.int32)
+        oc = np.zeros((width, V), dtype=np.int32)
+        for i, s in enumerate(lanes):
+            sp = s.req.sampling
+            rep[i] = sp.repetition_penalty
+            freq[i] = sp.frequency_penalty
+            pres[i] = sp.presence_penalty
+            if s.has_penalties:
+                pc[i] = s.prompt_hist(V)
+                for t, c in s.out_counter.items():
+                    if 0 <= t < V:
+                        oc[i, t] = c
+        return rep, freq, pres, pc, oc
 
     def _guided_lane_arrays(self, batch: list, b: int):
         """(g_ids, g_states, stop_ids) numpy arrays for a burst's lanes

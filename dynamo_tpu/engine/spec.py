@@ -20,12 +20,14 @@ implementation, TPU-first:
     greedy lanes  (temperature == 0): accept while target argmax == draft
     stochastic lanes: accept draft token c with prob min(1, p_t(c)/p_d(c))
       over the lane's ACTUAL sampling distribution — the temperature-
-      scaled softmax restricted by its top-p/top-k filter
-      (sampling.filtered_probs; filtering target and draft identically
-      preserves Leviathan correctness). Greedy lanes are the one-hot
-      special case of the same test (exact argmax equality), so one code
-      path serves every sampling config except min_p/penalties/guided
-      (those lanes route to the constrained fused burst instead).
+      scaled softmax restricted by its top-p/top-k/min_p filter and
+      penalty-adjusted logits (sampling.filtered_probs +
+      apply_penalties; filtering target and draft identically preserves
+      Leviathan correctness). Greedy lanes are the one-hot special case
+      of the same test (exact argmax equality), so one code path serves
+      EVERY sampling config — guided grammars mask both sides through
+      the DFA row, penalties ride a tentative-counts chain (see
+      spec_decode_multi_step), min_p rides the shared filter.
 
 Output is PACKED into one f32 array (3, num_iters, gamma+1, B):
 row 0 token ids, row 1 chosen-token target logprobs, row 2 the per-lane
@@ -55,18 +57,20 @@ _DRAFT_SEED_SALT = jnp.uint32(0x9E3779B9)
 
 
 def _lane_probs(logits: jax.Array, temperature: jax.Array,
-                top_p: jax.Array, top_k: jax.Array) -> jax.Array:
+                top_p: jax.Array, top_k: jax.Array,
+                min_p=None) -> jax.Array:
     """Per-lane ACTUAL sampling distribution for (B, V) or (B, G, V)
     logits (sampling.filtered_probs, vectorized over the middle dim)."""
     from dynamo_tpu.engine.sampling import filtered_probs
 
     if logits.ndim == 2:
-        return filtered_probs(logits, temperature, top_p, top_k)
+        return filtered_probs(logits, temperature, top_p, top_k, min_p)
     b, g, v = logits.shape
     flat = filtered_probs(
         logits.reshape(b * g, v),
         jnp.repeat(temperature, g), jnp.repeat(top_p, g),
-        jnp.repeat(top_k, g))
+        jnp.repeat(top_k, g),
+        None if min_p is None else jnp.repeat(min_p, g))
     return flat.reshape(b, g, v)
 
 
@@ -77,7 +81,7 @@ def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
 
 @partial(jax.jit,
          static_argnames=("cfg", "draft_cfg", "gamma", "num_iters",
-                          "use_guided", "topk_lp"),
+                          "use_guided", "topk_lp", "use_penalties"),
          donate_argnums=(2, 3, 4, 5))
 def spec_decode_multi_step(
         params: dict, draft_params: dict,
@@ -91,7 +95,11 @@ def spec_decode_multi_step(
         use_guided: bool = False,
         g_bits=None, g_next=None, g_eos_ok=None,
         g_ids=None, g_states=None, stop_ids=None,
-        topk_lp: int = 0):
+        topk_lp: int = 0,
+        min_p=None,
+        use_penalties: bool = False,
+        rep_pen=None, freq_pen=None, pres_pen=None,
+        prompt_counts=None, out_counts=None):
     """`num_iters` fused draft→verify→accept iterations, ONE host sync.
 
     tokens/positions/valid/seeds/steps0/temperature: (B,). Pages for
@@ -116,6 +124,23 @@ def spec_decode_multi_step(
     logprobs (same log_softmax as the chosen row — the target verify
     forward's distribution, so spec and plain bursts report identical
     alternatives under greedy).
+
+    min_p: optional (B,) — threaded into filtered_probs on BOTH the
+    draft and target sides, so min_p lanes ride spec bursts with the
+    Leviathan test intact (identical filtered support both sides).
+
+    use_penalties: OpenAI/HF sampling penalties ride the burst too.
+    rep/freq/pres_pen: (B,); prompt_counts/out_counts: (B, V) token
+    histograms at burst start. The draft chain carries TENTATIVE output
+    counts (each proposal increments its token), and target
+    verification at position i penalizes with the counts after the
+    first i proposals — identical to what the draft used when sampling
+    proposal i+1, because the accepted prefix IS the proposal prefix
+    (the same argument that makes the guided DFA-state chain sound).
+    After acceptance the real counts resume from the accepted prefix's
+    entry plus the extra token. One apply_penalties definition
+    (engine/sampling.py) serves both sides, so spec and constrained
+    bursts can never diverge on penalty semantics.
     """
     B = tokens.shape[0]
     G1 = gamma + 1
@@ -147,8 +172,25 @@ def spec_decode_multi_step(
             return logits
         return jnp.where(allow, logits, -1e30)
 
+    if use_penalties:
+        from dynamo_tpu.engine.sampling import apply_penalties
+
+        def pen(logits, counts):
+            return apply_penalties(logits, prompt_counts, counts,
+                                   rep_pen, freq_pen, pres_pen)
+
+        def bump(counts, toks_):
+            return counts.at[jnp.arange(B), toks_].add(
+                valid.astype(counts.dtype))
+    else:
+        def pen(logits, counts):
+            return logits
+
+        def bump(counts, toks_):
+            return counts
+
     def one_iter(it, carry):
-        cur, pos, kc, vc, dk, dv, steps, gst, out = carry
+        cur, pos, kc, vc, dk, dv, steps, gst, oc, out = carry
 
         # -- draft: gamma autoregressive proposals (its own small cache).
         # gamma+1 forwards: the last one's logits are unused but it WRITES
@@ -159,8 +201,10 @@ def spec_decode_multi_step(
         d_probs = []
         d_allows = []        # per-position grammar masks (guided only)
         d_states = [gst]     # DFA state BEFORE sampling position j+1
+        d_counts = [oc]      # tentative counts BEFORE position j+1
         dtok = cur
         st = gst
+        ct = oc
         for j in range(gamma + 1):
             dlogits, dk, dv = _decode_once(
                 draft_params, dk, dv, dtok, pos + j, page_tables, valid,
@@ -168,8 +212,8 @@ def spec_decode_multi_step(
             if j == gamma:
                 break
             allow_j = allow_rows(st)
-            dp = _lane_probs(mask(dlogits, allow_j), temperature, top_p,
-                             top_k)
+            dp = _lane_probs(mask(pen(dlogits, ct), allow_j),
+                             temperature, top_p, top_k, min_p)
             key = jax.vmap(
                 lambda s, st_: jax.random.fold_in(
                     jax.random.fold_in(jax.random.PRNGKey(s), st_),
@@ -183,6 +227,8 @@ def spec_decode_multi_step(
             d_allows.append(allow_j)
             st = advance(st, dtok)
             d_states.append(st)
+            ct = bump(ct, dtok)
+            d_counts.append(ct)
         verify_toks = jnp.stack(d_tokens, axis=1)          # (B, G1)
         draft_p = jnp.stack(d_probs, axis=1)               # (B, gamma, V)
 
@@ -191,6 +237,21 @@ def spec_decode_multi_step(
         x, kc, vc = paged_forward(params, kc, vc, verify_toks, page_tables,
                                   pos, seq_lens, cfg, False)
         logits = qm(x, params["lm_head"]).astype(jnp.float32)  # (B, G1, V)
+        if use_penalties:
+            # position i's counts = counts after the first i proposals —
+            # exactly what the draft used there (accepted prefix ==
+            # proposal prefix). One flat apply_penalties call keeps THE
+            # definition shared with the constrained burst.
+            from dynamo_tpu.engine.sampling import apply_penalties
+
+            counts_stack = jnp.stack(d_counts, axis=1)     # (B, G1, V)
+            V = logits.shape[-1]
+            logits = apply_penalties(
+                logits.reshape(B * G1, V),
+                jnp.repeat(prompt_counts, G1, axis=0),
+                counts_stack.reshape(B * G1, V),
+                jnp.repeat(rep_pen, G1), jnp.repeat(freq_pen, G1),
+                jnp.repeat(pres_pen, G1)).reshape(B, G1, V)
         if use_guided:
             # mask position i by the state reached after the accepted
             # prefix — identical to the draft's tentative state there
@@ -198,7 +259,7 @@ def spec_decode_multi_step(
                 d_allows + [allow_rows(d_states[gamma])],
                 axis=1)                                    # (B, G1, V)
             logits = jnp.where(allow_all, logits, -1e30)
-        target_p = _lane_probs(logits, temperature, top_p, top_k)
+        target_p = _lane_probs(logits, temperature, top_p, top_k, min_p)
 
         # -- acceptance ----------------------------------------------------
         cand = verify_toks[:, 1:]                          # (B, gamma)
@@ -280,16 +341,26 @@ def spec_decode_multi_step(
             new_gst = advance(st_at_n, last)
         else:
             new_gst = gst
+        if use_penalties:
+            # counts resume from the accepted prefix's tentative entry
+            # (rejected proposals never happened) plus the extra token
+            oc_at_n = jnp.take_along_axis(
+                counts_stack, n_acc[:, None, None], axis=1)[:, 0]
+            new_oc = bump(oc_at_n, last)
+        else:
+            new_oc = oc
         return (last, new_pos, kc, vc, dk, dv,
-                steps + count.astype(jnp.uint32), new_gst, out)
+                steps + count.astype(jnp.uint32), new_gst, new_oc, out)
 
     out0 = jnp.zeros((3 + 2 * topk_lp, num_iters, G1, B),
                      dtype=jnp.float32)
     gst0 = (g_states.astype(jnp.int32) if use_guided
             else jnp.zeros((B,), jnp.int32))
-    (cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, _,
+    oc0 = (out_counts.astype(jnp.int32) if use_penalties
+           else jnp.zeros((), jnp.int32))
+    (cur, pos, k_cache, v_cache, dk_cache, dv_cache, _, _, _,
      out) = lax.fori_loop(
         0, num_iters, one_iter,
         (tokens, positions, k_cache, v_cache, dk_cache, dv_cache,
-         steps0.astype(jnp.uint32), gst0, out0))
+         steps0.astype(jnp.uint32), gst0, oc0, out0))
     return out, k_cache, v_cache, dk_cache, dv_cache, pos

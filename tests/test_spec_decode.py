@@ -84,19 +84,89 @@ async def test_nucleus_lane_rides_spec_bursts():
     assert stats.acceptance_rate > 0.8, stats.to_dict()
 
 
-async def test_min_p_lane_falls_back_to_constrained():
-    draft_params = init_params(jax.random.PRNGKey(99), CFG)
+async def test_min_p_lane_rides_spec_bursts():
+    # min_p threads through filtered_probs on BOTH the draft and target
+    # sides (r4 excluded these lanes; now they speculate)
+    target_params = init_params(jax.random.PRNGKey(0), CFG)
     eng = TpuEngine(TpuEngineConfig(
         model=CFG, num_pages=96, max_batch_size=2, default_max_tokens=8,
         draft_model=CFG, spec_gamma=2, spec_iters_per_sync=2),
-        draft_params=draft_params)
+        params=target_params, draft_params=target_params)
     req = {"token_ids": list(PROMPT), "model": "m",
            "sampling": {"temperature": 0.8, "min_p": 0.2, "seed": 3},
            "stop": {"max_tokens": 8}}
     toks = [t async for o in eng.generate(req, Context())
             for t in o.get("token_ids", [])]
     assert len(toks) == 8
-    assert eng._spec_stats.num_draft_tokens == 0  # constrained path
+    st = eng._spec_stats
+    assert st.num_draft_tokens > 0, "min_p lane must keep speculation"
+    # self-draft + identical filters ⇒ acceptance stays high
+    assert st.acceptance_rate > 0.8, st.to_dict()
+    await eng.close()
+
+
+async def test_greedy_penalty_spec_matches_constrained_engine():
+    """Greedy + repetition/frequency/presence penalties through a spec
+    burst must emit EXACTLY the no-draft constrained engine's tokens —
+    the tentative-counts chain makes the verify distribution at every
+    position identical to the sequential constrained one."""
+    sampling = {"temperature": 0.0, "repetition_penalty": 1.3,
+                "frequency_penalty": 0.2, "presence_penalty": 0.1}
+
+    async def run(draft):
+        eng = TpuEngine(TpuEngineConfig(
+            model=CFG, num_pages=96, max_batch_size=2,
+            default_max_tokens=24, decode_steps_per_sync=4,
+            draft_model=CFG if draft else None, spec_gamma=3,
+            spec_iters_per_sync=2),
+            draft_params=(init_params(jax.random.PRNGKey(99), CFG)
+                          if draft else None))
+        req = {"token_ids": list(PROMPT), "model": "m",
+               "sampling": dict(sampling), "stop": {"max_tokens": 24}}
+        toks = []
+        async for o in eng.generate(req, Context()):
+            toks += o.get("token_ids", [])
+        stats = eng._spec_stats
+        await eng.close()
+        return toks, stats
+
+    base, _ = await run(draft=False)
+    spec, stats = await run(draft=True)
+    assert spec == base
+    assert stats.num_draft_tokens > 0, \
+        "penalty lane must keep speculation"
+
+
+async def test_spec_penalty_mixed_batch_with_plain_lane():
+    """A penalty lane and a plain greedy lane share one spec burst;
+    the plain lane's output must equal its solo greedy sequence."""
+    base, _ = await run_engine(n_tokens=16)
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=96, max_batch_size=2, default_max_tokens=16,
+        decode_steps_per_sync=4, draft_model=CFG, spec_gamma=3,
+        spec_iters_per_sync=2),
+        draft_params=init_params(jax.random.PRNGKey(0), CFG))
+
+    async def plain():
+        req = {"token_ids": list(PROMPT), "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 16}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    async def penalized():
+        req = {"token_ids": [9, 8, 7], "model": "m",
+               "sampling": {"temperature": 0.7, "seed": 11,
+                            "repetition_penalty": 1.2,
+                            "frequency_penalty": 0.3},
+               "stop": {"max_tokens": 12}}
+        return [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+
+    p, q = await asyncio.gather(plain(), penalized())
+    assert p == base
+    assert len(q) == 12
+    assert eng._spec_stats.num_draft_tokens > 0
     await eng.close()
 
 
@@ -261,15 +331,16 @@ async def test_spec_guided_mixed_batch_with_plain_lane():
     await eng.close()
 
 
-async def test_spec_sampled_distribution_matches_target_only():
+async def _spec_tv_distance(min_p: float = 0.0,
+                            penalties: bool = False) -> float:
     """Leviathan correctness, measured: over many lanes/seeds, the
     first spec-emitted token's empirical distribution must match
-    target-only sampling from the same filtered distribution (total
-    variation distance small). A biased acceptance rule shows up here
-    directly."""
+    target-only sampling from the same filtered (and, when enabled,
+    penalty-adjusted / min_p-restricted) distribution. A biased
+    acceptance rule shows up directly as TV distance."""
     import jax.numpy as jnp
 
-    from dynamo_tpu.engine.sampling import filtered_probs
+    from dynamo_tpu.engine.sampling import apply_penalties, filtered_probs
     from dynamo_tpu.engine.spec import spec_decode_multi_step
     from dynamo_tpu.models.llama import init_cache, prefill_step
 
@@ -307,6 +378,7 @@ async def test_spec_sampled_distribution_matches_target_only():
     del logits
     cur = 7
     temp, top_k = 1.0, 8
+    V = CFG.vocab_size
     rkc, rvc = init_cache(CFG, num_pages=4)
     padded5 = np.zeros(T, dtype=np.int32)
     padded5[:5] = prompt + [cur]
@@ -315,11 +387,40 @@ async def test_spec_sampled_distribution_matches_target_only():
     ref_logits, _, _ = prefill_step(
         params, rkc, rvc, jnp.asarray(padded5), jnp.asarray(ref_table),
         jnp.int32(0), jnp.int32(5), CFG)
+    # the emitted position's histograms: prompt tokens + the one output
+    # token already emitted (cur) — what the engine's host counters
+    # would hold at burst start
+    p_cnt = np.zeros((1, V), dtype=np.int32)
+    ids, cnts = np.unique(np.asarray(prompt), return_counts=True)
+    p_cnt[0, ids] = cnts
+    o_cnt = np.zeros((1, V), dtype=np.int32)
+    o_cnt[0, cur] = 1
+    rep, freq, pres = (1.4, 0.3, 0.2) if penalties else (1.0, 0.0, 0.0)
+    ref_l = ref_logits[None].astype(jnp.float32)
+    if penalties:
+        ref_l = apply_penalties(
+            ref_l, jnp.asarray(p_cnt), jnp.asarray(o_cnt),
+            jnp.asarray([rep], jnp.float32),
+            jnp.asarray([freq], jnp.float32),
+            jnp.asarray([pres], jnp.float32))
     ref = np.asarray(filtered_probs(
-        ref_logits[None].astype(jnp.float32), jnp.asarray([temp]),
-        jnp.asarray([1.0]), jnp.asarray([top_k])))[0]
+        ref_l, jnp.asarray([temp]), jnp.asarray([1.0]),
+        jnp.asarray([top_k]),
+        jnp.asarray([min_p], jnp.float32) if min_p else None))[0]
 
-    counts = np.zeros(CFG.vocab_size)
+    extra_kw = {}
+    if min_p:
+        extra_kw["min_p"] = jnp.full((B,), min_p, jnp.float32)
+    if penalties:
+        extra_kw.update(
+            use_penalties=True,
+            rep_pen=jnp.full((B,), rep, jnp.float32),
+            freq_pen=jnp.full((B,), freq, jnp.float32),
+            pres_pen=jnp.full((B,), pres, jnp.float32),
+            prompt_counts=jnp.asarray(np.tile(p_cnt, (B, 1))),
+            out_counts=jnp.asarray(np.tile(o_cnt, (B, 1))))
+
+    counts = np.zeros(V)
     n = 0
     last_tok = cur
     for r in range(reps):
@@ -339,15 +440,33 @@ async def test_spec_sampled_distribution_matches_target_only():
             jnp.full((B,), temp, jnp.float32),
             jnp.ones((B,), jnp.float32),
             jnp.full((B,), top_k, jnp.int32),
-            CFG, CFG, 2, 1)
+            CFG, CFG, 2, 1, **extra_kw)
         first = np.asarray(packed)[0, 0, 0, :].astype(np.int64)
         for t in first:
             counts[t] += 1
             n += 1
     emp = counts / n
     tv = 0.5 * np.abs(emp - ref).sum()
+    if tv >= 0.25:
+        raise AssertionError((tv, np.nonzero(counts)[0], ref.max()))
+    return tv
+
+
+async def test_spec_sampled_distribution_matches_target_only():
     # 256 samples over <=8 support: TV ~ O(sqrt(k/n)) ~ 0.12 expected
-    assert tv < 0.25, (tv, np.nonzero(counts)[0], ref.max())
+    await _spec_tv_distance()
+
+
+async def test_spec_min_p_distribution_matches_target_only():
+    # min_p shrinks the support; the spec-emitted distribution must
+    # match target-only min_p sampling (r4: these lanes fell back)
+    await _spec_tv_distance(min_p=0.15)
+
+
+async def test_spec_penalty_distribution_matches_target_only():
+    # penalties shift the logits identically on both sides; the
+    # tentative-counts chain must not bias the first emitted token
+    await _spec_tv_distance(penalties=True)
 
 
 async def test_spec_topk_logprobs_match_no_spec():

@@ -146,9 +146,11 @@ class TpuEngineConfig:
     # pp_microbatches lane groups with a psum token mailbox
     # (pp_decode_multi_step). For models whose weights exceed a TP
     # slice's HBM. Requires max_batch_size % pp_microbatches == 0 and
-    # pp_microbatches >= the stage count; spec/guided/min_p/penalty/
-    # top-logprob lanes are rejected (plain top_k/top_p sampling rides
-    # the pipeline). Reference serves PP via engine flags:
+    # pp_microbatches >= the stage count. The FULL sampling matrix
+    # rides the pipeline (guided grammars, min_p, penalties,
+    # top-logprobs — the constrained head runs on the last stage);
+    # only speculative decoding and quantize don't compose with pp
+    # yet. Reference serves PP via engine flags:
     # trtllm_utils.py:39,167-170 --pipeline-parallel-size.
     pp_mesh: Optional[Any] = None
     pp_microbatches: int = 2
@@ -592,20 +594,6 @@ class TpuEngine:
                 token_ids=[], finish_reason=FINISH_ERROR,
                 extra={"error": "empty prompt"}).to_dict()
             return
-        if cfg.pp_mesh is not None:
-            sp_ = req.sampling
-            if (sp_.guided or sp_.min_p > 0.0 or sp_.top_logprobs > 0
-                    or sp_.repetition_penalty != 1.0
-                    or sp_.frequency_penalty != 0.0
-                    or sp_.presence_penalty != 0.0):
-                # the pp decode pipeline runs the plain sampled burst
-                # only; reject up front rather than silently ignore
-                yield EngineOutput(
-                    token_ids=[], finish_reason=FINISH_ERROR,
-                    extra={"error": "pipeline-parallel engines do not "
-                                    "support guided/min_p/penalties/"
-                                    "top_logprobs"}).to_dict()
-                return
         guided_tables = None
         guided_key = None
         if req.sampling.guided:
@@ -1199,6 +1187,25 @@ class TpuEngine:
         if cfg.pp_mesh is not None:
             from dynamo_tpu.models.llama_pp import pp_decode_multi_step
 
+            ckw = {}
+            if use_constrained:
+                # full sampling matrix on pp engines (reference serves
+                # sampling uniformly regardless of parallelism:
+                # trtllm_utils.py:167-176) — the SAME lane packings the
+                # plain constrained burst built above
+                ckw = dict(
+                    use_constrained=True,
+                    min_p=jax.numpy.asarray(min_ps),
+                    rep_pen=jax.numpy.asarray(rep_pens),
+                    freq_pen=jax.numpy.asarray(freq_pens),
+                    pres_pen=jax.numpy.asarray(pres_pens),
+                    prompt_counts=jax.numpy.asarray(prompt_counts),
+                    out_counts=jax.numpy.asarray(out_counts),
+                    g_bits=g_bits, g_next=g_next, g_eos_ok=g_eos_ok,
+                    g_ids=jax.numpy.asarray(g_ids),
+                    g_states=jax.numpy.asarray(g_states),
+                    stop_ids=jax.numpy.asarray(stop_ids))
+
             def run_pp_burst():
                 packed, kc, vc = pp_decode_multi_step(
                     self.params, self.k_cache, self.v_cache,
@@ -1209,13 +1216,13 @@ class TpuEngine:
                     jax.numpy.asarray(steps), jax.numpy.asarray(temps),
                     jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
                     mcfg, cfg.pp_mesh, k_steps,
-                    n_micro=cfg.pp_microbatches)
+                    n_micro=cfg.pp_microbatches, topk_lp=tk, **ckw)
                 return np.asarray(packed), kc, vc     # ONE host sync
 
             async with self._device_lock:
                 packed, self.k_cache, self.v_cache = \
                     await asyncio.to_thread(run_pp_burst)
-            self._emit_burst(batch, packed, k_steps, 0)
+            self._emit_burst(batch, packed, k_steps, tk)
             return True
 
         if cfg.pipeline_bursts and not use_constrained:

@@ -124,6 +124,48 @@ def apply_penalties(logits: jax.Array, prompt_counts: jax.Array,
     return logits
 
 
+def stop_token_mask(stop_ids: jax.Array, vocab: int) -> jax.Array:
+    """(B, V) bool from (B, K) per-lane stop-token ids (-1 padding):
+    which vocab entries are the lane's stop tokens. Shared by every
+    guided consumer so 'what counts as a stop token' can't diverge."""
+    return (jnp.arange(vocab, dtype=jnp.int32)[None, None, :]
+            == stop_ids[:, :, None]).any(axis=1)
+
+
+def guided_allow(g_bits: jax.Array, g_eos_ok: jax.Array,
+                 g_ids: jax.Array, states: jax.Array,
+                 is_stop: jax.Array) -> jax.Array:
+    """(B, V) bool allow-mask from the stacked DFA tables — THE one
+    definition of 'which tokens the grammar permits here' (bit-packed
+    allowed rows, plus the lane's stop tokens wherever the grammar
+    accepts). Used by the plain constrained burst
+    (llama.decode_multi_step_guided), the spec burst (engine/spec.py),
+    and the pp constrained head (llama_pp.py) — keeping them
+    semantically identical is what makes their token-parity contracts
+    sound."""
+    V = is_stop.shape[-1]
+    byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
+    bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
+    rows = g_bits[g_ids, states]                   # (B, ceil(V/8))
+    allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
+    return (allowed > 0) | (g_eos_ok[g_ids, states][:, None] & is_stop)
+
+
+def constrained_logits(logits: jax.Array, prompt_counts: jax.Array,
+                       counts: jax.Array, rep: jax.Array,
+                       freq: jax.Array, pres: jax.Array,
+                       g_bits: jax.Array, g_eos_ok: jax.Array,
+                       g_ids: jax.Array, states: jax.Array,
+                       is_stop: jax.Array) -> jax.Array:
+    """The full constrained head minus sampling: penalties, then the
+    DFA mask (order matters only in that masked entries must stay
+    masked — penalties never raise a -1e30)."""
+    logits = apply_penalties(logits, prompt_counts, counts, rep, freq,
+                             pres)
+    allow = guided_allow(g_bits, g_eos_ok, g_ids, states, is_stop)
+    return jnp.where(allow, logits, _NEG_INF)
+
+
 def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
     """(B,) log-probability of each row's sampled token (traceable) —
     the ONE definition both prefill sampling and the fused decode loop

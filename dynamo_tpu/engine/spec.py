@@ -146,17 +146,15 @@ def spec_decode_multi_step(
     G1 = gamma + 1
     draft_seeds = seeds.astype(jnp.uint32) ^ _DRAFT_SEED_SALT
     if use_guided:
-        V = cfg.vocab_size
-        byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
-        bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
-        is_stop = (jnp.arange(V, dtype=jnp.int32)[None, None, :]
-                   == stop_ids[:, :, None]).any(axis=1)    # (B, V)
+        from dynamo_tpu.engine.sampling import (
+            guided_allow,
+            stop_token_mask,
+        )
+
+        is_stop = stop_token_mask(stop_ids, cfg.vocab_size)   # (B, V)
 
         def allow_rows(states):
-            rows = g_bits[g_ids, states]               # (B, ceil(V/8))
-            allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
-            return (allowed > 0) | (g_eos_ok[g_ids, states][:, None]
-                                    & is_stop)
+            return guided_allow(g_bits, g_eos_ok, g_ids, states, is_stop)
 
         def advance(states, toks_):
             return g_next[g_ids, states, toks_].astype(jnp.int32)

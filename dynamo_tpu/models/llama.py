@@ -503,28 +503,23 @@ def decode_multi_step_guided(params: dict, k_cache, v_cache,
     the tables; the engine recomputes authoritative states host-side
     from the emitted tokens)."""
     from dynamo_tpu.engine.sampling import (
-        apply_penalties,
         chosen_logprob,
+        constrained_logits,
         sample_tokens_traced,
+        stop_token_mask,
     )
 
     V = cfg.vocab_size
     B = tokens.shape[0]
-    byte_idx = jnp.arange(V, dtype=jnp.int32) // 8
-    bit_idx = (jnp.arange(V, dtype=jnp.int32) % 8).astype(jnp.uint8)
-    is_stop = (jnp.arange(V, dtype=jnp.int32)[None, None, :]
-               == stop_ids[:, :, None]).any(axis=1)       # (B, V)
+    is_stop = stop_token_mask(stop_ids, V)                # (B, V)
 
     def body(i, carry):
         toks, st, counts, kc, vc, out = carry
         logits, kc, vc = _decode_once(
             params, kc, vc, toks, positions + i, page_tables, valid, cfg)
-        logits = apply_penalties(logits, prompt_counts, counts, rep_pen,
-                                 freq_pen, pres_pen)
-        rows = g_bits[g_ids, st]                       # (B, ceil(V/8))
-        allowed = (rows[:, byte_idx] >> bit_idx) & jnp.uint8(1)
-        allow = (allowed > 0) | (g_eos_ok[g_ids, st][:, None] & is_stop)
-        logits = jnp.where(allow, logits, -1e30)
+        logits = constrained_logits(
+            logits, prompt_counts, counts, rep_pen, freq_pen, pres_pen,
+            g_bits, g_eos_ok, g_ids, st, is_stop)
         sampled = sample_tokens_traced(
             logits, seeds, steps0 + i, temperature, top_p, top_k, min_p)
         chosen = chosen_logprob(logits, sampled)

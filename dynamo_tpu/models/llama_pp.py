@@ -313,29 +313,57 @@ def pp_cache_specs() -> P:
 
 def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
                      page_tables, valid, seeds, steps0, temperature,
-                     top_p, top_k, cfg: LlamaConfig, axis: str,
-                     n_stages: int, n_micro: int, num_steps: int):
+                     top_p, top_k, min_p, rep_pen, freq_pen, pres_pen,
+                     prompt_counts, out_counts, g_bits, g_next,
+                     g_eos_ok, g_ids, g_states, stop_ids,
+                     cfg: LlamaConfig, axis: str,
+                     n_stages: int, n_micro: int, num_steps: int,
+                     use_constrained: bool = False, topk_lp: int = 0):
     """Per-stage body. tokens0/positions/valid/seeds/steps0/temperature/
-    top_p/top_k: (M, Bm); page_tables: (M, Bm, max_pages); caches
-    (L_local, KVH, N, P, D) stage-local. Returns (2, num_steps, M, Bm)
-    sampled ids + chosen logprobs (real on the last stage) and the
-    updated caches."""
+    top_p/top_k (+ min_p/rep/freq/pres_pen when constrained): (M, Bm);
+    page_tables: (M, Bm, max_pages); prompt_counts/out_counts:
+    (M, Bm, V); guided tables (g_bits/g_next/g_eos_ok) replicated,
+    g_ids/g_states: (M, Bm); stop_ids: (M, Bm, K); caches
+    (L_local, KVH, N, P, D) stage-local. Returns
+    (2 + 2*topk_lp, num_steps, M, Bm) packed rows (real on the last
+    stage) and the updated caches.
+
+    use_constrained: the LAST stage applies the same constrained
+    sampling head as decode_multi_step_guided (penalties from a carried
+    per-microbatch counts histogram, DFA mask, min_p) — every stage
+    executes the same code on its (garbage) logits, but only the last
+    stage's chain is real: its sampled tokens gate the out/mailbox/
+    state/count updates through `write`, so the other stages' carried
+    copies never update and never matter."""
     from dynamo_tpu.engine.attention import paged_attention_decode
-    from dynamo_tpu.engine.sampling import chosen_logprob, sample_tokens_traced
+    from dynamo_tpu.engine.sampling import (
+        chosen_logprob,
+        constrained_logits,
+        sample_tokens_traced,
+        stop_token_mask,
+        topk_logprobs,
+    )
 
     stage = lax.axis_index(axis)
     M, Bm = tokens0.shape
     E = cfg.hidden_size
     L_local = k_cache.shape[0]
     total = num_steps * n_micro
+    n_rows = 2 + 2 * topk_lp
 
-    out0 = jnp.zeros((2, num_steps, M, Bm), jnp.float32)
+    out0 = jnp.zeros((n_rows, num_steps, M, Bm), jnp.float32)
     x0 = jnp.zeros((Bm, E), cfg.dtype)
     out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
     perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+    if use_constrained:
+        V = cfg.vocab_size
+        K_stop = stop_ids.shape[-1]
+        # (M, Bm, V): which vocab entries are the lane's stop tokens
+        is_stop = stop_token_mask(
+            stop_ids.reshape(M * Bm, K_stop), V).reshape(M, Bm, V)
 
     def step(carry, r):
-        x_recv, mailbox, kc_all, vc_all, out = carry
+        x_recv, mailbox, gst, counts, kc_all, vc_all, out = carry
         p = r - stage
         active = (p >= 0) & (p < total)
         p_safe = jnp.clip(p, 0, total - 1)
@@ -377,20 +405,55 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
 
         xf = rms_norm(x, params["final_norm"], cfg.rms_eps)
         logits = qm(xf, params["lm_head"]).astype(jnp.float32)
+        write = active & (stage == n_stages - 1)
+        minp_m = None
+        if use_constrained:
+            # the SAME head as decode_multi_step_guided (one shared
+            # definition: sampling.constrained_logits), then min_p in
+            # the sampler
+            st_m = lax.dynamic_index_in_dim(gst, m, 0, False)   # (Bm,)
+            cnt_m = lax.dynamic_index_in_dim(counts, m, 0, False)
+            gid_m = lax.dynamic_index_in_dim(g_ids, m, 0, False)
+            logits = constrained_logits(
+                logits,
+                lax.dynamic_index_in_dim(prompt_counts, m, 0, False),
+                cnt_m,
+                lax.dynamic_index_in_dim(rep_pen, m, 0, False),
+                lax.dynamic_index_in_dim(freq_pen, m, 0, False),
+                lax.dynamic_index_in_dim(pres_pen, m, 0, False),
+                g_bits, g_eos_ok, gid_m, st_m,
+                lax.dynamic_index_in_dim(is_stop, m, 0, False))
+            minp_m = lax.dynamic_index_in_dim(min_p, m, 0, False)
         sampled = sample_tokens_traced(
             logits,
             lax.dynamic_index_in_dim(seeds, m, 0, False),
             lax.dynamic_index_in_dim(steps0, m, 0, False) + k_idx,
             lax.dynamic_index_in_dim(temperature, m, 0, False),
             lax.dynamic_index_in_dim(top_p, m, 0, False),
-            lax.dynamic_index_in_dim(top_k, m, 0, False))
+            lax.dynamic_index_in_dim(top_k, m, 0, False),
+            minp_m)
         lp_chosen = chosen_logprob(logits, sampled)
-        write = active & (stage == n_stages - 1)
+        if use_constrained:
+            new_st = g_next[gid_m, st_m, sampled].astype(jnp.int32)
+            gst = lax.dynamic_update_index_in_dim(
+                gst, jnp.where(write, new_st, st_m), m, 0)
+            new_cnt = cnt_m.at[jnp.arange(Bm), sampled].add(
+                (valid_m & write).astype(cnt_m.dtype))
+            counts = lax.dynamic_update_index_in_dim(counts, new_cnt,
+                                                     m, 0)
 
-        cur = lax.dynamic_slice(out, (0, k_idx, m, 0), (2, 1, 1, Bm))
+        row_list = [sampled.astype(jnp.float32), lp_chosen]
+        if topk_lp:
+            # alternatives from the same (possibly penalized+masked)
+            # logits the lane sampled from — matches the plain engine's
+            # constrained-burst semantics
+            tk_ids, tk_vals = topk_logprobs(logits, topk_lp)
+            row_list += [tk_ids[:, i] for i in range(topk_lp)]
+            row_list += [tk_vals[:, i] for i in range(topk_lp)]
+        cur = lax.dynamic_slice(out, (0, k_idx, m, 0),
+                                (n_rows, 1, 1, Bm))
         upd = jnp.where(write,
-                        jnp.stack([sampled.astype(jnp.float32),
-                                   lp_chosen])[:, None, None, :],
+                        jnp.stack(row_list)[:, None, None, :],
                         cur)
         out = lax.dynamic_update_slice(out, upd, (0, k_idx, m, 0))
         # feedback: the last stage's sampled token becomes microbatch
@@ -402,45 +465,77 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
             .at[m].set(delta), axis)
         mailbox = mailbox + delta_all
         x_next = lax.ppermute(x, axis, perm_fwd)
-        return (x_next, mailbox, kc_all, vc_all, out), None
+        return (x_next, mailbox, gst, counts, kc_all, vc_all, out), None
 
     mailbox0 = lax.pcast(tokens0, (axis,), to='varying')
+    if use_constrained:
+        gst0 = lax.pcast(g_states.astype(jnp.int32), (axis,),
+                         to='varying')
+        counts0 = lax.pcast(out_counts.astype(jnp.int32), (axis,),
+                            to='varying')
+    else:
+        gst0 = lax.pcast(jnp.zeros((M, Bm), jnp.int32), (axis,),
+                         to='varying')
+        counts0 = lax.pcast(jnp.zeros((M, Bm, 1), jnp.int32), (axis,),
+                            to='varying')
     rounds = total + n_stages - 1
-    (_, _, k_cache, v_cache, out), _ = lax.scan(
-        step, (x0, mailbox0, k_cache, v_cache, out0),
+    (_, _, _, _, k_cache, v_cache, out), _ = lax.scan(
+        step, (x0, mailbox0, gst0, counts0, k_cache, v_cache, out0),
         jnp.arange(rounds))
     return out[None], k_cache, v_cache
 
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "mesh", "axis", "n_micro",
-                                    "num_steps"),
+                                    "num_steps", "use_constrained",
+                                    "topk_lp"),
                    donate_argnums=(1, 2))
 def _pp_decode_jit(params, k_cache, v_cache, tokens, positions,
                    page_tables, valid, seeds, steps0, temperature,
-                   top_p, top_k, cfg: LlamaConfig, mesh: Mesh, axis: str,
-                   n_micro: int, num_steps: int):
+                   top_p, top_k, min_p, rep_pen, freq_pen, pres_pen,
+                   prompt_counts, out_counts, g_bits, g_next, g_eos_ok,
+                   g_ids, g_states, stop_ids,
+                   cfg: LlamaConfig, mesh: Mesh, axis: str,
+                   n_micro: int, num_steps: int,
+                   use_constrained: bool, topk_lp: int):
     n_stages = mesh.shape[axis]
+    mb2 = P(None, None)
+    mb3 = P(None, None, None)
     fn = jax.shard_map(
         functools.partial(_pp_decode_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_micro=n_micro,
-                          num_steps=num_steps),
+                          num_steps=num_steps,
+                          use_constrained=use_constrained,
+                          topk_lp=topk_lp),
         mesh=mesh,
         in_specs=(pp_specs_for(params), pp_cache_specs(), pp_cache_specs(),
-                  P(None, None), P(None, None), P(None, None, None),
-                  P(None, None), P(None, None), P(None, None),
-                  P(None, None), P(None, None), P(None, None)),
+                  mb2, mb2, mb3,
+                  mb2, mb2, mb2,
+                  mb2, mb2, mb2,
+                  mb2, mb2, mb2, mb2,   # min_p, rep/freq/pres_pen
+                  mb3, mb3,             # prompt_counts, out_counts
+                  mb3, mb3, mb2,        # g_bits, g_next, g_eos_ok
+                  mb2, mb2, mb3),       # g_ids, g_states, stop_ids
         out_specs=(P(axis, None, None, None, None),
                    pp_cache_specs(), pp_cache_specs()))
     return fn(params, k_cache, v_cache, tokens, positions, page_tables,
-              valid, seeds, steps0, temperature, top_p, top_k)
+              valid, seeds, steps0, temperature, top_p, top_k,
+              min_p, rep_pen, freq_pen, pres_pen, prompt_counts,
+              out_counts, g_bits, g_next, g_eos_ok, g_ids, g_states,
+              stop_ids)
 
 
 def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
                          positions, page_tables, valid, seeds, steps0,
                          temperature, top_p, top_k, cfg: LlamaConfig,
                          mesh: Mesh, num_steps: int, n_micro: int = 2,
-                         axis: str = "pp"):
+                         axis: str = "pp",
+                         min_p=None, rep_pen=None, freq_pen=None,
+                         pres_pen=None, prompt_counts=None,
+                         out_counts=None, g_bits=None, g_next=None,
+                         g_eos_ok=None, g_ids=None, g_states=None,
+                         stop_ids=None, use_constrained: bool = False,
+                         topk_lp: int = 0):
     """Microbatched pipeline decode: `num_steps` fused decode+sample
     steps for B lanes split into n_micro groups that round-robin
     through the pp stages (GPipe schedule with a sampled-token feedback
@@ -456,8 +551,17 @@ def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
     n_micro >= n_stages (the schedule needs a microbatch's step-k
     token sampled before its step-k+1 slot reaches stage 0).
 
-    Returns (packed (2, num_steps, B) f32 — decode_multi_step's row
-    layout, k_cache, v_cache)."""
+    use_constrained: the full constrained sampling matrix (grammar
+    masks via the stacked DFA tables, min_p, OpenAI/HF penalties) runs
+    on the last stage — pp engines serve the SAME feature set as the
+    plain engine (the reference's engines own sampling uniformly
+    regardless of parallelism: trtllm_utils.py:167-176). min_p/
+    rep_pen/freq_pen/pres_pen: (B,); prompt_counts/out_counts: (B, V);
+    g_ids/g_states: (B,); stop_ids: (B, K). topk_lp appends top-k
+    alternative id/logprob rows exactly like decode_multi_step.
+
+    Returns (packed (2 + 2*topk_lp, num_steps, B) f32 —
+    decode_multi_step's row layout, k_cache, v_cache)."""
     n_stages = mesh.shape[axis]
     assert cfg.num_layers % n_stages == 0
     assert n_micro >= n_stages, (
@@ -469,6 +573,21 @@ def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
     def mb(a):
         return a.reshape(n_micro, Bm, *a.shape[1:])
 
+    if use_constrained:
+        cargs = (mb(min_p), mb(rep_pen), mb(freq_pen), mb(pres_pen),
+                 mb(prompt_counts), mb(out_counts),
+                 jnp.asarray(g_bits), jnp.asarray(g_next),
+                 jnp.asarray(g_eos_ok), mb(g_ids), mb(g_states),
+                 mb(stop_ids))
+    else:
+        z2 = jnp.zeros((n_micro, Bm), jnp.float32)
+        z2i = jnp.zeros((n_micro, Bm), jnp.int32)
+        z3 = jnp.zeros((n_micro, Bm, 1), jnp.int32)
+        cargs = (z2, z2, z2, z2, z3, z3,
+                 jnp.zeros((1, 1, 1), jnp.uint8),
+                 jnp.zeros((1, 1, 1), jnp.int16),
+                 jnp.zeros((1, 1), bool), z2i, z2i, z3)
+
     sharded_params = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, pp_specs_for(params),
@@ -479,8 +598,8 @@ def pp_decode_multi_step(params: dict, k_cache, v_cache, tokens,
     out, k_cache, v_cache = _pp_decode_jit(
         sharded_params, k_cache, v_cache, mb(tokens), mb(positions),
         mb(page_tables), mb(valid), mb(seeds), mb(steps0),
-        mb(temperature), mb(top_p), mb(top_k), cfg, mesh, axis,
-        n_micro, num_steps)
-    # (S, 2, K, M, Bm) stacked over pp → last stage holds the real rows
-    packed = out[-1].reshape(2, num_steps, B)
+        mb(temperature), mb(top_p), mb(top_k), *cargs, cfg, mesh, axis,
+        n_micro, num_steps, use_constrained, topk_lp)
+    # (S, R, K, M, Bm) stacked over pp → last stage holds the real rows
+    packed = out[-1].reshape(2 + 2 * topk_lp, num_steps, B)
     return packed, k_cache, v_cache

@@ -86,18 +86,94 @@ async def test_pp_engine_concurrent_batch(cpu_mesh_devices):
     await eng.close()
 
 
-async def test_pp_engine_rejects_unsupported_sampling(cpu_mesh_devices):
-    eng = TpuEngine(TpuEngineConfig(
-        model=CFG, num_pages=64, max_batch_size=4,
-        decode_steps_per_sync=4, pp_mesh=pp_mesh(cpu_mesh_devices),
-        pp_microbatches=2))
-    req = {"token_ids": [5, 6, 7], "model": "m",
-           "sampling": {"temperature": 0.0, "top_logprobs": 3},
-           "stop": {"max_tokens": 4}}
-    outs = [o async for o in eng.generate(req, Context())]
-    assert outs[0]["finish_reason"] == "error"
-    assert "pipeline-parallel" in outs[0]["extra"]["error"]
+TOKEN_BYTES = [bytes([i]) if i < 256 else None
+               for i in range(CFG.vocab_size)]
+
+# the full sampling matrix (VERDICT r4 #8: pp engines served a reduced
+# feature set): every request below must produce IDENTICAL output on a
+# pp=2 engine and the plain engine — the constrained head runs on the
+# last stage, same packings as the plain constrained burst
+MATRIX = [
+    {"sampling": {"temperature": 0.0, "top_logprobs": 3}},
+    {"sampling": {"temperature": 0.0, "repetition_penalty": 1.3,
+                  "frequency_penalty": 0.2, "presence_penalty": 0.1}},
+    {"sampling": {"temperature": 0.8, "min_p": 0.2, "seed": 11}},
+    {"sampling": {"temperature": 0.7, "seed": 5,
+                  "guided": {"regex": "[a-f]{8}"}},
+     "stop": {"max_tokens": 10, "stop_token_ids": [0]}},
+]
+
+
+async def collect(eng, prompt, spec):
+    req = {"token_ids": list(prompt), "model": "m",
+           "sampling": dict(spec["sampling"]),
+           "stop": dict(spec.get("stop", {"max_tokens": 10}))}
+    toks, topks = [], []
+    async for o in eng.generate(req, Context()):
+        toks += o.get("token_ids", [])
+        topks += o.get("top_logprobs", []) or []
+    return toks, topks
+
+
+async def test_pp_engine_full_sampling_matrix_matches_plain(
+        cpu_mesh_devices):
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    prompt = [5, 6, 7, 8, 9]
+
+    def mk(pp):
+        kw = dict(pp_mesh=pp_mesh(cpu_mesh_devices),
+                  pp_microbatches=2) if pp else {}
+        return TpuEngine(TpuEngineConfig(
+            model=CFG, num_pages=64, max_batch_size=4,
+            decode_steps_per_sync=4, **kw), params=params,
+            token_bytes=TOKEN_BYTES, eos_token_id=0)
+
+    plain = mk(False)
+    base = [await collect(plain, prompt, s) for s in MATRIX]
+    await plain.close()
+    eng = mk(True)
+    got = [await collect(eng, prompt, s) for s in MATRIX]
     await eng.close()
+    for spec, (bt, btk), (gt, gtk) in zip(MATRIX, base, got):
+        assert gt == bt, (spec, gt, bt)
+        assert [[e[0] for e in row] for row in gtk] == \
+               [[e[0] for e in row] for row in btk], spec
+        for br, gr in zip(btk, gtk):
+            np.testing.assert_allclose([e[1] for e in gr],
+                                       [e[1] for e in br], atol=2e-4)
+    # the guided lane actually obeyed its grammar
+    g_toks = got[3][0]
+    body = bytes(t for t in g_toks if t != 0)
+    assert len(body) == 8 and all(97 <= c <= 102 for c in body), body
+
+
+async def test_pp_engine_mixed_constrained_batch_concurrent(
+        cpu_mesh_devices):
+    """All four sampling flavors IN ONE pp decode batch, concurrently —
+    microbatch grouping must keep per-lane states/counts straight."""
+    import asyncio
+
+    params = init_params(jax.random.PRNGKey(3), CFG)
+    prompts = [[(i * 13 + j) % 250 + 1 for j in range(9 + 2 * i)]
+               for i in range(4)]
+
+    def mk(pp):
+        kw = dict(pp_mesh=pp_mesh(cpu_mesh_devices),
+                  pp_microbatches=2) if pp else {}
+        return TpuEngine(TpuEngineConfig(
+            model=CFG, num_pages=64, max_batch_size=4,
+            decode_steps_per_sync=4, **kw), params=params,
+            token_bytes=TOKEN_BYTES, eos_token_id=0)
+
+    plain = mk(False)
+    base = await asyncio.gather(
+        *(collect(plain, p, s) for p, s in zip(prompts, MATRIX)))
+    await plain.close()
+    eng = mk(True)
+    got = await asyncio.gather(
+        *(collect(eng, p, s) for p, s in zip(prompts, MATRIX)))
+    await eng.close()
+    assert [g[0] for g in got] == [b[0] for b in base]
 
 
 def test_pp_engine_config_validation(cpu_mesh_devices):

@@ -1,0 +1,133 @@
+"""Size a disaggregated deployment from a COMMITTED pre-swept results
+table — no engine boot, no chips.
+
+Reference parity: the reference planner can consume pre-swept profiling
+results / aiconfigurator estimates instead of burning hardware on a
+live sweep (`components/src/dynamo/planner/utils/
+pre_swept_results_utils.py`, `benchmarks/profiler/`). Here the table IS
+the `profile_sla.profile_engine` output format ({"prefill": ...,
+"decode": ...}) — one schema for the live sweep, the committed table,
+and the planner's interpolators, so they can never drift.
+
+Usage:
+    python -m dynamo_tpu.planner.pre_swept deploy/pre_swept/TABLE.json \
+        --ttft-ms 200 --itl-ms 20 --req-per-s 4 --isl 1024 --osl 256
+
+The sizing math is the Planner's own `compute_replica_requirements`
+(planner_core.py) with corrections disabled — a deployment sized from
+the table behaves exactly like the live planner's first adjustment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import (
+    IntervalMetrics,
+    Planner,
+    SlaPlannerConfig,
+)
+
+REQUIRED_PREFILL = ("isl", "ttft_ms", "thpt_per_chip")
+REQUIRED_DECODE = ("x_kv_usage", "y_context_length", "z_itl_ms",
+                   "z_thpt_per_chip", "max_kv_tokens")
+
+
+def load_pre_swept(path: str) -> dict:
+    """Load + validate a pre-swept table (profile_engine's format)."""
+    with open(path) as f:
+        profile = json.load(f)
+    for key, fields in (("prefill", REQUIRED_PREFILL),
+                        ("decode", REQUIRED_DECODE)):
+        section = profile.get(key)
+        if not isinstance(section, dict):
+            raise ValueError(f"pre-swept table missing {key!r} section")
+        for field in fields:
+            vals = section.get(field)
+            if not vals:
+                raise ValueError(
+                    f"pre-swept table {key}.{field} missing/empty")
+    return profile
+
+
+class _NoMetrics:
+    """The pre-swept path never observes live metrics."""
+
+    async def interval_metrics(self) -> IntervalMetrics:
+        return IntervalMetrics()
+
+
+def size_from_pre_swept(profile: dict, *, ttft_ms: float, itl_ms: float,
+                        req_per_s: float, isl: float, osl: float,
+                        chips_per_prefill_engine: int = 1,
+                        chips_per_decode_engine: int = 1,
+                        max_chip_budget: int = 64,
+                        min_endpoint: int = 1,
+                        interval_s: float = 60.0) -> dict:
+    """p/d pool sizes for a target SLA + load, from the table alone."""
+    cfg = SlaPlannerConfig(
+        adjustment_interval=interval_s,
+        ttft_sla=ttft_ms / 1e3, itl_sla=itl_ms / 1e3,
+        chips_per_prefill_engine=chips_per_prefill_engine,
+        chips_per_decode_engine=chips_per_decode_engine,
+        max_chip_budget=max_chip_budget, min_endpoint=min_endpoint,
+        no_correction=True)
+    planner = Planner(cfg, PrefillInterpolator(profile["prefill"]),
+                      DecodeInterpolator(profile["decode"]),
+                      _NoMetrics())
+    num_p, num_d = planner.compute_replica_requirements(
+        req_per_s * interval_s, isl, osl)
+    expected_ttft = planner.prefill_interpolator.interpolate_ttft(isl)
+    d_thpt, best_kv, expected_itl = \
+        planner.decode_interpolator.find_best_throughput_per_chip(
+            itl=cfg.itl_sla, context_length=isl + osl / 2)
+    return {
+        "prefill_replicas": num_p,
+        "decode_replicas": num_d,
+        "total_chips": (num_p * chips_per_prefill_engine
+                        + num_d * chips_per_decode_engine),
+        "expected_ttft_ms": round(expected_ttft * 1e3, 1),
+        "expected_itl_ms": round(expected_itl * 1e3, 2),
+        "decode_thpt_per_chip_at_sla": round(d_thpt, 1),
+        "decode_best_kv_usage": round(best_kv, 3),
+        "ttft_sla_ok": expected_ttft * 1e3 <= ttft_ms,
+        "inputs": {"ttft_ms": ttft_ms, "itl_ms": itl_ms,
+                   "req_per_s": req_per_s, "isl": isl, "osl": osl},
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.planner.pre_swept",
+        description="size a p/d deployment from a pre-swept table")
+    p.add_argument("table", help="pre-swept results json "
+                                 "(profile_sla.profile_engine format)")
+    p.add_argument("--ttft-ms", type=float, required=True)
+    p.add_argument("--itl-ms", type=float, required=True)
+    p.add_argument("--req-per-s", type=float, required=True)
+    p.add_argument("--isl", type=float, required=True)
+    p.add_argument("--osl", type=float, required=True)
+    p.add_argument("--chips-per-prefill-engine", type=int, default=1)
+    p.add_argument("--chips-per-decode-engine", type=int, default=1)
+    p.add_argument("--max-chip-budget", type=int, default=64)
+    args = p.parse_args(argv)
+    profile = load_pre_swept(args.table)
+    out = size_from_pre_swept(
+        profile, ttft_ms=args.ttft_ms, itl_ms=args.itl_ms,
+        req_per_s=args.req_per_s, isl=args.isl, osl=args.osl,
+        chips_per_prefill_engine=args.chips_per_prefill_engine,
+        chips_per_decode_engine=args.chips_per_decode_engine,
+        max_chip_budget=args.max_chip_budget)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

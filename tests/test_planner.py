@@ -7,6 +7,7 @@ Reference tests: `tests/planner/test_replica_calculation.py`,
 """
 
 import asyncio
+import os
 import math
 
 import pytest
@@ -347,3 +348,56 @@ async def test_profiler_normalizes_per_chip(tmp_path):
         assert four["num_chips"] == 4
     finally:
         await eng.close()
+
+
+def test_pre_swept_sizing_no_engine_boot():
+    """VERDICT r4 #10: the planner sizes p/d pools from a COMMITTED
+    pre-swept table alone — no engine, no live profiling."""
+    import json
+    import subprocess
+    import sys
+
+    from dynamo_tpu.planner.pre_swept import (
+        load_pre_swept,
+        size_from_pre_swept,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    table = os.path.join(repo, "deploy", "pre_swept", "mocker_v0.json")
+    profile = load_pre_swept(table)
+    out = size_from_pre_swept(profile, ttft_ms=500, itl_ms=50,
+                              req_per_s=4.0, isl=1024, osl=256)
+    assert out["prefill_replicas"] >= 1
+    assert out["decode_replicas"] >= 1
+    assert out["total_chips"] == (out["prefill_replicas"]
+                                  + out["decode_replicas"])
+    assert out["expected_ttft_ms"] > 0
+    # heavier load must not shrink the pools
+    heavy = size_from_pre_swept(profile, ttft_ms=500, itl_ms=50,
+                                req_per_s=40.0, isl=1024, osl=256)
+    assert heavy["prefill_replicas"] >= out["prefill_replicas"]
+    assert heavy["decode_replicas"] >= out["decode_replicas"]
+
+    # the CLI path end to end (still no engines)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dynamo_tpu.planner.pre_swept", table,
+         "--ttft-ms", "500", "--itl-ms", "50", "--req-per-s", "4",
+         "--isl", "1024", "--osl", "256"],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    cli = json.loads(proc.stdout)
+    assert cli["prefill_replicas"] == out["prefill_replicas"]
+
+
+def test_pre_swept_rejects_malformed_table(tmp_path):
+    import json
+
+    import pytest as _pytest
+
+    from dynamo_tpu.planner.pre_swept import load_pre_swept
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"prefill": {"isl": [1]}}))
+    with _pytest.raises(ValueError):
+        load_pre_swept(str(bad))

@@ -117,6 +117,46 @@ async def test_stop_token_id():
         await eng.close()
 
 
+async def test_min_tokens_suppresses_early_stop():
+    eng = make_engine()
+    try:
+        # find the greedy continuation, then make its FIRST token a stop
+        # id but demand min_tokens=3: the stop must be suppressed until
+        # the floor is reached (vLLM min_tokens semantics)
+        outs = await run(eng, req(range(1, 9), max_tokens=4))
+        toks = [t for o in outs for t in o.get("token_ids", ())]
+        r = req(range(1, 9), max_tokens=6, stop_ids=[toks[0]])
+        r["stop"]["min_tokens"] = 3
+        outs2 = await run(eng, r)
+        got = [t for o in outs2 for t in o.get("token_ids", ())]
+        assert len(got) >= 3, got
+        # the suppressed stop token was still EMITTED (not dropped)
+        assert got[0] == toks[0], (got, toks)
+    finally:
+        await eng.close()
+
+
+async def test_burst_frames_align_tokens_and_logprobs():
+    """Batched emission: every frame's token_ids/log_probs lists stay
+    aligned, and the finish frame's tokens end exactly at max_tokens."""
+    eng = make_engine(decode_steps_per_sync=4)
+    try:
+        r = req(range(1, 9), max_tokens=10)
+        r["sampling"]["logprobs"] = True
+        outs = await run(eng, r)
+        total = 0
+        for o in outs:
+            ids = o.get("token_ids", [])
+            lps = o.get("log_probs")
+            if lps is not None:
+                assert len(lps) == len(ids), o
+            total += len(ids)
+        assert total == 10
+        assert outs[-1]["finish_reason"] == "length"
+    finally:
+        await eng.close()
+
+
 async def test_cancellation_frees_resources():
     eng = make_engine(default_max_tokens=10_000)
     try:

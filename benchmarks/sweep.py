@@ -93,6 +93,7 @@ async def one_request(session, url: str, model: str, prompt: str,
     n_chunks = 0
     body = {"model": model, "prompt": prompt, "stream": True,
             "max_tokens": osl, "ignore_eos": True}
+    finish = None
     async with session.post(f"{url}/v1/completions", json=body) as resp:
         if resp.status != 200:
             return {"error": resp.status}
@@ -102,6 +103,8 @@ async def one_request(session, url: str, model: str, prompt: str,
                 continue
             now = time.perf_counter()
             chunk = json.loads(line[6:])
+            for c in chunk.get("choices", ()):
+                finish = c.get("finish_reason") or finish
             if first is None:
                 # first data event = first token(s), aiperf semantics —
                 # byte-level tokenizers can hold partial UTF-8 so the
@@ -112,6 +115,11 @@ async def one_request(session, url: str, model: str, prompt: str,
                     deltas.append(now - last)
                 last = now
                 n_chunks += 1
+    if finish not in ("length", "stop", "eos"):
+        # a stream that ended on an error frame (or never finished) is
+        # a FAILED request, even though HTTP said 200 — counting it ok
+        # would inflate output_tok_s exactly when the backend drops
+        return {"error": f"finish_reason={finish}"}
     return {"ttft": (first - t0) if first else None,
             "itls": deltas, "duration": time.perf_counter() - t0,
             "chunks": n_chunks}
@@ -145,6 +153,7 @@ async def run_level(url: str, model: str, concurrency: int,
     prompts = [make_prompt(rng, isl, prefix_ratio, prefix_pool, seed)
                for _ in range(n_requests)]
     results: list[dict] = []
+    offsets: list[float] = []
 
     async with aiohttp.ClientSession() as session:
         t0 = time.perf_counter()
@@ -200,7 +209,13 @@ async def run_level(url: str, model: str, concurrency: int,
         row["error_statuses"] = error_statuses
     if arrival != "closed":
         row["target_qps"] = qps
-        row["offered_qps"] = round(n_requests / max(wall, 1e-9), 2)
+        # offered rate comes from the ARRIVAL span, not the wall (which
+        # stretches to the last completion — at saturation, exactly
+        # where open-loop load matters, completion rate ≠ offered rate)
+        span = offsets[-1] if offsets and offsets[-1] > 0 else None
+        row["offered_qps"] = (round(n_requests / span, 2)
+                              if span else None)
+        row["completed_req_s"] = round(len(ok) / max(wall, 1e-9), 2)
     if prefix_ratio > 0:
         row["prefix_ratio"] = prefix_ratio
         row["prefix_pool"] = prefix_pool

@@ -192,6 +192,63 @@ def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
     return params
 
 
+class _Prefetcher:
+    """Reads tensors ONE thread ahead of the consumer so disk I/O
+    overlaps the previous tensor's device upload + on-chip prep. The
+    consumer must request names in exactly the order given (asserted).
+    Bounded queue: at most `depth` raw tensors buffered on host.
+
+    stop() unblocks the reader even when the consumer abandoned the
+    load mid-way (a device OOM in the prep loop must not leave a
+    thread parked forever on the full queue, pinning shard handles)."""
+
+    def __init__(self, idx: "_TensorIndex", ordered_names: list,
+                 depth: int = 2) -> None:
+        import queue
+        import threading
+
+        self._q: Any = queue.Queue(maxsize=depth)
+        self._queue_mod = queue
+        self._stop = threading.Event()
+
+        def run():
+            try:
+                for name in ordered_names:
+                    item = (name, idx.get(name), None)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:   # surface in the consumer
+                try:
+                    self._q.put((None, None, e), timeout=5)
+                except queue.Full:
+                    pass
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def get(self, name: str) -> np.ndarray:
+        got, arr, err = self._q.get()
+        if err is not None:
+            raise err
+        assert got == name, f"prefetch order broke: {got} != {name}"
+        return arr
+
+    def stop(self) -> None:
+        self._stop.set()
+        # drain one slot so a put-blocked reader can observe the stop
+        try:
+            self._q.get_nowait()
+        except self._queue_mod.Empty:
+            pass
+        self._t.join(timeout=60)
+
+
 def load_llama_params_device(path: str, cfg: LlamaConfig,
                              quantize=False) -> dict:
     """Checkpoint → DEVICE param pytree, transposing/casting/quantizing
@@ -204,8 +261,17 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     once anyway (Llama-3-8B bf16 = 16 GB = a whole v5e). Here each raw
     tensor is uploaded as stored, and transpose + cast (+ int8
     quantization, keeping only the int8 on device) run on the chip;
-    per-layer results are stacked device-side. Peak HBM ≈ final params
-    + one layer's transients."""
+    per-layer results are stacked device-side.
+
+    Load-time shape (VERDICT r4 #6 — the r4 8B load took 108 s):
+    - disk reads run on a PREFETCH thread, overlapping each tensor's
+      read with the previous one's upload/prep;
+    - the per-tensor block_until_ready (a ~95 ms tunnel round-trip
+      × ~300 tensors on an 8B) becomes one sync every _SYNC_EVERY
+      tensors — single-stream TPU execution completes ops in dispatch
+      order, so syncing the newest bounds ALL outstanding transients.
+    Peak HBM ≈ final params + _SYNC_EVERY tensors' transients
+    (~1 GB at 8B scale)."""
     import functools
 
     import jax
@@ -232,12 +298,6 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     def prep(w):                        # cast only
         return w.astype(cfg.dtype)
 
-    def dense(name, transpose=True):
-        t = jax.device_put(idx.get(name))
-        out = prep_t(t) if transpose else prep(t)
-        out.block_until_ready()         # bound transient HBM
-        return out
-
     p = "model.layers.{}."
     names = {
         "wq": p + "self_attn.q_proj.weight",
@@ -250,12 +310,65 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     }
     from dynamo_tpu.engine.quant import QTensor
 
+    # exact read order (the prefetcher replays it; EVERY read goes
+    # through it — the safetensors handles must only be touched by the
+    # reader thread)
+    order = [fmt.format(i) for fmt in names.values() for i in range(L)]
+    for fmt in ("input_layernorm.weight",
+                "post_attention_layernorm.weight"):
+        order += [p.format(i) + fmt for i in range(L)]
+    if cfg.attention_bias:
+        for name in ("q_proj", "k_proj", "v_proj"):
+            order += [p.format(i) + f"self_attn.{name}.bias"
+                      for i in range(L)]
+    order.append("model.embed_tokens.weight")
+    order.append("model.norm.weight")
+    if "lm_head.weight" in idx:
+        order.append("lm_head.weight")
+    pf = _Prefetcher(idx, order)
+
+    _SYNC_EVERY = 8
+    state = {"n": 0, "last": None}
+
+    def throttle(out):
+        """Bound in-flight transients without a sync per tensor."""
+        state["last"] = out
+        state["n"] += 1
+        if state["n"] >= _SYNC_EVERY:
+            out.block_until_ready()
+            state["n"] = 0
+        return out
+
+    def dense(name, transpose=True):
+        t = jax.device_put(pf.get(name))
+        return throttle(prep_t(t) if transpose else prep(t))
+
     q_layer = jax.jit(functools.partial(quant_fn, bits=bits,
                                         act_bits=act_bits),
                       donate_argnums=(0,))
     import logging
 
     _log = logging.getLogger(__name__)
+    try:
+        return _load_device_body(
+            cfg, idx, pf, names, p, dense, throttle, state, q_layer,
+            quantize, quant_fn, bits, act_bits, L, _log)
+    finally:
+        # unblock + join the reader even when the prep loop raised
+        # (device OOM mid-load must not leak a put-blocked thread
+        # pinning shard handles)
+        pf.stop()
+        idx.close()
+
+
+def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
+                      q_layer, quantize, quant_fn, bits, act_bits, L,
+                      _log) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.quant import QUANT_KEYS, QTensor
+
     layers: dict[str, Any] = {}
     for key, fmt in names.items():
         _log.info("loading %s (%d layers)", key, L)
@@ -266,7 +379,7 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
             qs, ss = [], []
             for i in range(L):
                 qt = q_layer(dense(fmt.format(i)))
-                qt.q.block_until_ready()
+                throttle(qt.q)
                 qs.append(qt.q)
                 ss.append(qt.s)
             layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss),
@@ -278,20 +391,20 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     for key, fmt in (("attn_norm", p + "input_layernorm.weight"),
                      ("mlp_norm", p + "post_attention_layernorm.weight")):
         layers[key] = jnp.stack(
-            [jnp.asarray(idx.get(fmt.format(i)), dtype=jnp.float32)
+            [jnp.asarray(pf.get(fmt.format(i)), dtype=jnp.float32)
              for i in range(L)])
     if cfg.attention_bias:
         # Qwen2 family: 1-D q/k/v biases (tiny — host stack is fine)
         for key, name in (("bq", "q_proj"), ("bk", "k_proj"),
                           ("bv", "v_proj")):
             layers[key] = jnp.stack(
-                [jnp.asarray(idx.get(p.format(i) + f"self_attn.{name}"
-                                     f".bias"), dtype=cfg.dtype)
+                [jnp.asarray(pf.get(p.format(i) + f"self_attn.{name}"
+                                    f".bias"), dtype=cfg.dtype)
                  for i in range(L)])
     params: dict[str, Any] = {
         "embed": dense("model.embed_tokens.weight", transpose=False),
         "layers": layers,
-        "final_norm": jnp.asarray(idx.get("model.norm.weight"),
+        "final_norm": jnp.asarray(pf.get("model.norm.weight"),
                                   dtype=jnp.float32),
     }
     _log.info("loading embed/lm_head")
@@ -311,12 +424,20 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
         qt = jax.jit(quant_fn, donate_argnums=(0,))(lm)
         qt.q.block_until_ready()
         params["lm_head"] = qt
+        if state["last"] is lm:
+            # lm was DONATED to the quant jit — the drain below must
+            # never touch the deleted buffer (TPU honors donation;
+            # CPU tests don't, so only a real chip would crash)
+            state["last"] = qt.q
     else:
         # big-vocab lm_head stays bf16: the int8 (E, 128k) matmul sends
         # XLA/Mosaic compile into a tailspin (quant.py
         # LM_HEAD_QUANT_MAX_VOCAB)
         params["lm_head"] = lm
-    idx.close()
+    # drain outstanding dispatches before handing the pytree out (the
+    # throttle only syncs every _SYNC_EVERY tensors)
+    if state["last"] is not None:
+        state["last"].block_until_ready()
     return params
 
 

@@ -195,3 +195,58 @@ def test_device_loader_matches_host_loader(checkpoint):
     assert diff.mean() < 1e-3 and np.abs(dg - hg).max() <= 1, diff.mean()
     np.testing.assert_allclose(np.asarray(dq["lm_head"].s),
                                np.asarray(hq["lm_head"].s), rtol=1e-5)
+
+
+def test_loader_bit_exact_across_fresh_loads(checkpoint):
+    """VERDICT r4 #6: the loader witness — two fresh device loads of
+    the same checkpoint produce IDENTICAL bytes on every leaf (incl.
+    int8 quantized), and two fresh engines built from them emit
+    identical greedy tokens. The prefetch/throttle pipeline must be
+    a pure reordering of work, never of values."""
+    import asyncio
+
+    import jax
+
+    from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
+    from dynamo_tpu.engine.quant import QTensor
+    from dynamo_tpu.models.loader import (
+        config_from_hf,
+        load_llama_params_device,
+    )
+    from dynamo_tpu.runtime.context import Context
+
+    path, _ = checkpoint
+    cfg = config_from_hf(path, page_size=8, max_pages_per_seq=8)
+
+    def leaves(p):
+        return [(k, np.asarray(x.q) if isinstance(x, QTensor) else
+                 np.asarray(x))
+                for k, x in sorted(jax.tree.leaves_with_path(
+                    p, is_leaf=lambda v: isinstance(v, QTensor)),
+                    key=lambda kv: str(kv[0]))]
+
+    a = load_llama_params_device(path, cfg, quantize="int8")
+    b = load_llama_params_device(path, cfg, quantize="int8")
+    la, lb = leaves(a), leaves(b)
+    assert len(la) == len(lb)
+    for (ka, va), (kb, vb) in zip(la, lb):
+        assert str(ka) == str(kb)
+        np.testing.assert_array_equal(va, vb, err_msg=str(ka))
+
+    async def serve(params):
+        eng = TpuEngine(TpuEngineConfig(
+            model=cfg, num_pages=32, max_batch_size=2,
+            prefill_chunk=16, min_prefill_bucket=8,
+            default_max_tokens=8), params=params)
+        try:
+            req = {"token_ids": [1, 2, 3, 4, 5], "model": "m",
+                   "sampling": {"temperature": 0.0},
+                   "stop": {"max_tokens": 8}}
+            return [t async for o in eng.generate(req, Context())
+                    for t in o.get("token_ids", ())]
+        finally:
+            await eng.close()
+
+    ta = asyncio.run(serve(a))
+    tb = asyncio.run(serve(b))
+    assert ta == tb and len(ta) == 8

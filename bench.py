@@ -74,7 +74,13 @@ V5E_HBM_GBPS = 819.0
 # MFU denominator follows the mode's matmul datapath: int8 weight-only
 # (W8A16) still runs bf16 MACs; w8a8 runs the native int8 path.
 V5E_PEAK_TFLOPS = {"bf16": 197.0, "int8": 394.0}
-QUANTIZE = "int8"
+# DYN_BENCH_QUANTIZE=w8a8 re-runs every phase under another quant mode
+# (VERDICT r5 #1: if the quant phase shows w8a8 winning, the whole
+# bench re-runs under it with one env var). Validated here: a typo
+# must fail at startup, not as an engine ValueError inside every
+# phase subprocess after the preflight + ckpt build.
+QUANTIZE = os.environ.get("DYN_BENCH_QUANTIZE", "int8")
+assert QUANTIZE in ("int8", "w8a8", "int4"), QUANTIZE
 
 # short phase (r1/r2 continuity)
 ISL, OSL, N_REQS, BATCH, K_STEPS = 96, 64, 32, 16, 32
@@ -236,9 +242,12 @@ def decode_flops_per_step(cfg, batch, avg_ctx):
 
 def mfu_pct(cfg, batch, avg_ctx, step_s, quantize):
     """Model-FLOPs utilisation vs the chip peak of the mode's matmul
-    datapath (w8a8 → native int8 peak; bf16/int8-weight-only/int4 →
-    bf16 MACs). THE judging metric for single-chip decode perf."""
-    peak = V5E_PEAK_TFLOPS["int8" if quantize == "w8a8" else "bf16"]
+    datapath: w8a8 AND int4 (= W4A8, per-row int8 activations through
+    the same native int8 MXU kernels — engine/int4_mm.py) use the int8
+    peak; bf16 / int8-weight-only run bf16 MACs. THE judging metric
+    for single-chip decode perf."""
+    peak = V5E_PEAK_TFLOPS[
+        "int8" if quantize in ("w8a8", "int4") else "bf16"]
     return 100.0 * decode_flops_per_step(cfg, batch, avg_ctx) \
         / step_s / 1e12 / peak
 

@@ -73,7 +73,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="override model context (max_pages_per_seq)")
     p.add_argument("--quantize", default=None,
                    choices=["int8", "w8a8", "int4"],
-                   help="weight-only quantization for the TPU engine")
+                   help="TPU engine quantization: int8 = weight-only "
+                        "(half the weight bytes, bf16 MACs); w8a8 adds "
+                        "dynamic per-row activation quant on the MXU's "
+                        "native int8 path (2x the bf16 pass rate — the "
+                        "decode-speed lever on pass-bound batches); "
+                        "int4 = packed-nibble W4A8 (a CAPACITY lever: "
+                        "~quarter weight bytes at ~10%% slower steps — "
+                        "decode on this hardware is pass-bound, not "
+                        "HBM-bound)")
     p.add_argument("--draft-model", default=None,
                    help="small checkpoint for speculative decoding")
     p.add_argument("--spec-gamma", type=int, default=4,

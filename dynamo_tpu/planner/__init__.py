@@ -21,6 +21,7 @@ from dynamo_tpu.planner.load_predictor import (
     LOAD_PREDICTORS,
     ConstantPredictor,
     EwmaPredictor,
+    HoltWintersPredictor,
     LinearTrendPredictor,
 )
 from dynamo_tpu.planner.planner_core import (
@@ -33,5 +34,6 @@ __all__ = [
     "Planner", "SlaPlannerConfig", "IntervalMetrics",
     "PrefillInterpolator", "DecodeInterpolator",
     "LOAD_PREDICTORS", "ConstantPredictor", "LinearTrendPredictor",
-    "EwmaPredictor", "TargetReplica", "VirtualConnector",
+    "EwmaPredictor", "HoltWintersPredictor", "TargetReplica",
+    "VirtualConnector",
 ]

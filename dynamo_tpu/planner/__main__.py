@@ -39,7 +39,11 @@ def parse_args(argv=None):
     p.add_argument("--max-chip-budget", type=int, default=8)
     p.add_argument("--min-endpoint", type=int, default=1)
     p.add_argument("--load-predictor", default="constant",
-                   choices=["constant", "linear", "ewma"])
+                   choices=["constant", "linear", "ewma",
+                            "holtwinters"])
+    p.add_argument("--load-predictor-period", type=int, default=12,
+                   help="holtwinters seasonal period, in adjustment "
+                        "intervals (24h cycle at 60s intervals = 1440)")
     p.add_argument("--no-operation", action="store_true",
                    help="observe and log, never write targets")
     return p.parse_args(argv)
@@ -73,7 +77,8 @@ def main(argv=None) -> None:
             chips_per_decode_engine=args.chips_per_decode_engine,
             max_chip_budget=args.max_chip_budget,
             min_endpoint=args.min_endpoint,
-            load_predictor=args.load_predictor)
+            load_predictor=args.load_predictor,
+            load_predictor_period=args.load_predictor_period)
         connector = None if args.no_operation else VirtualConnector(
             rt, args.namespace)
         planner = Planner(

@@ -3,8 +3,11 @@
 Reference: `components/src/dynamo/planner/utils/load_predictor.py` —
 constant, ARIMA (pmdarima) and Prophet predictors behind one interface.
 Those libraries aren't in this image; the linear-trend and EWMA
-predictors cover the same planning role (short-horizon one-step
-forecasts) with closed-form math.
+predictors cover the short-horizon role, and HoltWintersPredictor
+(hand-rolled triple exponential smoothing, additive seasonality)
+covers the SEASONAL role Prophet/ARIMA play — diurnal/sinusoidal
+traffic (the shapes `benchmarks/sweep.py --arrival sin` generates)
+forecast one step ahead with the season carried, not smoothed away.
 """
 
 from __future__ import annotations
@@ -93,8 +96,72 @@ class EwmaPredictor(BasePredictor):
         return est
 
 
+class HoltWintersPredictor(BasePredictor):
+    """Additive Holt-Winters (triple exponential smoothing): level +
+    trend + a `period`-long seasonal component, refit over the window
+    on every predict. The seasonal analog of the reference's
+    Prophet/ARIMA predictors, in ~40 lines of closed-form math —
+    sin/burst-shaped arrival rates (sweep --arrival sin) forecast with
+    the upcoming season's phase instead of lagging it by half a
+    period.
+
+    Falls back to the linear-trend estimate until 2 full periods of
+    data exist (a season can't be estimated from less)."""
+
+    def __init__(self, period: int = 12, alpha: float = 0.4,
+                 beta: float = 0.1, gamma: float = 0.3, **kw) -> None:
+        kw.setdefault("window_size", max(100, 4 * period))
+        super().__init__(**kw)
+        if period < 2:
+            raise ValueError(f"period must be >= 2, got {period}")
+        if self.window_size < 2 * period:
+            # the fallback branch would silently run FOREVER — the
+            # operator must learn at construction, not from flat
+            # forecasts, that the window can't hold a season
+            raise ValueError(
+                f"window_size {self.window_size} < 2*period "
+                f"{2 * period}: a season cannot be estimated")
+        self.period = period
+        self.alpha, self.beta, self.gamma = alpha, beta, gamma
+
+    def add_data_point(self, value: float) -> None:
+        """Seasonal phase = buffer position mod period, so samples must
+        stay evenly spaced in wall-clock intervals. The base class
+        SKIPS NaN samples (idle intervals report NaN isl/osl) — here a
+        gap carries the last sample forward instead, or every forecast
+        after an overnight idle period would be phase-shifted by the
+        gap length."""
+        is_nan = value is None or (isinstance(value, float)
+                                   and math.isnan(value))
+        if is_nan and self.data_buffer:
+            value = self.data_buffer[-1]
+        super().add_data_point(value)
+
+    def predict_next(self) -> float:
+        xs = self.data_buffer
+        m = self.period
+        if len(xs) < 2 * m:
+            return LinearTrendPredictor.predict_next(self)
+        # init from the first two periods (standard HW bootstrap)
+        level = sum(xs[:m]) / m
+        second = sum(xs[m:2 * m]) / m
+        trend = (second - level) / m
+        season = [xs[i] - level for i in range(m)]
+        for t in range(m, len(xs)):
+            s = season[t % m]
+            prev_level = level
+            level = (self.alpha * (xs[t] - s)
+                     + (1 - self.alpha) * (level + trend))
+            trend = (self.beta * (level - prev_level)
+                     + (1 - self.beta) * trend)
+            season[t % m] = (self.gamma * (xs[t] - level)
+                             + (1 - self.gamma) * s)
+        return max(0.0, level + trend + season[len(xs) % m])
+
+
 LOAD_PREDICTORS = {
     "constant": ConstantPredictor,
     "linear": LinearTrendPredictor,
     "ewma": EwmaPredictor,
+    "holtwinters": HoltWintersPredictor,
 }

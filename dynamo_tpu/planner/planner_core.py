@@ -71,6 +71,9 @@ class SlaPlannerConfig:
     min_endpoint: int = 1
     load_predictor: str = "constant"
     load_window: int = 50
+    # seasonal period in adjustment intervals (holtwinters only): e.g.
+    # a 24 h cycle observed every 60 s needs period=1440
+    load_predictor_period: int = 12
     no_correction: bool = False
 
 
@@ -88,9 +91,16 @@ class Planner:
         self.metrics_source = metrics_source
         self.connector = connector
         pred = LOAD_PREDICTORS[config.load_predictor]
-        self.num_req_predictor = pred(window_size=config.load_window)
-        self.isl_predictor = pred(window_size=config.load_window)
-        self.osl_predictor = pred(window_size=config.load_window)
+        pkw: dict = {"window_size": config.load_window}
+        if config.load_predictor == "holtwinters":
+            pkw["period"] = config.load_predictor_period
+            # the window must hold >= 2 seasons or the seasonal branch
+            # never engages (validated again in the predictor)
+            pkw["window_size"] = max(config.load_window,
+                                     2 * config.load_predictor_period)
+        self.num_req_predictor = pred(**pkw)
+        self.isl_predictor = pred(**pkw)
+        self.osl_predictor = pred(**pkw)
         self.p_correction_factor = 1.0
         self.d_correction_factor = 1.0
         self.last_metrics = IntervalMetrics()

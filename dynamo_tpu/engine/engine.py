@@ -288,6 +288,21 @@ class TpuEngine:
         # the caller's objects
         owned_params = params is None
         owned_draft = draft_params is None
+        if getattr(mcfg, "num_experts", 0):
+            # MoE serving: single-device and pp_mesh layouts work (the
+            # MLP dispatch in models/llama.py routes every forward
+            # through moe_mlp). tp/sp meshes need expert-aware specs
+            # and quantize needs qm-routed expert matmuls — reject
+            # loudly rather than shard/quantize garbage.
+            if cfg.mesh is not None or cfg.sp_mesh is not None:
+                raise ValueError(
+                    "MoE models serve single-device or over pp_mesh; "
+                    "tp/sp meshes need expert-aware sharding specs "
+                    "(use moe_forward + ep_param_specs for EP "
+                    "inference, models/mixtral.py)")
+            if cfg.quantize:
+                raise ValueError(
+                    "quantize does not support MoE expert stacks yet")
         def place_owned(p, owned: bool):
             """Host (numpy) checkpoints must land on device ONCE at
             init: a numpy leaf passed to a jitted step re-uploads on

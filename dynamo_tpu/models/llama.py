@@ -91,7 +91,13 @@ class LlamaConfig:
 def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
     """Random-init params. Layer weights are stacked on a leading L axis for
     `lax.scan`. Shapes chosen so the "tp" shardings in engine/sharding.py
-    split heads/ffn evenly."""
+    split heads/ffn evenly. MoE configs dispatch to the expert-stacked
+    layout (mixtral.init_moe_params) — callers (the engine, tests) get
+    the right tree for any family from this one entry point."""
+    if getattr(cfg, "num_experts", 0):
+        from dynamo_tpu.models.mixtral import init_moe_params
+
+        return init_moe_params(rng, cfg)
     E, F = cfg.hidden_size, cfg.intermediate_size
     H, KVH, D, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.num_layers
     k = iter(jax.random.split(rng, 12))
@@ -200,6 +206,20 @@ def _write_kv(k_cache: jax.Array, v_cache: jax.Array, k: jax.Array,
 def _swiglu(h: jax.Array, lp: dict) -> jax.Array:
     gate = jax.nn.silu(qm(h, lp["w_gate"]))
     return qm(gate * qm(h, lp["w_up"]), lp["w_down"])
+
+
+def _mlp(h: jax.Array, lp: dict, cfg: "LlamaConfig") -> jax.Array:
+    """THE per-layer FFN dispatch: dense SwiGLU for Llama/Qwen2
+    families, top-k routed experts for MoE configs (mixtral.moe_mlp).
+    cfg is static under jit, so the branch costs nothing at runtime —
+    and because every forward flavor (paged prefill/decode, dense,
+    pp stages) routes through here, an MoE config serves through the
+    SAME engine/scheduler/spec/guided machinery as a dense model."""
+    if getattr(cfg, "num_experts", 0):
+        from dynamo_tpu.models.mixtral import moe_mlp
+
+        return moe_mlp(h, lp, cfg)
+    return _swiglu(h, lp)
 
 
 # ---------------------------------------------------------------------------
@@ -313,7 +333,7 @@ def paged_forward(params: dict, k_cache: tuple, v_cache: tuple,
         )(q, page_tables, positions, seq_lens)             # (Bp, T, H, D)
         x = x + qm(attn.reshape(Bp, T, -1), lp["wo"])
         hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _swiglu(hn, lp)
+        x = x + _mlp(hn, lp, cfg)
         new_k.append(kc)
         new_v.append(vc)
 
@@ -380,7 +400,7 @@ def _decode_once(params: dict, k_cache: tuple, v_cache: tuple,
             q, kc, vc, lengths, page_tables, page_size=cfg.page_size)
         x = x + qm(attn.reshape(B, -1), lp["wo"])
         hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _swiglu(hn, lp)
+        x = x + _mlp(hn, lp, cfg)
         new_k.append(kc)
         new_v.append(vc)
 
@@ -596,7 +616,7 @@ def embed_batch(params: dict, tokens: jax.Array, lengths: jax.Array,
     for l in range(cfg.num_layers):
         lp = _layer_params(params, l)
         x = dense_attention(x, lp, positions, mask, cfg)
-        x = x + _swiglu(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp, cfg)
     h = rms_norm(x, params["final_norm"], cfg.rms_eps).astype(jnp.float32)
     h = jnp.where(valid[..., None], h, 0.0)
     pooled = h.sum(axis=1) / jnp.maximum(

@@ -37,7 +37,7 @@ from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     _layer_params,
-    _swiglu,
+    _mlp,
     _write_kv,
     dense_attention,
     qkv_proj,
@@ -55,7 +55,8 @@ def _stage_layers(params_local: dict, x: jax.Array, positions: jax.Array,
 
     def one_layer(x, lp):
         x = dense_attention(x, lp, positions, mask, cfg)
-        x = x + _swiglu(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp)
+        x = x + _mlp(rms_norm(x, lp["mlp_norm"], cfg.rms_eps), lp,
+                     cfg)
         return x, None
 
     x, _ = lax.scan(one_layer, x, params_local)
@@ -113,16 +114,26 @@ def _pp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def pp_specs_for(params: dict) -> dict:
-    """pp_param_specs matching THIS param tree (bias rows only when the
-    family has them) — the one probe site, mirroring sharding.specs_for."""
-    return pp_param_specs("bq" in params["layers"])
+    """pp_param_specs matching THIS param tree (bias/MoE rows only when
+    the family has them) — the one probe site, mirroring
+    sharding.specs_for."""
+    return pp_param_specs("bq" in params["layers"],
+                          moe="router" in params["layers"])
 
 
-def pp_param_specs(with_bias: bool = False) -> dict:
+def pp_param_specs(with_bias: bool = False, moe: bool = False) -> dict:
     """Layer stacks sharded over "pp" (stage slices); the rest replicated.
-    `with_bias` (Qwen2 family) adds the bq/bk/bv stacks."""
+    `with_bias` (Qwen2 family) adds the bq/bk/bv stacks; `moe`
+    (Mixtral family) swaps the dense FFN rows for the router + the
+    (L, X, ...) expert stacks — each stage then holds its layer
+    slice's EXPERTS too, which is the pp×moe layout."""
     rows = [("attn_norm", 1), ("wq", 2), ("wk", 2), ("wv", 2), ("wo", 2),
-            ("mlp_norm", 1), ("w_gate", 2), ("w_up", 2), ("w_down", 2)]
+            ("mlp_norm", 1)]
+    if moe:
+        rows += [("router", 2), ("w_gate", 3), ("w_up", 3),
+                 ("w_down", 3)]
+    else:
+        rows += [("w_gate", 2), ("w_up", 2), ("w_down", 2)]
     if with_bias:
         rows += [("bq", 1), ("bk", 1), ("bv", 1)]
     layer = {k: P("pp", *([None] * n)) for k, n in rows}
@@ -233,7 +244,7 @@ def _pp_prefill_paged_local(params, kc_all, vc_all, tokens_c,
             )(q, page_tables, positions, seq_lens)          # (B, Tc, H, D)
             x = x + qm(attn.reshape(B, Tc, -1), lp["wo"])
             hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-            x = x + _swiglu(hn, lp)
+            x = x + _mlp(hn, lp, cfg)
             new_k.append(kc)
             new_v.append(vc)
         kc_all = jnp.stack(new_k)
@@ -397,7 +408,7 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
                 q, kc, vc, lengths, tbl_m, page_size=cfg.page_size)
             x = x + qm(attn.reshape(Bm, -1), lp["wo"])
             hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-            x = x + _swiglu(hn, lp)
+            x = x + _mlp(hn, lp, cfg)
             new_k.append(kc)
             new_v.append(vc)
         kc_all = jnp.stack(new_k)

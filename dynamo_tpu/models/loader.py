@@ -65,11 +65,12 @@ def resolve_model(name_or_path: str) -> str:
 
 
 def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
-    """LlamaConfig from a checkpoint dir's config.json."""
+    """LlamaConfig (or MoeConfig for Mixtral-family checkpoints) from a
+    checkpoint dir's config.json."""
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-    known = ("llama", "mistral", "qwen2")
+    known = ("llama", "mistral", "mixtral", "qwen2")
     if not any(f in arch.lower() for f in known):
         logger.warning("loading %s with the llama-family loader", arch)
     hidden = hf["hidden_size"]
@@ -90,8 +91,20 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
         attention_bias=bool(hf.get("attention_bias",
                                    "qwen2" in arch.lower())),
     )
+    cls = LlamaConfig
+    if "mixtral" in arch.lower() or hf.get("num_local_experts"):
+        from dynamo_tpu.models.mixtral import MoeConfig
+
+        n_exp = hf.get("num_local_experts")
+        if not n_exp:
+            raise ValueError(
+                f"{arch} checkpoint at {path} has no num_local_experts "
+                f"in config.json — cannot size the expert stacks")
+        cls = MoeConfig
+        cfg["num_experts"] = int(n_exp)
+        cfg["experts_per_token"] = int(hf.get("num_experts_per_tok", 2))
     cfg.update(overrides)
-    return LlamaConfig(**cfg)
+    return cls(**cfg)
 
 
 class _TensorIndex:
@@ -162,19 +175,38 @@ def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
                          for i in range(L)])
 
     p = "model.layers.{}."
+    moe = bool(getattr(cfg, "num_experts", 0))
+    layers = {
+        "attn_norm": stack_norm(p + "input_layernorm.weight"),
+        "wq": stack(p + "self_attn.q_proj.weight"),
+        "wk": stack(p + "self_attn.k_proj.weight"),
+        "wv": stack(p + "self_attn.v_proj.weight"),
+        "wo": stack(p + "self_attn.o_proj.weight"),
+        "mlp_norm": stack_norm(p + "post_attention_layernorm.weight"),
+    }
+    if moe:
+        # Mixtral layout: block_sparse_moe.gate (router) + per-expert
+        # w1 (gate) / w3 (up) / w2 (down), stacked to the (L, X, ...)
+        # expert stacks mixtral.init_moe_params defines
+        X = cfg.num_experts
+        bs = p + "block_sparse_moe."
+
+        def stack_experts(w_fmt: str) -> np.ndarray:
+            return np.stack([
+                np.stack([dense(bs.format(i) + w_fmt.format(e))
+                          for e in range(X)]) for i in range(L)])
+
+        layers["router"] = stack(bs + "gate.weight")
+        layers["w_gate"] = stack_experts("experts.{}.w1.weight")
+        layers["w_up"] = stack_experts("experts.{}.w3.weight")
+        layers["w_down"] = stack_experts("experts.{}.w2.weight")
+    else:
+        layers["w_gate"] = stack(p + "mlp.gate_proj.weight")
+        layers["w_up"] = stack(p + "mlp.up_proj.weight")
+        layers["w_down"] = stack(p + "mlp.down_proj.weight")
     params = {
         "embed": dense("model.embed_tokens.weight", transpose=False),
-        "layers": {
-            "attn_norm": stack_norm(p + "input_layernorm.weight"),
-            "wq": stack(p + "self_attn.q_proj.weight"),
-            "wk": stack(p + "self_attn.k_proj.weight"),
-            "wv": stack(p + "self_attn.v_proj.weight"),
-            "wo": stack(p + "self_attn.o_proj.weight"),
-            "mlp_norm": stack_norm(p + "post_attention_layernorm.weight"),
-            "w_gate": stack(p + "mlp.gate_proj.weight"),
-            "w_up": stack(p + "mlp.up_proj.weight"),
-            "w_down": stack(p + "mlp.down_proj.weight"),
-        },
+        "layers": layers,
         "final_norm": idx.get("model.norm.weight").astype(np.float32),
     }
     if cfg.attention_bias:
@@ -287,6 +319,11 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     bits = _bits_of(quantize)      # falsy | "int8" | "w8a8" | "int4"
     act_bits = _act_bits_of(quantize)
 
+    moe = bool(getattr(cfg, "num_experts", 0))
+    if moe and quantize:
+        raise ValueError(
+            "quantize does not support MoE expert stacks yet")
+
     idx = _TensorIndex(path)
     L = cfg.num_layers
 
@@ -304,16 +341,32 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
         "wk": p + "self_attn.k_proj.weight",
         "wv": p + "self_attn.v_proj.weight",
         "wo": p + "self_attn.o_proj.weight",
-        "w_gate": p + "mlp.gate_proj.weight",
-        "w_up": p + "mlp.up_proj.weight",
-        "w_down": p + "mlp.down_proj.weight",
     }
+    if not moe:
+        names.update({
+            "w_gate": p + "mlp.gate_proj.weight",
+            "w_up": p + "mlp.up_proj.weight",
+            "w_down": p + "mlp.down_proj.weight",
+        })
+    # Mixtral FFN: router + per-expert tensors, streamed one tensor at
+    # a time like everything else (a host-side expert-stack build of an
+    # 8x7B would need ~2x checkpoint RAM and tens of minutes of strided
+    # transposes — exactly what this function exists to avoid)
+    MOE_FFN = (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2"))
+    bs = p + "block_sparse_moe."
+
     from dynamo_tpu.engine.quant import QTensor
 
     # exact read order (the prefetcher replays it; EVERY read goes
     # through it — the safetensors handles must only be touched by the
     # reader thread)
     order = [fmt.format(i) for fmt in names.values() for i in range(L)]
+    if moe:
+        order += [bs.format(i) + "gate.weight" for i in range(L)]
+        for _, w in MOE_FFN:
+            order += [bs.format(i) + f"experts.{e}.{w}.weight"
+                      for i in range(L)
+                      for e in range(cfg.num_experts)]
     for fmt in ("input_layernorm.weight",
                 "post_attention_layernorm.weight"):
         order += [p.format(i) + fmt for i in range(L)]
@@ -388,6 +441,18 @@ def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
         else:
             layers[key] = jnp.stack(
                 [dense(fmt.format(i)) for i in range(L)])
+    if getattr(cfg, "num_experts", 0):
+        X = cfg.num_experts
+        bs = p + "block_sparse_moe."
+        _log.info("loading MoE router + %d experts x %d layers", X, L)
+        layers["router"] = jnp.stack(
+            [dense(bs.format(i) + "gate.weight") for i in range(L)])
+        for key, w in (("w_gate", "w1"), ("w_up", "w3"),
+                       ("w_down", "w2")):
+            layers[key] = jnp.stack([
+                jnp.stack([dense(bs.format(i)
+                                 + f"experts.{e}.{w}.weight")
+                           for e in range(X)]) for i in range(L)])
     for key, fmt in (("attn_norm", p + "input_layernorm.weight"),
                      ("mlp_norm", p + "post_attention_layernorm.weight")):
         layers[key] = jnp.stack(

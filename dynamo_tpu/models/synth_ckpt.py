@@ -33,7 +33,13 @@ PRESETS = {
     # biases (arch "Qwen2ForCausalLM" → loader sets attention_bias)
     "qwen2-tiny": (64, 128, 2, 4, 2, 300),
     "qwen2-1b": (2048, 8192, 16, 16, 8, 32000),
+    # Mixtral family: block_sparse_moe router + per-expert w1/w2/w3
+    # (arch "MixtralForCausalLM" → loader returns MoeConfig)
+    "mixtral-tiny": (64, 96, 2, 4, 2, 300),
 }
+
+# MoE presets: name -> (num_local_experts, num_experts_per_tok)
+MOE_PRESETS = {"mixtral-tiny": (4, 2)}
 
 _POOL_ELEMS = 1 << 24        # 16M bf16 = 32 MB shared noise pool
 
@@ -81,11 +87,17 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
     hidden, inter, layers, heads, kv_heads, vocab = PRESETS[preset]
     head_dim = hidden // heads
     qwen = preset.startswith("qwen2")
+    moe = MOE_PRESETS.get(preset)
     os.makedirs(path, exist_ok=True)
+    if moe:
+        arch, model_type = "MixtralForCausalLM", "mixtral"
+    elif qwen:
+        arch, model_type = "Qwen2ForCausalLM", "qwen2"
+    else:
+        arch, model_type = "LlamaForCausalLM", "llama"
     cfg = {
-        "architectures": ["Qwen2ForCausalLM" if qwen
-                          else "LlamaForCausalLM"],
-        "model_type": "qwen2" if qwen else "llama",
+        "architectures": [arch],
+        "model_type": model_type,
         "hidden_size": hidden, "intermediate_size": inter,
         "num_hidden_layers": layers, "num_attention_heads": heads,
         "num_key_value_heads": kv_heads, "head_dim": head_dim,
@@ -94,6 +106,8 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
         "bos_token_id": 1, "eos_token_id": 2,
         "tie_word_embeddings": False, "dtype": "bfloat16",
     }
+    if moe:
+        cfg["num_local_experts"], cfg["num_experts_per_tok"] = moe
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(cfg, f, indent=1)
 
@@ -121,9 +135,19 @@ def write_synthetic_hf_checkpoint(path: str, preset: str = "llama3-8b",
             yield p + "self_attn.o_proj.weight", \
                 (hidden, heads * head_dim)
             yield p + "post_attention_layernorm.weight", (hidden,)
-            yield p + "mlp.gate_proj.weight", (inter, hidden)
-            yield p + "mlp.up_proj.weight", (inter, hidden)
-            yield p + "mlp.down_proj.weight", (hidden, inter)
+            if moe:
+                n_exp = moe[0]
+                yield p + "block_sparse_moe.gate.weight", \
+                    (n_exp, hidden)
+                for e in range(n_exp):
+                    ep = p + f"block_sparse_moe.experts.{e}."
+                    yield ep + "w1.weight", (inter, hidden)
+                    yield ep + "w3.weight", (inter, hidden)
+                    yield ep + "w2.weight", (hidden, inter)
+            else:
+                yield p + "mlp.gate_proj.weight", (inter, hidden)
+                yield p + "mlp.up_proj.weight", (inter, hidden)
+                yield p + "mlp.down_proj.weight", (hidden, inter)
         yield "model.norm.weight", (hidden,)
         yield "lm_head.weight", (vocab, hidden)
 

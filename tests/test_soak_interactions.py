@@ -63,11 +63,16 @@ def requests():
             {"token_ids": [9, 8, 7], "model": "m",
              "sampling": {"temperature": 0.8, "seed": 21 + rep},
              "stop": {"max_tokens": 8}},
-            # nucleus + min_p (rides spec since r5)
+            # pure nucleus (plain top_p filtering on the spec path)
             {"token_ids": [11, 12], "model": "m",
              "sampling": {"temperature": 0.9, "top_p": 0.5,
-                          "min_p": 0.05, "seed": 5},
+                          "seed": 5},
              "stop": {"max_tokens": 8}},
+            # min_p lane (rides spec since r5)
+            {"token_ids": [14, 15], "model": "m",
+             "sampling": {"temperature": 0.9, "min_p": 0.1,
+                          "seed": 6},
+             "stop": {"max_tokens": 6}},
             # guided choice (constrained burst)
             {"token_ids": [20, 21], "model": "m",
              "sampling": {"temperature": 0.0,

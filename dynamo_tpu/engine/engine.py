@@ -289,20 +289,42 @@ class TpuEngine:
         owned_params = params is None
         owned_draft = draft_params is None
         if getattr(mcfg, "num_experts", 0):
-            # MoE serving: single-device and pp_mesh layouts work (the
-            # MLP dispatch in models/llama.py routes every forward
-            # through moe_mlp). tp/sp meshes need expert-aware specs
-            # and quantize needs qm-routed expert matmuls — reject
-            # loudly rather than shard/quantize garbage.
-            if cfg.mesh is not None or cfg.sp_mesh is not None:
+            # MoE serving layouts: single-device, pp_mesh (stage slices
+            # carry their experts), or an EXPERT-PARALLEL mesh — any
+            # mesh whose axes avoid "tp" (sharding.param_specs' moe
+            # branch shards the expert stacks over "ep"; attention and
+            # the KV cache replicate, GSPMD psums the expert combine).
+            # tp/sp meshes and quantize are rejected loudly: they'd
+            # need head-sharded attention specs composed with expert
+            # sharding / qm-routed expert matmuls.
+            if cfg.sp_mesh is not None or (
+                    cfg.mesh is not None
+                    and "tp" in cfg.mesh.axis_names):
                 raise ValueError(
-                    "MoE models serve single-device or over pp_mesh; "
-                    "tp/sp meshes need expert-aware sharding specs "
-                    "(use moe_forward + ep_param_specs for EP "
-                    "inference, models/mixtral.py)")
+                    "MoE models serve single-device, over pp_mesh, or "
+                    "over an ('ep',) mesh; tp/sp meshes need "
+                    "expert-aware attention specs (future work)")
+            if cfg.mesh is not None \
+                    and tuple(cfg.mesh.axis_names) != ("ep",):
+                raise ValueError(
+                    "an MoE serving mesh must be exactly ('ep',) — "
+                    "experts shard over it; other axes would silently "
+                    "replicate the whole model")
             if cfg.quantize:
                 raise ValueError(
                     "quantize does not support MoE expert stacks yet")
+            if cfg.mesh is not None and cfg.draft_model is not None:
+                raise ValueError(
+                    "speculative decoding on an ep mesh needs the "
+                    "draft placed with family-matched specs (future "
+                    "work); drop draft_model or the mesh")
+        elif cfg.mesh is not None and "tp" not in cfg.mesh.axis_names:
+            # a dense model on an ('ep',)-style mesh would crash deep in
+            # param placement with an opaque 'mesh has no axis tp' —
+            # reject at the boundary where the cause is stateable
+            raise ValueError(
+                "dense-family mesh serving shards over 'tp'; an "
+                "('ep',) mesh is for MoE models")
         def place_owned(p, owned: bool):
             """Host (numpy) checkpoints must land on device ONCE at
             init: a numpy leaf passed to a jitted step re-uploads on
@@ -380,7 +402,8 @@ class TpuEngine:
                 params = jax.jit(
                     lambda key: init_params(key, mcfg),
                     out_shardings=param_sharding(
-                        cfg.mesh, mcfg.attention_bias),
+                        cfg.mesh, mcfg.attention_bias,
+                        moe=bool(getattr(mcfg, "num_experts", 0))),
                 )(jax.random.PRNGKey(cfg.rng_seed))
                 self.params = params
             else:

@@ -36,10 +36,38 @@ def make_mesh(dp: int = 1, tp: int = 1,
     return Mesh(arr, axis_names=("dp", "tp"))
 
 
-def param_specs(attention_bias: bool = False) -> dict:
+def param_specs(attention_bias: bool = False,
+                moe: bool = False) -> dict:
     """PartitionSpecs matching init_params' pytree structure.
     `attention_bias` (Qwen2 family) adds bq/bk/bv rows — biases shard
-    like their weight's OUTPUT dim (megatron column-parallel)."""
+    like their weight's OUTPUT dim (megatron column-parallel).
+
+    `moe` (Mixtral family) returns the EXPERT-PARALLEL serving layout
+    instead: attention/router/embeddings replicated, the (L, X, ...)
+    expert stacks sharded over "ep" on the expert axis. moe_mlp's
+    dense-dispatch einsums contract over X, so GSPMD computes each
+    chip's experts locally and inserts ONE psum for the weighted
+    combine — the serving analog of ep_param_specs (mixtral.py),
+    reusable under the engine's ordinary jit (no shard_map)."""
+    if moe:
+        layers = {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, None),
+            "wk": P(None, None, None),
+            "wv": P(None, None, None),
+            "wo": P(None, None, None),
+            "mlp_norm": P(None, None),
+            "router": P(None, None, None),
+            "w_gate": P(None, "ep", None, None),
+            "w_up": P(None, "ep", None, None),
+            "w_down": P(None, "ep", None, None),
+        }
+        return {
+            "embed": P(None, None),
+            "layers": layers,
+            "final_norm": P(None),
+            "lm_head": P(None, None),
+        }
     layers = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
@@ -64,27 +92,34 @@ def param_specs(attention_bias: bool = False) -> dict:
 
 def specs_for(params: dict) -> dict:
     """param_specs pruned/extended to match THIS param tree's layer
-    keys (the bias rows exist only for attention_bias configs; a
-    tree.map over mismatched dicts raises)."""
-    specs = param_specs(attention_bias="bq" in params["layers"])
+    keys (the bias rows exist only for attention_bias configs, the
+    router/expert rows only for MoE; a tree.map over mismatched dicts
+    raises)."""
+    specs = param_specs(attention_bias="bq" in params["layers"],
+                        moe="router" in params["layers"])
     specs["layers"] = {k: specs["layers"][k] for k in params["layers"]}
     return specs
 
 
-def cache_spec() -> P:
-    # per-layer (KVH, N, P, D): kv heads over tp
+def cache_spec(mesh: Optional[Mesh] = None) -> P:
+    # per-layer (KVH, N, P, D): kv heads over tp; fully replicated on
+    # meshes without a "tp" axis (the ep serving mesh — every chip
+    # runs full attention, only the expert FFN splits)
+    if mesh is not None and "tp" not in mesh.axis_names:
+        return P(None, None, None, None)
     return P("tp", None, None, None)
 
 
-def param_sharding(mesh: Mesh, attention_bias: bool = False) -> dict:
+def param_sharding(mesh: Mesh, attention_bias: bool = False,
+                   moe: bool = False) -> dict:
     """NamedSharding tree matching init_params' structure."""
     return jax.tree.map(lambda s: NamedSharding(mesh, s),
-                        param_specs(attention_bias),
+                        param_specs(attention_bias, moe=moe),
                         is_leaf=lambda x: isinstance(x, P))
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, cache_spec())
+    return NamedSharding(mesh, cache_spec(mesh))
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
@@ -109,5 +144,5 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 
 
 def shard_cache(cache, mesh: Mesh):
-    ns = NamedSharding(mesh, cache_spec())
+    ns = NamedSharding(mesh, cache_spec(mesh))
     return jax.tree.map(lambda x: jax.device_put(x, ns), cache)

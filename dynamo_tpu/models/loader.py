@@ -41,6 +41,14 @@ from dynamo_tpu.models.llama import LlamaConfig
 logger = logging.getLogger(__name__)
 
 
+# Mixtral FFN key mapping: ours -> HF block_sparse_moe expert tensor.
+# ONE definition: the host loader's expert stacking, the device
+# loader's prefetch ORDER, and the device body's consumption all read
+# this — the prefetcher contract (reads replay the order exactly)
+# breaks if any copy drifts.
+MOE_FFN = (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2"))
+
+
 def resolve_model(name_or_path: str) -> str:
     """Local dir, or an HF-cache snapshot for `org/name` (hub.rs:~).
 
@@ -197,9 +205,9 @@ def load_llama_params(path: str, cfg: LlamaConfig) -> dict:
                           for e in range(X)]) for i in range(L)])
 
         layers["router"] = stack(bs + "gate.weight")
-        layers["w_gate"] = stack_experts("experts.{}.w1.weight")
-        layers["w_up"] = stack_experts("experts.{}.w3.weight")
-        layers["w_down"] = stack_experts("experts.{}.w2.weight")
+        for key, w in MOE_FFN:
+            layers[key] = stack_experts(
+                "experts.{}." + w + ".weight")
     else:
         layers["w_gate"] = stack(p + "mlp.gate_proj.weight")
         layers["w_up"] = stack(p + "mlp.up_proj.weight")
@@ -352,7 +360,6 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     # a time like everything else (a host-side expert-stack build of an
     # 8x7B would need ~2x checkpoint RAM and tens of minutes of strided
     # transposes — exactly what this function exists to avoid)
-    MOE_FFN = (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2"))
     bs = p + "block_sparse_moe."
 
     from dynamo_tpu.engine.quant import QTensor
@@ -447,8 +454,7 @@ def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
         _log.info("loading MoE router + %d experts x %d layers", X, L)
         layers["router"] = jnp.stack(
             [dense(bs.format(i) + "gate.weight") for i in range(L)])
-        for key, w in (("w_gate", "w1"), ("w_up", "w3"),
-                       ("w_down", "w2")):
+        for key, w in MOE_FFN:
             layers[key] = jnp.stack([
                 jnp.stack([dense(bs.format(i)
                                  + f"experts.{e}.{w}.weight")

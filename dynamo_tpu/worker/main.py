@@ -110,6 +110,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                     help="host:port of node 0's jax coordinator")
     mn.add_argument("--tensor-parallel-size", type=int, default=1,
                     help="tp over the (possibly multi-host) device mesh")
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="MoE expert parallelism: an ('ep',) mesh over "
+                        "this many local devices — expert stacks shard, "
+                        "attention/KV replicate, GSPMD psums the "
+                        "combine (Mixtral-family models only)")
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="GPipe stage count over local devices: layer "
                         "stack + paged KV shard into stage slices "
@@ -174,6 +179,21 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
     mesh = None
     if args.num_nodes > 1 or args.tensor_parallel_size > 1:
         mesh = _multinode_mesh(args)
+    if args.expert_parallel_size > 1:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if mesh is not None:
+            raise SystemExit(
+                "--expert-parallel-size does not compose with tp/"
+                "multinode meshes (MoE attention specs are replicated)")
+        devices = jax.devices()
+        ep = args.expert_parallel_size
+        if len(devices) < ep:
+            raise SystemExit(
+                f"ep={ep} needs {ep} devices; found {len(devices)}")
+        mesh = Mesh(np.asarray(devices[:ep]), axis_names=("ep",))
     overrides = {}
     if args.context_length is not None:
         overrides["max_pages_per_seq"] = max(1, args.context_length // 16)

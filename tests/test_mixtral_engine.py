@@ -185,3 +185,64 @@ async def test_moe_engine_from_synth_preset(tmp_path):
         assert len(toks) == 6
     finally:
         await eng.close()
+
+
+async def test_moe_engine_ep_mesh_matches_single_device(
+        cpu_mesh_devices):
+    """EXPERT-PARALLEL serving: a 4-chip ('ep',) mesh engine (experts
+    sharded, attention/cache replicated, GSPMD psums the combine) must
+    emit the same greedy tokens as the single-device engine."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.models.llama import init_params
+
+    cfg = MoeConfig.tiny(dtype=jnp.float32, max_pages_per_seq=32)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    prompts = [[(i * 11 + j) % 250 + 1 for j in range(7 + 3 * i)]
+               for i in range(2)]
+
+    async def run(mesh):
+        eng = TpuEngine(TpuEngineConfig(
+            model=cfg, num_pages=64, max_batch_size=2,
+            decode_steps_per_sync=4, mesh=mesh), params=params)
+        try:
+            outs = []
+            for p in prompts:
+                req = {"token_ids": p, "model": "m",
+                       "sampling": {"temperature": 0.0},
+                       "stop": {"max_tokens": 6}}
+                outs.append([t async for o in eng.generate(
+                    req, Context()) for t in o.get("token_ids", [])])
+            return outs
+        finally:
+            await eng.close()
+
+    base = await run(None)
+    ep_mesh = Mesh(np.asarray(cpu_mesh_devices[:4]), axis_names=("ep",))
+    got = await run(ep_mesh)
+    assert got == base, (got, base)
+
+
+def test_moe_engine_rejects_tp_mesh(cpu_mesh_devices):
+    from jax.sharding import Mesh
+
+    cfg = MoeConfig.tiny()
+    tp_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]).reshape(1, 2),
+                   axis_names=("dp", "tp"))
+    with pytest.raises(ValueError, match="tp"):
+        TpuEngine(TpuEngineConfig(model=cfg, num_pages=16,
+                                  max_batch_size=2, mesh=tp_mesh))
+
+
+def test_dense_model_rejects_ep_mesh(cpu_mesh_devices):
+    """A dense model on an ('ep',) mesh must fail at the boundary with
+    a stateable cause, not deep in param placement."""
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    ep_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]), axis_names=("ep",))
+    with pytest.raises(ValueError, match="MoE"):
+        TpuEngine(TpuEngineConfig(model=LlamaConfig.tiny(), num_pages=16,
+                                  max_batch_size=2, mesh=ep_mesh))

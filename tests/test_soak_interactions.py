@@ -73,6 +73,11 @@ def requests():
              "sampling": {"temperature": 0.9, "min_p": 0.1,
                           "seed": 6},
              "stop": {"max_tokens": 6}},
+            # top_p AND min_p composed (both filters on one lane)
+            {"token_ids": [16, 17], "model": "m",
+             "sampling": {"temperature": 0.9, "top_p": 0.6,
+                          "min_p": 0.05, "seed": 7},
+             "stop": {"max_tokens": 6}},
             # guided choice (constrained burst)
             {"token_ids": [20, 21], "model": "m",
              "sampling": {"temperature": 0.0,

@@ -310,9 +310,11 @@ class TpuEngine:
                     "an MoE serving mesh must be exactly ('ep',) — "
                     "experts shard over it; other axes would silently "
                     "replicate the whole model")
-            if cfg.quantize:
+            if cfg.quantize and cfg.quantize != "int8":
                 raise ValueError(
-                    "quantize does not support MoE expert stacks yet")
+                    "MoE expert stacks support weight-only int8 "
+                    "(mixtral._qe); w8a8/int4 expert kernels don't "
+                    "exist yet")
             if cfg.mesh is not None and cfg.draft_model is not None:
                 raise ValueError(
                     "speculative decoding on an ep mesh needs the "

@@ -328,9 +328,10 @@ def load_llama_params_device(path: str, cfg: LlamaConfig,
     act_bits = _act_bits_of(quantize)
 
     moe = bool(getattr(cfg, "num_experts", 0))
-    if moe and quantize:
+    if moe and quantize and quantize != "int8":
         raise ValueError(
-            "quantize does not support MoE expert stacks yet")
+            "MoE expert stacks support weight-only int8 only "
+            "(w8a8/int4 expert kernels don't exist yet)")
 
     idx = _TensorIndex(path)
     L = cfg.num_layers
@@ -429,22 +430,30 @@ def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
 
     from dynamo_tpu.engine.quant import QUANT_KEYS, QTensor
 
+    def q_stack(name_tree):
+        """Quantize each named tensor then stack following the nesting
+        (a list of names → one stack axis; nested lists → nested
+        axes) — THE quantize-before-stack recipe shared by the dense
+        (L,) and expert (L, X) paths, so transients stay int8
+        (stacking 32 bf16 layers first would spike peak HBM past a
+        16 GB chip near the end of an 8B load)."""
+        def rec(node):
+            if isinstance(node, str):
+                qt = q_layer(dense(node))
+                throttle(qt.q)
+                return qt.q, qt.s
+            pairs = [rec(child) for child in node]
+            return (jnp.stack([a for a, _ in pairs]),
+                    jnp.stack([b for _, b in pairs]))
+
+        q, s = rec(name_tree)
+        return QTensor(q=q, s=s, bits=bits, act_bits=act_bits)
+
     layers: dict[str, Any] = {}
     for key, fmt in names.items():
         _log.info("loading %s (%d layers)", key, L)
         if quantize and key in QUANT_KEYS:
-            # quantize per LAYER before stacking: transients stay int8
-            # (stacking 32 bf16 layers first would spike peak HBM past
-            # a 16 GB chip near the end of an 8B load)
-            qs, ss = [], []
-            for i in range(L):
-                qt = q_layer(dense(fmt.format(i)))
-                throttle(qt.q)
-                qs.append(qt.q)
-                ss.append(qt.s)
-            layers[key] = QTensor(q=jnp.stack(qs), s=jnp.stack(ss),
-                                  bits=bits, act_bits=act_bits)
-            del qs, ss
+            layers[key] = q_stack([fmt.format(i) for i in range(L)])
         else:
             layers[key] = jnp.stack(
                 [dense(fmt.format(i)) for i in range(L)])
@@ -455,10 +464,17 @@ def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
         layers["router"] = jnp.stack(
             [dense(bs.format(i) + "gate.weight") for i in range(L)])
         for key, w in MOE_FFN:
-            layers[key] = jnp.stack([
-                jnp.stack([dense(bs.format(i)
-                                 + f"experts.{e}.{w}.weight")
-                           for e in range(X)]) for i in range(L)])
+            if quantize:
+                # per-(layer,expert) scales == quantizing the full
+                # stack: the reduction is over the contraction dim only
+                layers[key] = q_stack(
+                    [[bs.format(i) + f"experts.{e}.{w}.weight"
+                      for e in range(X)] for i in range(L)])
+            else:
+                layers[key] = jnp.stack([
+                    jnp.stack([dense(bs.format(i)
+                                     + f"experts.{e}.{w}.weight")
+                               for e in range(X)]) for i in range(L)])
     for key, fmt in (("attn_norm", p + "input_layernorm.weight"),
                      ("mlp_norm", p + "post_attention_layernorm.weight")):
         layers[key] = jnp.stack(

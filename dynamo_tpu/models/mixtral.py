@@ -99,6 +99,28 @@ def init_moe_params(rng: jax.Array, cfg: MoeConfig) -> dict:
     }
 
 
+def _qe(subscripts: str, x: jax.Array, w) -> jax.Array:
+    """Einsum against a maybe-quantized expert stack — qm's analog for
+    the (X, in, out) expert weights. W8A16 only (the int8 convert fuses
+    into the operand read, per-channel scale multiplies the output);
+    w8a8/int4 expert kernels don't exist yet. The bits check lives
+    HERE (not just the engine's cfg.quantize guard) because
+    pre-quantized param trees reach this code without passing through
+    that guard — and einsumming nibble-packed int4 bytes as int8
+    weights would produce silently garbage logits."""
+    from dynamo_tpu.engine.quant import QTensor
+
+    if isinstance(w, QTensor):
+        if w.bits != 8:
+            raise ValueError(
+                f"int{w.bits} expert stacks unsupported (W8A16 only)")
+        y = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
+        # s: (X, 1, out) per-channel over the contraction dim → (X, out)
+        # broadcasts over the (..., T, X, out) einsum output
+        return y * w.s[:, 0, :].astype(x.dtype)
+    return jnp.einsum(subscripts, x, w)
+
+
 def moe_mlp(h: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     """Top-k routed expert FFN. h: (..., T, E) → (..., T, E).
 
@@ -106,7 +128,9 @@ def moe_mlp(h: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     weight mask zeroes the rest. The expert axis ('x' below) is the EP
     sharding axis — under a mesh with the expert dims of w_gate/up/down
     sharded over "ep", GSPMD computes each chip's experts locally and
-    psums the weighted combine."""
+    psums the weighted combine. Expert stacks may be int8 QTensors
+    (weight-only; engine quantize="int8") — with ep=8 that puts
+    Mixtral-8x7B experts at ~5.9 GB/chip, inside a v5e."""
     router_logits = (h @ lp["router"]).astype(jnp.float32)  # (..., T, X)
     k = cfg.experts_per_token
     topv, topi = jax.lax.top_k(router_logits, k)            # (..., T, k)
@@ -115,9 +139,9 @@ def moe_mlp(h: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     dense_w = jnp.sum(
         jax.nn.one_hot(topi, cfg.num_experts, dtype=jnp.float32)
         * gates[..., None], axis=-2)                        # (..., T, X)
-    gate = jax.nn.silu(jnp.einsum("...te,xef->...txf", h, lp["w_gate"]))
-    up = jnp.einsum("...te,xef->...txf", h, lp["w_up"])
-    down = jnp.einsum("...txf,xfe->...txe", gate * up, lp["w_down"])
+    gate = jax.nn.silu(_qe("...te,xef->...txf", h, lp["w_gate"]))
+    up = _qe("...te,xef->...txf", h, lp["w_up"])
+    down = _qe("...txf,xfe->...txe", gate * up, lp["w_down"])
     out = jnp.einsum("...txe,...tx->...te", down,
                      dense_w.astype(down.dtype))
     return out

@@ -152,11 +152,15 @@ async def test_moe_engine_pp_matches_plain(cpu_mesh_devices):
     assert pp == plain, (pp, plain)
 
 
-def test_moe_engine_rejects_unsupported_layouts():
+def test_moe_engine_rejects_sp_mesh(cpu_mesh_devices):
+    from jax.sharding import Mesh
+
     cfg = MoeConfig.tiny()
-    with pytest.raises(ValueError, match="quantize"):
+    sp_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]), axis_names=("sp",))
+    with pytest.raises(ValueError, match="sp"):
         TpuEngine(TpuEngineConfig(model=cfg, num_pages=16,
-                                  max_batch_size=2, quantize="int8"))
+                                  max_batch_size=2, sp_mesh=sp_mesh,
+                                  sp_threshold=16))
 
 
 async def test_moe_engine_from_synth_preset(tmp_path):
@@ -246,3 +250,98 @@ def test_dense_model_rejects_ep_mesh(cpu_mesh_devices):
     with pytest.raises(ValueError, match="MoE"):
         TpuEngine(TpuEngineConfig(model=LlamaConfig.tiny(), num_pages=16,
                                   max_batch_size=2, mesh=ep_mesh))
+
+
+def test_moe_mlp_int8_close_to_bf16():
+    """Weight-only int8 expert stacks: moe_mlp output within per-channel
+    quantization tolerance of the dense version."""
+    import jax
+
+    from dynamo_tpu.engine.quant import quantize_params
+    from dynamo_tpu.models.llama import _layer_params, init_params
+    from dynamo_tpu.models.mixtral import moe_mlp
+
+    cfg = MoeConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    qparams = jax.jit(lambda p: quantize_params(p, mode="int8"))(params)
+    h = jax.random.normal(jax.random.PRNGKey(4), (5, cfg.hidden_size),
+                          dtype=jnp.float32)
+    dense_out = np.asarray(moe_mlp(h, _layer_params(params, 0), cfg))
+    q_out = np.asarray(moe_mlp(h, _layer_params(qparams, 0), cfg))
+    err = np.abs(q_out - dense_out).max()
+    scale = np.abs(dense_out).max()
+    assert err < 0.05 * scale + 1e-3, (err, scale)
+
+
+async def test_moe_engine_int8_serves_and_ep(cpu_mesh_devices):
+    """quantize='int8' MoE engine serves (expert stacks as QTensors
+    through _qe), single-device AND over the ('ep',) mesh with sharded
+    int8 experts; both deterministic."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.engine.quant import QTensor
+    from dynamo_tpu.models.llama import init_params
+
+    cfg = MoeConfig.tiny(max_pages_per_seq=32)
+    params = init_params(jax.random.PRNGKey(8), cfg)
+    req = {"token_ids": [4, 5, 6, 7], "model": "m",
+           "sampling": {"temperature": 0.0}, "stop": {"max_tokens": 6}}
+
+    async def run(mesh):
+        eng = TpuEngine(TpuEngineConfig(
+            model=cfg, num_pages=64, max_batch_size=2,
+            decode_steps_per_sync=4, quantize="int8", mesh=mesh),
+            params=params)
+        try:
+            assert isinstance(eng.params["layers"]["w_gate"], QTensor)
+            assert not isinstance(eng.params["layers"]["router"],
+                                  QTensor)
+            return [t async for o in eng.generate(dict(req), Context())
+                    for t in o.get("token_ids", [])]
+        finally:
+            await eng.close()
+
+    single = await run(None)
+    assert len(single) == 6
+    ep_mesh = Mesh(np.asarray(cpu_mesh_devices[:4]), axis_names=("ep",))
+    ep = await run(ep_mesh)
+    assert ep == single, (ep, single)
+
+
+async def test_moe_device_loader_int8(tmp_path):
+    from dynamo_tpu.engine.quant import QTensor
+    from dynamo_tpu.models.loader import (
+        config_from_hf,
+        load_llama_params_device,
+    )
+    from dynamo_tpu.models.synth_ckpt import write_synthetic_hf_checkpoint
+
+    path = write_synthetic_hf_checkpoint(
+        str(tmp_path / "mixtral-tiny"), "mixtral-tiny")
+    cfg = config_from_hf(path, page_size=4, max_pages_per_seq=16)
+    params = load_llama_params_device(path, cfg, quantize="int8")
+    wg = params["layers"]["w_gate"]
+    assert isinstance(wg, QTensor) and wg.bits == 8
+    assert wg.q.shape == (cfg.num_layers, cfg.num_experts,
+                          cfg.hidden_size, cfg.intermediate_size)
+    eng = TpuEngine(TpuEngineConfig(
+        model=cfg, num_pages=64, max_batch_size=2, quantize="int8",
+        decode_steps_per_sync=4, default_max_tokens=6), params=params)
+    try:
+        req = {"token_ids": [9, 8, 7], "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 6}}
+        toks = [t async for o in eng.generate(req, Context())
+                for t in o.get("token_ids", [])]
+        assert len(toks) == 6
+    finally:
+        await eng.close()
+
+
+def test_moe_engine_rejects_w8a8_int4():
+    cfg = MoeConfig.tiny()
+    for mode in ("w8a8", "int4"):
+        with pytest.raises(ValueError, match="int8"):
+            TpuEngine(TpuEngineConfig(model=cfg, num_pages=16,
+                                      max_batch_size=2, quantize=mode))

@@ -339,6 +339,43 @@ async def test_moe_device_loader_int8(tmp_path):
         await eng.close()
 
 
+async def test_moe_target_with_dense_draft_spec():
+    """Speculative decoding over an MoE TARGET with a dense DRAFT
+    (page geometry shared): greedy output must equal the no-draft MoE
+    engine — the verify forward routes through moe_mlp via the same
+    _mlp dispatch, and Leviathan greedy equality is family-blind."""
+    import jax
+
+    from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = MoeConfig.tiny(max_pages_per_seq=32)
+    draft_cfg = LlamaConfig.tiny(max_pages_per_seq=32)
+    params = init_params(jax.random.PRNGKey(12), cfg)
+    req = {"token_ids": [3, 1, 4, 1, 5], "model": "m",
+           "sampling": {"temperature": 0.0}, "stop": {"max_tokens": 12}}
+
+    async def run(draft):
+        eng = TpuEngine(TpuEngineConfig(
+            model=cfg, num_pages=96, max_batch_size=2,
+            decode_steps_per_sync=4,
+            draft_model=draft_cfg if draft else None,
+            spec_gamma=2, spec_iters_per_sync=2), params=params,
+            draft_params=(init_params(jax.random.PRNGKey(13), draft_cfg)
+                          if draft else None))
+        try:
+            toks = [t async for o in eng.generate(dict(req), Context())
+                    for t in o.get("token_ids", [])]
+            stats = eng._spec_stats
+            return toks, stats
+        finally:
+            await eng.close()
+
+    base, _ = await run(False)
+    spec, stats = await run(True)
+    assert spec == base and len(spec) == 12
+    assert stats.num_draft_tokens > 0
+
+
 def test_moe_engine_rejects_w8a8_int4():
     cfg = MoeConfig.tiny()
     for mode in ("w8a8", "int4"):

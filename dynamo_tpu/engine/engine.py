@@ -290,26 +290,26 @@ class TpuEngine:
         owned_draft = draft_params is None
         if getattr(mcfg, "num_experts", 0):
             # MoE serving layouts: single-device, pp_mesh (stage slices
-            # carry their experts), or an EXPERT-PARALLEL mesh — any
-            # mesh whose axes avoid "tp" (sharding.param_specs' moe
-            # branch shards the expert stacks over "ep"; attention and
-            # the KV cache replicate, GSPMD psums the expert combine).
-            # tp/sp meshes and quantize are rejected loudly: they'd
-            # need head-sharded attention specs composed with expert
-            # sharding / qm-routed expert matmuls.
-            if cfg.sp_mesh is not None or (
-                    cfg.mesh is not None
-                    and "tp" in cfg.mesh.axis_names):
+            # carry their experts), an ('ep',) mesh (experts shard,
+            # attention + KV cache replicate, GSPMD psums the expert
+            # combine), or a 2-D ('ep','tp') mesh (attention
+            # additionally megatron-shards over tp — the Mixtral-8x7B
+            # multi-host shape). quantize='int8' composes (weight-only
+            # expert stacks via mixtral._qe); sp, other mesh axes, and
+            # w8a8/int4 experts are rejected loudly below.
+            if cfg.sp_mesh is not None:
                 raise ValueError(
-                    "MoE models serve single-device, over pp_mesh, or "
-                    "over an ('ep',) mesh; tp/sp meshes need "
-                    "expert-aware attention specs (future work)")
-            if cfg.mesh is not None \
-                    and tuple(cfg.mesh.axis_names) != ("ep",):
+                    "MoE models don't compose with sp ring prefill "
+                    "yet; serve single-device, over pp_mesh, or over "
+                    "an ('ep',)/('ep','tp') mesh")
+            if cfg.mesh is not None and not (
+                    "ep" in cfg.mesh.axis_names
+                    and set(cfg.mesh.axis_names) <= {"ep", "tp"}):
                 raise ValueError(
-                    "an MoE serving mesh must be exactly ('ep',) — "
-                    "experts shard over it; other axes would silently "
-                    "replicate the whole model")
+                    "an MoE serving mesh must be ('ep',) — experts "
+                    "shard over it — or 2-D ('ep','tp') with attention "
+                    "megatron-sharded over tp; other axes would "
+                    "silently replicate the whole model")
             if cfg.quantize and cfg.quantize != "int8":
                 raise ValueError(
                     "MoE expert stacks support weight-only int8 "

@@ -37,37 +37,52 @@ def make_mesh(dp: int = 1, tp: int = 1,
 
 
 def param_specs(attention_bias: bool = False,
-                moe: bool = False) -> dict:
+                moe: bool = False, moe_tp: bool = False) -> dict:
     """PartitionSpecs matching init_params' pytree structure.
     `attention_bias` (Qwen2 family) adds bq/bk/bv rows — biases shard
     like their weight's OUTPUT dim (megatron column-parallel).
 
     `moe` (Mixtral family) returns the EXPERT-PARALLEL serving layout
-    instead: attention/router/embeddings replicated, the (L, X, ...)
-    expert stacks sharded over "ep" on the expert axis. moe_mlp's
-    dense-dispatch einsums contract over X, so GSPMD computes each
-    chip's experts locally and inserts ONE psum for the weighted
-    combine — the serving analog of ep_param_specs (mixtral.py),
-    reusable under the engine's ordinary jit (no shard_map)."""
+    instead: the (L, X, ...) expert stacks shard over "ep" on the
+    expert axis; moe_mlp's dense-dispatch einsums contract over X, so
+    GSPMD computes each chip's experts locally and inserts ONE psum
+    for the weighted combine — the serving analog of ep_param_specs
+    (mixtral.py), reusable under the engine's ordinary jit (no
+    shard_map). With `moe_tp` (a 2-D ("ep","tp") mesh — the
+    Mixtral-8x7B multi-host shape) attention/embeddings additionally
+    shard megatron-style over "tp" while the router stays replicated;
+    otherwise everything non-expert replicates."""
     if moe:
-        layers = {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, None),
-            "wk": P(None, None, None),
-            "wv": P(None, None, None),
-            "wo": P(None, None, None),
-            "mlp_norm": P(None, None),
+        if moe_tp:
+            base = param_specs(attention_bias)
+            layers = dict(base["layers"])
+            for k in ("w_gate", "w_up", "w_down"):
+                layers.pop(k)
+            out = {"embed": base["embed"], "layers": layers,
+                   "final_norm": base["final_norm"],
+                   "lm_head": base["lm_head"]}
+        else:
+            layers = {
+                "attn_norm": P(None, None),
+                "wq": P(None, None, None),
+                "wk": P(None, None, None),
+                "wv": P(None, None, None),
+                "wo": P(None, None, None),
+                "mlp_norm": P(None, None),
+            }
+            out = {
+                "embed": P(None, None),
+                "layers": layers,
+                "final_norm": P(None),
+                "lm_head": P(None, None),
+            }
+        out["layers"].update({
             "router": P(None, None, None),
             "w_gate": P(None, "ep", None, None),
             "w_up": P(None, "ep", None, None),
             "w_down": P(None, "ep", None, None),
-        }
-        return {
-            "embed": P(None, None),
-            "layers": layers,
-            "final_norm": P(None),
-            "lm_head": P(None, None),
-        }
+        })
+        return out
     layers = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
@@ -90,13 +105,16 @@ def param_specs(attention_bias: bool = False,
     }
 
 
-def specs_for(params: dict) -> dict:
+def specs_for(params: dict, mesh: Optional[Mesh] = None) -> dict:
     """param_specs pruned/extended to match THIS param tree's layer
     keys (the bias rows exist only for attention_bias configs, the
     router/expert rows only for MoE; a tree.map over mismatched dicts
-    raises)."""
-    specs = param_specs(attention_bias="bq" in params["layers"],
-                        moe="router" in params["layers"])
+    raises). The mesh decides whether MoE attention tp-shards (2-D
+    ("ep","tp")) or replicates (1-D ("ep",))."""
+    specs = param_specs(
+        attention_bias="bq" in params["layers"],
+        moe="router" in params["layers"],
+        moe_tp=mesh is not None and "tp" in mesh.axis_names)
     specs["layers"] = {k: specs["layers"][k] for k in params["layers"]}
     return specs
 
@@ -113,9 +131,11 @@ def cache_spec(mesh: Optional[Mesh] = None) -> P:
 def param_sharding(mesh: Mesh, attention_bias: bool = False,
                    moe: bool = False) -> dict:
     """NamedSharding tree matching init_params' structure."""
-    return jax.tree.map(lambda s: NamedSharding(mesh, s),
-                        param_specs(attention_bias, moe=moe),
-                        is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        param_specs(attention_bias, moe=moe,
+                    moe_tp=moe and "tp" in mesh.axis_names),
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
@@ -125,7 +145,7 @@ def cache_sharding(mesh: Mesh) -> NamedSharding:
 def shard_params(params: dict, mesh: Mesh) -> dict:
     from dynamo_tpu.engine.quant import QTensor, scale_spec
 
-    specs = specs_for(params)
+    specs = specs_for(params, mesh)
 
     def place(x, s):
         if isinstance(x, QTensor):

@@ -177,23 +177,32 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
     from dynamo_tpu.llm.entrypoint import build_tpu_engine
 
     mesh = None
-    if args.num_nodes > 1 or args.tensor_parallel_size > 1:
+    if args.expert_parallel_size <= 1 and (
+            args.num_nodes > 1 or args.tensor_parallel_size > 1):
         mesh = _multinode_mesh(args)
     if args.expert_parallel_size > 1:
         import jax
         import numpy as np
         from jax.sharding import Mesh
 
-        if mesh is not None:
+        if args.num_nodes > 1:
             raise SystemExit(
-                "--expert-parallel-size does not compose with tp/"
-                "multinode meshes (MoE attention specs are replicated)")
+                "--expert-parallel-size is single-host for now")
         devices = jax.devices()
         ep = args.expert_parallel_size
-        if len(devices) < ep:
+        tp = args.tensor_parallel_size
+        need = ep * tp
+        if len(devices) < need:
             raise SystemExit(
-                f"ep={ep} needs {ep} devices; found {len(devices)}")
-        mesh = Mesh(np.asarray(devices[:ep]), axis_names=("ep",))
+                f"ep={ep} x tp={tp} needs {need} devices; found "
+                f"{len(devices)}")
+        if tp > 1:
+            # the Mixtral multi-chip shape: experts over ep, attention
+            # megatron-sharded over tp
+            mesh = Mesh(np.asarray(devices[:need]).reshape(ep, tp),
+                        axis_names=("ep", "tp"))
+        else:
+            mesh = Mesh(np.asarray(devices[:ep]), axis_names=("ep",))
     overrides = {}
     if args.context_length is not None:
         overrides["max_pages_per_seq"] = max(1, args.context_length // 16)

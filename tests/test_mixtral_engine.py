@@ -228,15 +228,54 @@ async def test_moe_engine_ep_mesh_matches_single_device(
     assert got == base, (got, base)
 
 
-def test_moe_engine_rejects_tp_mesh(cpu_mesh_devices):
+def test_moe_engine_rejects_non_ep_mesh(cpu_mesh_devices):
     from jax.sharding import Mesh
 
     cfg = MoeConfig.tiny()
-    tp_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]).reshape(1, 2),
+    dp_mesh = Mesh(np.asarray(cpu_mesh_devices[:2]).reshape(1, 2),
                    axis_names=("dp", "tp"))
-    with pytest.raises(ValueError, match="tp"):
+    with pytest.raises(ValueError, match="ep"):
         TpuEngine(TpuEngineConfig(model=cfg, num_pages=16,
-                                  max_batch_size=2, mesh=tp_mesh))
+                                  max_batch_size=2, mesh=dp_mesh))
+
+
+async def test_moe_engine_ep_tp_mesh_matches_single_device(
+        cpu_mesh_devices):
+    """The Mixtral multi-host shape: a 2-D ('ep','tp') mesh — experts
+    over ep, attention megatron-sharded over tp, KV cache kvh-sharded
+    over tp — must emit the single-device engine's greedy tokens,
+    bf16 AND int8 expert stacks."""
+    import jax
+    from jax.sharding import Mesh
+
+    from dynamo_tpu.models.llama import init_params
+
+    mesh2d = Mesh(np.asarray(cpu_mesh_devices[:4]).reshape(2, 2),
+                  axis_names=("ep", "tp"))
+    for quant in (None, "int8"):
+        cfg = MoeConfig.tiny(dtype=jnp.float32 if quant is None
+                             else jnp.bfloat16, max_pages_per_seq=32)
+        params = init_params(jax.random.PRNGKey(21), cfg)
+        req = {"token_ids": [2, 7, 1, 8], "model": "m",
+               "sampling": {"temperature": 0.0},
+               "stop": {"max_tokens": 6}}
+
+        async def run(mesh, _cfg=cfg, _params=params, _req=req,
+                      _quant=quant):
+            eng = TpuEngine(TpuEngineConfig(
+                model=_cfg, num_pages=64, max_batch_size=2,
+                decode_steps_per_sync=4, quantize=_quant, mesh=mesh),
+                params=_params)
+            try:
+                return [t async for o in eng.generate(dict(_req),
+                                                      Context())
+                        for t in o.get("token_ids", [])]
+            finally:
+                await eng.close()
+
+        base = await run(None)
+        got = await run(mesh2d)
+        assert got == base and len(got) == 6, (quant, got, base)
 
 
 def test_dense_model_rejects_ep_mesh(cpu_mesh_devices):

@@ -63,7 +63,25 @@ def load_trained_encoder(cfg: ImageEncoderConfig) -> dict | None:
 
             params, l_rec = train()
             # savez appends ".npz" when the name lacks it — keep the
-            # suffix so the rename source actually exists
+            # suffix so the rename source actually exists. Sweep temps
+            # from CRASHED earlier trainings first (a killed process
+            # leaks its temp forever) — but ONLY dead owners: deleting
+            # a LIVE concurrent trainer's temp would break its
+            # os.replace and silently demote that worker to random
+            # init (the cross-worker token-identity hazard below).
+            import glob as _glob
+
+            for stale in _glob.glob(f"{_glob.escape(path)}.*.tmp.npz"):
+                try:
+                    owner = int(stale.rsplit(".", 3)[-3])
+                    os.kill(owner, 0)     # raises if no such process
+                except (ValueError, IndexError, ProcessLookupError):
+                    try:
+                        os.remove(stale)
+                    except OSError:
+                        pass
+                except OSError:
+                    pass                  # alive but not ours (EPERM)
             tmp = f"{path}.{os.getpid()}.tmp.npz"
             np.savez_compressed(tmp, **params,
                                 meta_recon_loss=np.float32(l_rec))

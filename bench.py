@@ -950,8 +950,10 @@ PHASES = {"short": phase_short, "wide": phase_wide, "long": phase_long,
 _MARK = "BENCH_PHASE_JSON: "
 
 # generous wall-clock boxes per phase (tunnel compiles are minutes;
-# the 8B ckpt phase has its own inner DYN_BENCH_CKPT_TIMEOUT too)
-_PHASE_TIMEOUT_S = {"ckpt": 2400.0}
+# the 8B ckpt phase has its own inner DYN_BENCH_CKPT_TIMEOUT too).
+# quant builds THREE 1B engines (one per mode) + three b32 loop shapes
+# — cold-cache compiles need more than the default box.
+_PHASE_TIMEOUT_S = {"ckpt": 2400.0, "quant": 2400.0, "disagg": 1800.0}
 _DEFAULT_TIMEOUT_S = 1200.0
 
 

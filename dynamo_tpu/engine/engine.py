@@ -58,6 +58,40 @@ from dynamo_tpu.tokens import TokenBlockSequence
 logger = logging.getLogger(__name__)
 
 
+@jax.jit
+def _gather_kv_jit(k_cache, v_cache, ids) -> "jax.Array":
+    """(2, L, KVH, n, P, D) page gather as one XLA program — from the
+    per-layer tuple layout or the pp engines' (L, ...) stacked one."""
+    if isinstance(k_cache, tuple):
+        k_sel = jnp.stack([kc[:, ids] for kc in k_cache])
+        v_sel = jnp.stack([vc[:, ids] for vc in v_cache])
+    else:
+        k_sel, v_sel = k_cache[:, :, ids], v_cache[:, :, ids]
+    return jnp.stack([k_sel, v_sel])
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _write_kv_pages_jit(k_cache, v_cache, ids,
+                        data) -> tuple[Any, Any]:
+    """Scatter imported (2, L, KVH, n, P, D) data into the paged caches
+    at `ids` — one XLA program (the eager per-layer .at[].set form paid
+    2L tunnel dispatches per disagg import), caches donated so the
+    update is in place. Handles BOTH cache layouts: the per-layer
+    tuple (plain engines) and the (L, KVH, N, P, D) stacked array (pp
+    engines — the old per-layer loop would have silently rebuilt the
+    stacked cache as a tuple and corrupted the pp layout)."""
+    if isinstance(k_cache, tuple):
+        new_k = tuple(
+            kc.at[:, ids].set(data[0, l].astype(kc.dtype))
+            for l, kc in enumerate(k_cache))
+        new_v = tuple(
+            vc.at[:, ids].set(data[1, l].astype(vc.dtype))
+            for l, vc in enumerate(v_cache))
+        return new_k, new_v
+    return (k_cache.at[:, :, ids].set(data[0].astype(k_cache.dtype)),
+            v_cache.at[:, :, ids].set(data[1].astype(v_cache.dtype)))
+
+
 @partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0, 1))
 def _sp_writeback(k_cache: tuple, v_cache: tuple, k_all, v_all,
                   page_ids, page_size: int) -> tuple[tuple, tuple]:
@@ -1998,11 +2032,14 @@ class TpuEngine:
     def _gather_kv_pages(self, page_ids: list[int]):
         """The one gather: device-resident (2, L, KVH, n, P, D). Both the
         host and device transfer paths go through here so a cache-layout
-        change can't skew them apart."""
+        change can't skew them apart. ONE jitted program (not 2L+3
+        eager ops): per-op dispatch through the tunnel dominated the
+        r4 transfer rate measurements, and XLA fuses the per-layer
+        gathers + stacks when it sees them together. Compile count is
+        bounded by distinct page-group sizes (page-aligned transfer
+        lengths)."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        k_sel = jax.numpy.stack([kc[:, ids] for kc in self.k_cache])
-        v_sel = jax.numpy.stack([vc[:, ids] for vc in self.v_cache])
-        out = jax.numpy.stack([k_sel, v_sel])
+        out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
         out.block_until_ready()
         return out
 
@@ -2037,15 +2074,11 @@ class TpuEngine:
 
     def write_kv_pages(self, page_ids: list[int], data: np.ndarray) -> None:
         """Only call from within the scheduler's device-locked step (the
-        prefill path does, for disagg imports)."""
+        prefill path does, for disagg imports). One jitted scatter —
+        see _write_kv_pages_jit."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        dtype = self.model_cfg.dtype
-        self.k_cache = tuple(
-            kc.at[:, ids].set(jax.numpy.asarray(data[0, l], dtype=dtype))
-            for l, kc in enumerate(self.k_cache))
-        self.v_cache = tuple(
-            vc.at[:, ids].set(jax.numpy.asarray(data[1, l], dtype=dtype))
-            for l, vc in enumerate(self.v_cache))
+        self.k_cache, self.v_cache = _write_kv_pages_jit(
+            self.k_cache, self.v_cache, ids, jax.numpy.asarray(data))
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown

@@ -190,3 +190,28 @@ def test_pp_engine_config_validation(cpu_mesh_devices):
         TpuEngine(TpuEngineConfig(model=CFG, num_pages=16,
                                   max_batch_size=4, pp_mesh=mesh,
                                   pp_microbatches=2, quantize="int8"))
+
+
+async def test_pp_engine_kv_pages_roundtrip(cpu_mesh_devices):
+    """read/write_kv_pages on a pp engine's STACKED (L, ...) cache: the
+    old per-layer loop would silently rebuild the stacked cache as a
+    tuple on import; now both layouts round-trip bit-exact."""
+    params = init_params(jax.random.PRNGKey(5), CFG)
+    eng = TpuEngine(TpuEngineConfig(
+        model=CFG, num_pages=64, max_batch_size=4,
+        decode_steps_per_sync=4, pp_mesh=pp_mesh(cpu_mesh_devices),
+        pp_microbatches=2), params=params)
+    try:
+        # serve once so some pages carry real KV
+        await generate(eng, [5, 6, 7, 8, 9, 10, 11, 12], n_tokens=6)
+        pages = [1, 2]
+        data = await eng.read_kv_pages(pages)
+        assert data.shape[0] == 2 and data.shape[1] == CFG.num_layers
+        # write the same data back: layout must stay STACKED and bytes
+        # must be unchanged
+        eng.write_kv_pages(pages, data)
+        assert not isinstance(eng.k_cache, tuple)
+        again = await eng.read_kv_pages(pages)
+        np.testing.assert_array_equal(data, again)
+    finally:
+        await eng.close()

@@ -617,7 +617,7 @@ class TpuEngine:
         # queues) — here the split is measured at the source.
         self.perf = {"prefill_s": 0.0, "decode_s": 0.0,
                      "prefill_new_tokens": 0, "prefill_emitted": 0,
-                     "tokens_emitted": 0}
+                     "tokens_emitted": 0, "pipelined_bursts": 0}
         self._rng = np.random.RandomState(cfg.rng_seed)
         # Serializes device access: step functions donate the cache buffers
         # (the pre-step arrays die mid-call), so concurrent readers
@@ -833,6 +833,15 @@ class TpuEngine:
     async def _scheduler_loop(self) -> None:
         while not self._stopped:
             if not self._waiting and not self._running:
+                if self._inflight is not None:
+                    # the last lane finished (stop token) while a
+                    # speculative burst was in flight: land it NOW, or
+                    # its deferred pages sit out of the pool (and
+                    # metrics report stale usage) for the whole idle
+                    # period — common at low concurrency since partial
+                    # batches pipeline
+                    await asyncio.to_thread(self._drain_inflight_sync)
+                    continue
                 self._wake.clear()
                 if self._transfers:
                     # stay reap-able: pinned transfers must expire even
@@ -1837,8 +1846,19 @@ class TpuEngine:
         nxt = None
         # speculate only when nothing can change the batch: slots full
         # (no admission), every lane alive/uncancelled/plain, no draft
-        # engine (it would want a spec burst instead)
-        can_spec = (len(self._running) >= cfg.max_batch_size
+        # engine (it would want a spec burst instead). "Nothing can
+        # change the batch" holds in TWO states: slots full (arrivals
+        # must queue), or nothing waiting AND every running lane is in
+        # this burst (an arrival during the speculative burst gets
+        # admitted next pass, which flips this check False and drains
+        # the pipeline before the batch is rebuilt). The second state
+        # pipelines phase TAILS and low-concurrency serving — r5: the
+        # slots-full-only guard left every partial batch unpipelined,
+        # paying the full sync per burst exactly when per-request
+        # latency is most visible.
+        can_spec = ((len(self._running) >= cfg.max_batch_size
+                     or (not self._waiting
+                         and len(self._running) == len(batch)))
                     and self.draft_params is None
                     and all(s in self._running and not s.ctx.is_cancelled()
                             and not s.needs_constrained for s in batch)
@@ -1888,6 +1908,7 @@ class TpuEngine:
                 async with self._device_lock:
                     packed2, self.k_cache, self.v_cache = \
                         await asyncio.to_thread(dispatch2)
+                self.perf["pipelined_bursts"] += 1
                 nxt = {"k": k, "batch": batch, "packed": packed2,
                        "positions": inf["positions"] + k,
                        "valid": inf["valid"], "seeds": inf["seeds"],

@@ -368,6 +368,38 @@ async def test_pipeline_bursts_equivalent_to_sync():
         assert piped == base, (sampling, piped, base)
 
 
+async def test_pipeline_engages_on_partial_batch():
+    """r5: speculation is no longer gated on full slots — a lone lane
+    (nothing waiting) pipelines too, with identical output to the
+    synchronous path."""
+    import jax as _jax
+
+    from dynamo_tpu.models.llama import init_params as _ip
+
+    cfg = LlamaConfig.tiny()
+    params = _ip(_jax.random.PRNGKey(0), cfg)
+
+    async def serve(pipeline):
+        eng = TpuEngine(TpuEngineConfig(
+            model=cfg, num_pages=96, max_batch_size=4,
+            default_max_tokens=32, decode_steps_per_sync=4,
+            pipeline_bursts=pipeline), params=params)
+        try:
+            req = {"token_ids": [1, 2, 3, 4, 5], "model": "m",
+                   "sampling": {"temperature": 0.0},
+                   "stop": {"max_tokens": 32}}
+            toks = [t async for o in eng.generate(req, Context())
+                    for t in o.get("token_ids", ())]
+            return toks, eng.perf["pipelined_bursts"]
+        finally:
+            await eng.close()
+
+    base, _ = await serve(False)
+    piped, n_spec = await serve(True)
+    assert piped == base and len(piped) == 32
+    assert n_spec > 0, "partial batch never pipelined"
+
+
 async def test_pipeline_no_page_leak_after_churn():
     eng = TpuEngine(TpuEngineConfig(
         model=LlamaConfig.tiny(), num_pages=64, max_batch_size=2,
@@ -395,5 +427,30 @@ async def test_pipeline_no_page_leak_after_churn():
         # the pipeline path would strand refcounted pages here)
         assert eng._inflight is None
         assert eng.pool.active_pages == 0
+    finally:
+        await eng.close()
+
+
+async def test_idle_drains_inflight_and_releases_pages():
+    """A stop-token finish during a pipelined burst must not strand the
+    lane's pages in the stale speculative burst across the idle period
+    (the scheduler drains _inflight before parking)."""
+    import asyncio as _a
+
+    eng = make_engine(max_batch_size=4, decode_steps_per_sync=4,
+                      default_max_tokens=32, num_pages=96)
+    try:
+        outs = await run(eng, req(range(1, 9), max_tokens=4))
+        first = outs[0]["token_ids"][0]
+        outs2 = await run(eng, req(range(1, 9), max_tokens=32,
+                                   stop_ids=[first]))
+        assert outs2[-1]["finish_reason"] == "stop"
+        # give the scheduler a few passes to notice idle + drain
+        for _ in range(50):
+            if eng._inflight is None and eng.pool.active_pages == 0:
+                break
+            await _a.sleep(0.05)
+        assert eng._inflight is None
+        assert eng.pool.active_pages == 0, eng.pool.active_pages
     finally:
         await eng.close()

@@ -1,0 +1,15 @@
+# Test tiers (ROADMAP.md). All runs pin the CPU backend — tests never
+# touch a TPU even when the tunnel backend is registered.
+
+PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
+
+.PHONY: tier0 tier1
+
+# fast smoke: the pure-host suites + the interleave scheduler gate,
+# < 60 s total (currently ~15 s)
+tier0:
+	$(PYTEST) tests/ -m tier0
+
+# the full gate the driver runs (everything but slow)
+tier1:
+	$(PYTEST) tests/ -m 'not slow' --continue-on-collection-errors

@@ -391,15 +391,21 @@ async def phase_long():
     # engine/attention.py block heuristic) — page granularity is an
     # attention-kernel lever, not just a cache-management knob
     cfg = bench_cfg(max_pages_per_seq=64, page_size=32)
+    # budgeted chunked-prefill interleaving (engine._prefill_budgeted):
+    # 0 = legacy phase-alternating scheduler for A/B runs
+    budget = int(os.environ.get("DYN_BENCH_PREFILL_BUDGET", "512"))
     return await engine_phase(
         lambda: TpuEngine(TpuEngineConfig(
             model=cfg, num_pages=1536, max_batch_size=L_BATCH,
             prefill_chunk=512, default_max_tokens=L_OSL,
-            decode_steps_per_sync=K_STEPS, quantize=QUANTIZE)),
+            decode_steps_per_sync=K_STEPS, quantize=QUANTIZE,
+            prefill_chunk_budget=budget)),
         lambda eng: _phase_long_body(cfg, eng))
 
 
 async def _phase_long_body(cfg, eng):
+    import numpy as np
+
     # warmup: compile decode (fixed width) + every (bp, 512) prefill
     # round width, short OSL so warmup cost is prefill-dominated
     await serve_n(eng, 1, L_ISL, K_STEPS + 1, base=0)
@@ -409,10 +415,15 @@ async def _phase_long_body(cfg, eng):
     ttft = await ttft_probe(eng, L_ISL)
 
     # measured: unique prompts (no prefix reuse — worst case), with the
-    # engine's own prefill/decode phase split captured around the window
+    # engine's own prefill/decode phase split captured around the window.
+    # NOTE: dict(eng.perf) shallow-copies — itl_hist is shared by
+    # reference, so ITL percentiles come from the raw-sample FIFO
+    # (exact, measured at _emit_lane), not from histogram deltas.
+    s0 = len(eng.itl_samples)
     p0 = dict(eng.perf)
     n_tok, dt = await serve_n(eng, L_NREQ, L_ISL, L_OSL, base=1000)
     p1 = dict(eng.perf)
+    itl_window = np.asarray(eng.itl_samples[s0:], dtype=np.float64)
     tok_s = n_tok / dt
     dec_s = p1["decode_s"] - p0["decode_s"]
     dec_tok = (p1["tokens_emitted"] - p0["tokens_emitted"]
@@ -457,6 +468,16 @@ async def _phase_long_body(cfg, eng):
         "n_requests": L_NREQ, "shared_prefix": L_SHARED,
         "quantize": QUANTIZE,
         "ttft_ms_unloaded_p50": round(ttft, 1),
+        "prefill_budget": eng.config.prefill_chunk_budget,
+        "itl_p50_ms": (round(float(np.percentile(itl_window, 50)), 2)
+                       if itl_window.size else None),
+        "itl_p99_ms": (round(float(np.percentile(itl_window, 99)), 2)
+                       if itl_window.size else None),
+        "prefill_chunks": p1["prefill_chunks"] - p0["prefill_chunks"],
+        "mixed_steps": p1["mixed_steps"] - p0["mixed_steps"],
+        "decode_steps_during_prefill":
+            p1["decode_steps_during_prefill"]
+            - p0["decode_steps_during_prefill"],
     }
     del params
     return out

@@ -1,0 +1,45 @@
+"""JAX version shims.
+
+The codebase targets the newest JAX API surface; this module backfills
+the handful of symbols that moved between 0.4.x and 0.5+/0.6+ so the
+same source serves both. Import from here, never feature-test at call
+sites — one shim per symbol keeps the fallback rules in one place.
+
+- ``shard_map``: promoted to ``jax.shard_map`` in 0.5; lives under
+  ``jax.experimental.shard_map`` on 0.4.x.
+- ``tree_leaves_with_path``: stable under ``jax.tree_util`` everywhere,
+  also exposed as ``jax.tree.leaves_with_path`` on newer releases.
+- ``pcast``: the varying-manual-axes cast (``jax.lax.pcast``) only
+  exists on releases with the shard_map varying-type system; on older
+  JAX every value inside shard_map is already varying, so casting
+  *to* 'varying' is the identity.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # JAX 0.4.x
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+try:
+    tree_leaves_with_path = jax.tree.leaves_with_path  # type: ignore
+except AttributeError:  # JAX 0.4.x: only the tree_util spelling exists
+    from jax.tree_util import tree_leaves_with_path  # type: ignore
+
+try:
+    pcast = jax.lax.pcast  # type: ignore[attr-defined]
+except AttributeError:  # older JAX: no varying types — identity
+    def pcast(x, axes, to="varying"):
+        assert to == "varying", to   # 'unvarying' has no old-JAX analog
+        return x
+
+try:
+    set_mesh = jax.set_mesh  # type: ignore[attr-defined]
+except AttributeError:  # older JAX: Mesh is itself the context manager
+    def set_mesh(mesh):
+        return mesh
+
+__all__ = ["shard_map", "tree_leaves_with_path", "pcast", "set_mesh"]

@@ -33,8 +33,21 @@ from typing import Any, Optional
 logger = logging.getLogger(__name__)
 
 
+def plane_available() -> bool:
+    """True when this JAX build ships the transfer API (it moved around
+    the experimental namespace across releases; some CPU builds omit it
+    entirely). Gating here keeps both roles on the wire fallback —
+    producer refuses to stage, consumer never asks."""
+    try:
+        from jax.experimental import transfer  # noqa: F401
+    except ImportError:
+        return False
+    return hasattr(transfer, "start_transfer_server")
+
+
 def plane_enabled() -> bool:
-    return os.environ.get("DYN_KV_PLANE", "1") != "0"
+    return (os.environ.get("DYN_KV_PLANE", "1") != "0"
+            and plane_available())
 
 
 def _uuid_of(transfer_id: str) -> int:

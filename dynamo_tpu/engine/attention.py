@@ -78,6 +78,37 @@ def prefill_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     return out.astype(q.dtype)
 
 
+def mixed_attention(q_dec: jax.Array, q_chunk: jax.Array,
+                    k_pages: jax.Array, v_pages: jax.Array,
+                    dec_lengths: jax.Array, dec_tables: jax.Array,
+                    chunk_tables: jax.Array, chunk_positions: jax.Array,
+                    chunk_seq_lens: jax.Array,
+                    page_size: int) -> tuple[jax.Array, jax.Array]:
+    """One attention entry for a MIXED prefill+decode dispatch: the
+    decode sub-batch routes through `paged_attention_decode` and the
+    chunk sub-batch through `prefill_attention`, against the same page
+    caches, inside one traced step (models/llama.py mixed_prefill_decode
+    jits the whole thing; compile shapes bucket on (decode width, chunk
+    tokens)). The two sub-batches are different sequences with disjoint
+    page tables, so neither side reads the other's in-flight writes and
+    each sub-batch's numerics are exactly the stand-alone kernel's.
+
+    q_dec: (B, H, D); q_chunk: (Bp, T, H, D); dec_lengths: (B,);
+    dec_tables: (B, max_pages); chunk_tables: (Bp, max_pages);
+    chunk_positions: (Bp, T); chunk_seq_lens: (Bp,).
+    Returns (dec_out (B, H, D), chunk_out (Bp, T, H, D)).
+    """
+    dec_out = paged_attention_decode(
+        q_dec, k_pages, v_pages, dec_lengths, dec_tables,
+        page_size=page_size)
+    chunk_out = jax.vmap(
+        lambda q1, pt, pos1, sl: prefill_attention(
+            q1, k_pages, v_pages, pt, q_positions=pos1, seq_len=sl,
+            page_size=page_size)
+    )(q_chunk, chunk_tables, chunk_positions, chunk_seq_lens)
+    return dec_out, chunk_out
+
+
 def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, lengths: jax.Array,
                            page_tables: jax.Array,

@@ -32,11 +32,13 @@ import numpy as np
 
 from dynamo_tpu.engine.pages import PagePool
 from dynamo_tpu.engine.sampling import sample_tokens_lp
+from dynamo_tpu.llm.perf import itl_new_hist, itl_observe, itl_percentile
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     decode_multi_step,
     init_cache,
     init_params,
+    mixed_prefill_decode,
     prefill_batch,
 )
 from dynamo_tpu.protocols import (
@@ -229,6 +231,17 @@ class TpuEngineConfig:
     # the compile count at the cost of padded prefill FLOPs for
     # mid-sized rounds.
     prefill_batch_widths: Optional[tuple] = None
+    # Token-budgeted interleaved prefill: each scheduler iteration runs
+    # at most ONE chunk round spending <= this many prompt tokens (drawn
+    # from pending sequences' cursors) instead of prefilling every
+    # admitted prompt to completion, so in-flight decode lanes emit
+    # tokens BETWEEN a long prompt's chunks and ITL is bounded by one
+    # budgeted step. Where the engine shape allows (no draft/pp engine,
+    # no constrained decode lane, no burst in flight) the chunk round
+    # FUSES with the decode burst in one jitted mixed step
+    # (models/llama.py mixed_prefill_decode). 0 = disabled: the legacy
+    # phase-alternating scheduler, bit-for-bit.
+    prefill_chunk_budget: int = 0
 
 
 @dataclass
@@ -243,6 +256,13 @@ class _Seq:
     # disagg: host KV data to preload into this seq's pages before prefill
     import_kv: Optional[tuple] = None     # (np array (2,L,KVH,n,P,D), len)
     cached_len: int = 0                   # prefix-cache hit length
+    # resumable prefill chunk cursor: prompt positions < prefill_pos have
+    # target KV on device. Partial-prefill sequences (cursor mid-prompt)
+    # stay in _running but are excluded from decode batches — and from
+    # draft catch-up and guided first-token handling — until the cursor
+    # reaches len(prompt) and `prefilled` flips.
+    prefill_pos: int = 0
+    last_emit_t: float = 0.0              # monotonic stamp of last emission
     draft_pos: int = 0                    # draft-cache-valid positions < this
     guided: Optional[Any] = None          # GuidedTables when constrained
     guided_state: int = 0                 # authoritative DFA state (host)
@@ -615,9 +635,21 @@ class TpuEngine:
         # The reference separates these phases at the metrics layer too
         # (TTFT vs ITL in aiperf; ForwardPassMetrics prefill/decode
         # queues) — here the split is measured at the source.
+        # prefill_chunks counts chunk ROUNDS (device dispatches), mixed
+        # or plain; decode_steps_during_prefill counts decode steps that
+        # ran while some admitted prompt's prefill was still mid-flight
+        # (the interleaving the budgeted scheduler exists to create);
+        # itl_hist is the llm/perf.py bucket histogram of per-lane
+        # inter-emission gaps (ms) — snapshot with list() before
+        # delta-ing, the engine mutates it in place.
         self.perf = {"prefill_s": 0.0, "decode_s": 0.0,
                      "prefill_new_tokens": 0, "prefill_emitted": 0,
-                     "tokens_emitted": 0, "pipelined_bursts": 0}
+                     "tokens_emitted": 0, "pipelined_bursts": 0,
+                     "prefill_chunks": 0, "decode_steps_during_prefill": 0,
+                     "mixed_steps": 0, "itl_hist": itl_new_hist()}
+        # raw ITL samples (ms), capped FIFO — bench reads these for
+        # exact percentiles; the wire carries only the histogram
+        self.itl_samples: list[float] = []
         self._rng = np.random.RandomState(cfg.rng_seed)
         # Serializes device access: step functions donate the cache buffers
         # (the pre-step arrays die mid-call), so concurrent readers
@@ -864,12 +896,16 @@ class TpuEngine:
                     # is one fetch_timeout per wave, not per sequence
                     # (onboard_remote never raises)
                     fresh = [s for s in self._running
-                             if not s.prefilled and s.import_kv is None]
+                             if not s.prefilled and s.import_kv is None
+                             and s.prefill_pos <= s.cached_len]
                     if fresh:
                         await asyncio.gather(
                             *(self.kvbm.onboard_remote(s) for s in fresh))
                 t0 = time.perf_counter()
-                progressed = await self._prefill_pending()
+                if self.config.prefill_chunk_budget > 0:
+                    progressed = await self._prefill_budgeted()
+                else:
+                    progressed = await self._prefill_pending()
                 t1 = time.perf_counter()
                 if progressed:
                     self.perf["prefill_s"] += t1 - t0
@@ -945,6 +981,9 @@ class TpuEngine:
                     # live in the host/disk tiers are DMA'd into the fresh
                     # pages so prefill skips them
                     cand.cached_len = self.kvbm.onboard(cand)
+            # budgeted prefill resumes from here; legacy prefill keys its
+            # offsets off cached_len directly and ignores the cursor
+            cand.prefill_pos = cand.cached_len
             self._waiting.pop(0)
             self._running.append(cand)
 
@@ -995,65 +1034,87 @@ class TpuEngine:
                 self.dk_cache, self.dv_cache, _ = run_chunks(
                     self.draft_params, self.config.draft_model,
                     self.dk_cache, self.dv_cache, d_offsets)
-            # pad to a fixed width so sampling compiles exactly once
-            width = cfg.max_batch_size
-            stack = [last_logits[id(s)] for s in pending]
-            while len(stack) < width:
-                stack.append(stack[0])
-            guided_mask = None
-            if any(s.guided is not None for s in pending):
-                # first sampled token must already respect the grammar
-                V = mcfg.vocab_size
-                guided_mask = np.zeros((width, V), dtype=np.float32)
-                for i, s in enumerate(pending):
-                    if s.guided is not None:
-                        ok = self._guided_allowed_row(s.guided, s, V)
-                        guided_mask[i, ~ok] = -1e30
-            penalty_args = None
-            if any(s.has_penalties for s in pending):
-                # the FIRST sampled token must see the same penalties as
-                # every decode-burst token (vLLM semantics: repetition
-                # covers prompt tokens)
-                penalty_args = self._penalty_arrays(pending, width)
-
-            def arr(fn, dtype):
-                vals = [fn(s) for s in pending]
-                vals += [vals[0]] * (width - len(pending))
-                return np.asarray(vals, dtype=dtype)
-
-            logits_stack = jax.numpy.stack(stack)
-            if penalty_args is not None:
-                from dynamo_tpu.engine.sampling import apply_penalties
-
-                rep_a, freq_a, pres_a, pc, oc = penalty_args
-                logits_stack = apply_penalties(
-                    logits_stack, jax.numpy.asarray(pc),
-                    jax.numpy.asarray(oc),
-                    jax.numpy.asarray(rep_a), jax.numpy.asarray(freq_a),
-                    jax.numpy.asarray(pres_a))
-            if guided_mask is not None:
-                logits_stack = logits_stack + jax.numpy.asarray(
-                    guided_mask)
-            tk = (self.TOPK_WIDTH
-                  if any(s.wants_topk for s in pending) else 0)
-            sampled = sample_tokens_lp(
-                logits_stack,
-                arr(lambda s: s.seed, np.uint32),
-                arr(lambda s: s.generated, np.uint32),
-                arr(lambda s: s.req.sampling.temperature, np.float32),
-                arr(lambda s: s.req.sampling.top_p, np.float32),
-                arr(lambda s: s.req.sampling.top_k, np.int32),
-                arr(lambda s: s.req.sampling.min_p, np.float32),
-                topk_lp=tk)
-            return np.asarray(sampled), tk                # ONE host sync
+            return self._first_token_packed(pending, last_logits)
 
         self.perf["prefill_new_tokens"] += sum(
             max(len(s.prompt) - s.cached_len, 0) for s in pending)
-        self.perf["prefill_emitted"] += len(pending)
         async with self._device_lock:
             packed, tk = await asyncio.to_thread(prefill_all)
+        self._emit_first_tokens(pending, packed, tk, draft_done=True)
+        return True
+
+    def _first_token_packed(self, pending: list[_Seq], last_logits):
+        """Sample every just-prefilled sequence's FIRST token in one
+        device call + ONE host sync: pad the last-token logits to the
+        fixed max_batch_size width (so sampling compiles exactly once),
+        overlay grammar masks and penalties, run sample_tokens_lp.
+        Returns (packed np (2 + 2*tk, width), tk). Device-blocking —
+        call under the device lock, in a thread. Shared by the legacy
+        all-at-once prefill and the budgeted scheduler's completions so
+        first-token semantics can never diverge."""
+        cfg, mcfg = self.config, self.model_cfg
+        width = cfg.max_batch_size
+        stack = [last_logits[id(s)] for s in pending]
+        while len(stack) < width:
+            stack.append(stack[0])
+        guided_mask = None
+        if any(s.guided is not None for s in pending):
+            # first sampled token must already respect the grammar
+            V = mcfg.vocab_size
+            guided_mask = np.zeros((width, V), dtype=np.float32)
+            for i, s in enumerate(pending):
+                if s.guided is not None:
+                    ok = self._guided_allowed_row(s.guided, s, V)
+                    guided_mask[i, ~ok] = -1e30
+        penalty_args = None
+        if any(s.has_penalties for s in pending):
+            # the FIRST sampled token must see the same penalties as
+            # every decode-burst token (vLLM semantics: repetition
+            # covers prompt tokens)
+            penalty_args = self._penalty_arrays(pending, width)
+
+        def arr(fn, dtype):
+            vals = [fn(s) for s in pending]
+            vals += [vals[0]] * (width - len(pending))
+            return np.asarray(vals, dtype=dtype)
+
+        logits_stack = jax.numpy.stack(stack)
+        if penalty_args is not None:
+            from dynamo_tpu.engine.sampling import apply_penalties
+
+            rep_a, freq_a, pres_a, pc, oc = penalty_args
+            logits_stack = apply_penalties(
+                logits_stack, jax.numpy.asarray(pc),
+                jax.numpy.asarray(oc),
+                jax.numpy.asarray(rep_a), jax.numpy.asarray(freq_a),
+                jax.numpy.asarray(pres_a))
+        if guided_mask is not None:
+            logits_stack = logits_stack + jax.numpy.asarray(
+                guided_mask)
+        tk = (self.TOPK_WIDTH
+              if any(s.wants_topk for s in pending) else 0)
+        sampled = sample_tokens_lp(
+            logits_stack,
+            arr(lambda s: s.seed, np.uint32),
+            arr(lambda s: s.generated, np.uint32),
+            arr(lambda s: s.req.sampling.temperature, np.float32),
+            arr(lambda s: s.req.sampling.top_p, np.float32),
+            arr(lambda s: s.req.sampling.top_k, np.int32),
+            arr(lambda s: s.req.sampling.min_p, np.float32),
+            topk_lp=tk)
+        return np.asarray(sampled), tk                # ONE host sync
+
+    def _emit_first_tokens(self, pending: list[_Seq], packed: np.ndarray,
+                           tk: int, draft_done: bool) -> None:
+        """Flip just-prefilled sequences to decodable and emit their
+        first tokens (packed from _first_token_packed). draft_done=False
+        (budgeted path): the draft cache saw none of the prompt — leave
+        draft_pos at 0 so _draft_catchup replays it before the first
+        spec burst (the draft is small by construction)."""
+        mcfg = self.model_cfg
         tokens = packed[0].astype(np.int32)
         logprobs = packed[1]
+        self.perf["prefill_emitted"] += len(pending)
         for i, (seq, token, lp) in enumerate(zip(pending, tokens,
                                                  logprobs)):
             # token_seq mirrors what prefill wrote to the device; register
@@ -1065,7 +1126,8 @@ class TpuEngine:
                     seq.pages[block.block_index], block.seq_hash,
                     block.local_hash, block.parent_seq_hash)
             seq.prefilled = True
-            seq.draft_pos = len(seq.prompt)
+            seq.prefill_pos = len(seq.prompt)
+            seq.draft_pos = len(seq.prompt) if draft_done else 0
             topk_fn = None
             if tk and seq.wants_topk:
                 def topk_fn(_k, _i=i, _s=seq):
@@ -1076,28 +1138,270 @@ class TpuEngine:
 
             self._emit_lane(seq, np.asarray([token]), [float(lp)],
                             topk_fn, append_inputs=False)
+
+    async def _prefill_budgeted(self) -> bool:
+        """Token-budgeted interleaved prefill step: advance pending
+        sequences' chunk cursors by at most prefill_chunk_budget prompt
+        tokens in ONE chunk round, instead of running every chunk round
+        back-to-back under the device lock. Decode lanes therefore emit
+        tokens BETWEEN a long prompt's chunks — ITL is bounded by one
+        budgeted step, not one full prefill. Where the engine shape
+        allows, the round FUSES with the decode burst in one jitted
+        mixed step (mixed_prefill_decode) so the chunk rides the burst's
+        weight stream; otherwise the round runs alone and _decode_iter
+        interleaves between scheduler iterations. Sequences whose cursor
+        reaches len(prompt) get their first token through the SAME
+        sampling/emission helpers as the legacy path."""
+        pending = [s for s in self._running if not s.prefilled]
+        if not pending:
+            return False
+        mcfg, cfg = self.model_cfg, self.config
+        for s in list(pending):
+            if s.ctx.is_cancelled():
+                # legacy prefill lets cancellation surface at decode;
+                # mid-prefill cursors can idle for many iterations, so
+                # reap here and free the partial pages early
+                self._finish(s, FINISH_CANCELLED)
+                pending.remove(s)
+        if not pending:
+            return True
+        for s in pending:
+            # KVBM/remote onboarding may advance the cached prefix after
+            # admission; the cursor resumes where the cache ends
+            s.prefill_pos = max(s.prefill_pos, s.cached_len)
+        offsets = {id(s): s.prefill_pos for s in pending}
+
+        needs_stage = any(s.import_kv is not None for s in pending) or (
+            self._sp_params is not None
+            and cfg.sp_threshold > 0
+            and any(offsets[id(s)] == 0
+                    and len(s.prompt) >= cfg.sp_threshold
+                    for s in pending))
+        if needs_stage:
+            # disagg imports land before any chunk touches the pages; SP
+            # bulk prefill is ONE ring dispatch covering >= half of an
+            # eligible novel long prompt — it deliberately overruns the
+            # token budget once (the ring kernel is the cheaper way to
+            # move that many tokens; docs/scheduler.md)
+            def stage():
+                for seq in pending:
+                    if seq.import_kv is not None:
+                        data, n_tok = seq.import_kv
+                        n_pages = (n_tok + mcfg.page_size - 1) \
+                            // mcfg.page_size
+                        self.write_kv_pages(seq.pages[:n_pages], data)
+                        seq.import_kv = None
+                if self._sp_params is not None:
+                    self._sp_bulk_prefill(pending, offsets)
+
+            async with self._device_lock:
+                await asyncio.to_thread(stage)
+            for s in pending:
+                s.prefill_pos = offsets[id(s)]
+
+        # pick chunks in arrival order up to the budget, aligned group
+        # first (mirrors _chunk_round_once's grouping, so the picks ARE
+        # the round's active set)
+        aligned_s = [s for s in pending
+                     if offsets[id(s)] % mcfg.page_size == 0]
+        pool_ = aligned_s or pending
+        aligned = bool(aligned_s)
+        picks: list[_Seq] = []
+        caps: dict[int, int] = {}
+        rem = cfg.prefill_chunk_budget
+        for s in pool_:
+            if rem <= 0 or len(picks) >= cfg.max_batch_size:
+                break
+            take = min(len(s.prompt) - offsets[id(s)],
+                       cfg.prefill_chunk, rem)
+            if take <= 0:
+                continue
+            picks.append(s)
+            caps[id(s)] = take
+            rem -= take
+        if not picks:
+            return needs_stage
+        picks = picks[:self._prefill_width(len(picks))]
+        chunk_lens = [caps[id(s)] for s in picks]
+        self.perf["prefill_new_tokens"] += sum(chunk_lens)
+
+        # fuse the round with a decode burst when nothing forces a
+        # special burst shape: no burst already in flight, no draft/pp
+        # engine, and no decode lane needing the constrained head.
+        # Fallback is NOT a stall — the round runs alone and
+        # _decode_iter still interleaves between iterations.
+        runnable = [s for s in self._running if s.prefilled]
+        k_steps = cfg.decode_steps_per_sync
+        batch: list[_Seq] = []
+        if (runnable and self._inflight is None
+                and self.draft_params is None and cfg.pp_mesh is None):
+            self._prep_decode_lanes(runnable, k_steps)
+            batch = runnable[:cfg.max_batch_size]
+            if any(s.needs_constrained for s in batch):
+                batch = []
+        if batch:
+            return await self._mixed_step(picks, offsets, caps, batch,
+                                          k_steps, aligned)
+
+        def round_():
+            if cfg.pp_mesh is not None:
+                return self._pp_chunk_round(picks, offsets, caps)
+            kc, vc, done, _ = self._chunk_round_once(
+                self.params, mcfg, self.k_cache, self.v_cache, picks,
+                offsets, tokens_of=lambda s: s.prompt,
+                target_len_of=lambda s: len(s.prompt), caps=caps)
+            self.k_cache, self.v_cache = kc, vc
+            return done
+
+        async with self._device_lock:
+            done_logits = await asyncio.to_thread(round_)
+        for s in picks:
+            s.prefill_pos = offsets[id(s)]
+        await self._finish_first_tokens(picks, done_logits)
         return True
+
+    async def _mixed_step(self, picks: list[_Seq], offsets, caps,
+                          batch: list[_Seq], k_steps: int,
+                          aligned: bool) -> bool:
+        """Dispatch ONE jitted mixed prefill+decode step: the picks'
+        chunk sub-batch and the decode burst share the device dispatch
+        (and each layer's weight stream). Decode lanes' tokens emit from
+        this step exactly as a plain burst's would."""
+        cfg, mcfg = self.config, self.model_cfg
+        bp = self._prefill_width(len(picks))
+        chunk_lens = [caps[id(s)] for s in picks]
+        t_bucket = _next_bucket(max(chunk_lens), cfg.min_prefill_bucket,
+                                cfg.prefill_chunk, align=mcfg.page_size)
+        ch_toks = np.zeros((bp, t_bucket), dtype=np.int32)
+        ch_tables = np.zeros((bp, mcfg.max_pages_per_seq),
+                             dtype=np.int32)
+        ch_cached = np.zeros(bp, dtype=np.int32)
+        ch_seq_lens = np.zeros(bp, dtype=np.int32)
+        for i, s in enumerate(picks):
+            off, n = offsets[id(s)], chunk_lens[i]
+            ch_toks[i, :n] = s.prompt[off:off + n]
+            ch_tables[i, :len(s.pages)] = s.pages
+            ch_cached[i] = off
+            ch_seq_lens[i] = off + n
+
+        b = cfg.max_batch_size
+        tokens = np.zeros(b, dtype=np.int32)
+        positions = np.zeros(b, dtype=np.int32)
+        page_tables = np.zeros((b, mcfg.max_pages_per_seq),
+                               dtype=np.int32)
+        valid = np.zeros(b, dtype=bool)
+        seeds = np.zeros(b, dtype=np.uint32)
+        steps = np.zeros(b, dtype=np.uint32)
+        temps = np.zeros(b, dtype=np.float32)
+        top_ps = np.ones(b, dtype=np.float32)
+        top_ks = np.zeros(b, dtype=np.int32)
+        for i, s in enumerate(batch):
+            tokens[i] = s.next_token
+            positions[i] = s.pos
+            page_tables[i, :len(s.pages)] = s.pages
+            valid[i] = True
+            seeds[i] = s.seed
+            steps[i] = s.generated
+            temps[i] = s.req.sampling.temperature
+            top_ps[i] = s.req.sampling.top_p
+            top_ks[i] = s.req.sampling.top_k
+        tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
+
+        def dispatch():
+            packed, ch_logits, kc, vc = mixed_prefill_decode(
+                self.params, self.k_cache, self.v_cache,
+                jax.numpy.asarray(ch_toks),
+                jax.numpy.asarray(ch_tables),
+                jax.numpy.asarray(ch_cached),
+                jax.numpy.asarray(ch_seq_lens),
+                jax.numpy.asarray(tokens), jax.numpy.asarray(positions),
+                jax.numpy.asarray(page_tables),
+                jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
+                mcfg, k_steps, aligned, tk)
+            # ONE host sync; chunk logits stay on device for the
+            # first-token sampler
+            return np.asarray(packed), ch_logits, kc, vc
+
+        async with self._device_lock:
+            packed, ch_logits, self.k_cache, self.v_cache = \
+                await asyncio.to_thread(dispatch)
+        self.perf["prefill_chunks"] += 1
+        self.perf["mixed_steps"] += 1
+        self.perf["decode_steps_during_prefill"] += k_steps
+        done_logits: dict[int, Any] = {}
+        for i, s in enumerate(picks):
+            offsets[id(s)] += chunk_lens[i]
+            s.prefill_pos = offsets[id(s)]
+            if s.prefill_pos >= len(s.prompt):
+                done_logits[id(s)] = ch_logits[i]
+        self._emit_burst(batch, packed, k_steps, tk)
+        await self._finish_first_tokens(picks, done_logits)
+        return True
+
+    async def _finish_first_tokens(self, picks: list[_Seq],
+                                   done_logits: dict[int, Any]) -> None:
+        """Sample + emit first tokens for the picks whose cursor reached
+        the end of the prompt this round (budgeted path: the draft cache
+        saw none of the prompt, so draft_pos stays 0 and _draft_catchup
+        replays it before the first spec burst)."""
+        completed = [s for s in picks if id(s) in done_logits]
+        if not completed:
+            return
+        async with self._device_lock:
+            packed, tk = await asyncio.to_thread(
+                self._first_token_packed, completed, done_logits)
+        self._emit_first_tokens(completed, packed, tk, draft_done=False)
+
+    def _pp_chunk_round(self, picks: list[_Seq], offsets,
+                        caps) -> dict[int, Any]:
+        """Budgeted chunk round on a pipeline-parallel engine: one
+        pp_prefill_paged call over the picks' capped chunks (the pp
+        analog of _chunk_round_once; cached = the cursor). Returns
+        {id(s): last-token logits} for completions."""
+        from dynamo_tpu.models.llama_pp import pp_prefill_paged
+
+        cfg, mcfg = self.config, self.model_cfg
+        n_stages = cfg.pp_mesh.shape["pp"]
+        chunk = min(cfg.prefill_chunk, 128)
+        takes = [caps[id(s)] for s in picks]
+        t_pad = _next_pow2(max(max(takes), chunk * n_stages), chunk,
+                           1 << 30)
+        b_pad = _next_pow2(len(picks), 1, cfg.max_batch_size)
+        tokens = np.zeros((b_pad, t_pad), dtype=np.int32)
+        tables = np.zeros((b_pad, mcfg.max_pages_per_seq),
+                          dtype=np.int32)
+        cached = np.zeros(b_pad, dtype=np.int32)
+        seq_lens = np.zeros(b_pad, dtype=np.int32)
+        for i, s in enumerate(picks):
+            off, n = offsets[id(s)], takes[i]
+            tokens[i, :n] = s.prompt[off:off + n]
+            tables[i, :len(s.pages)] = s.pages
+            cached[i] = off
+            seq_lens[i] = off + n
+        logits, self.k_cache, self.v_cache = pp_prefill_paged(
+            self.params, self.k_cache, self.v_cache,
+            jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
+            cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
+        self.perf["prefill_chunks"] += 1
+        done: dict[int, Any] = {}
+        for i, s in enumerate(picks):
+            offsets[id(s)] += takes[i]
+            if offsets[id(s)] >= len(s.prompt):
+                done[id(s)] = logits[i]
+        return done
 
     # -- decode -------------------------------------------------------------
 
-    async def _decode_iter(self) -> bool:
-        if self._inflight is not None:
-            return await self._pipeline_consume()
-        runnable = [s for s in self._running if s.prefilled]
-        if not runnable:
-            return False
-        mcfg, cfg = self.model_cfg, self.config
-        # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
-        # compilation for the engine's lifetime. Underfull lanes/steps waste
-        # a little compute; recompiles (tens of seconds) waste far more.
-        # Spec bursts serve EVERY sampling config (the rejection test
-        # runs on each lane's FILTERED, penalty-adjusted, DFA-masked
-        # distribution — engine/spec.py), so a draft engine always
-        # speculates; only non-spec engines route constrained lanes to
-        # the constrained burst.
-        use_spec = self.draft_params is not None
-        k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
-                   if use_spec else cfg.decode_steps_per_sync)
+    def _prep_decode_lanes(self, runnable: list[_Seq],
+                           k_steps: int) -> None:
+        """Ready `runnable` (mutated in place) for a k_steps decode
+        burst: drop cancelled lanes, and grow every lane's page list to
+        cover pos .. pos+k_steps-1 — preempting victims when the pool
+        runs dry. Shared by _decode_iter and the budgeted scheduler's
+        mixed dispatch so preemption semantics can't diverge."""
+        mcfg = self.model_cfg
         # every runnable seq needs pages covering pos .. pos+k_steps-1
         for s in list(runnable):
             if s not in runnable:
@@ -1122,6 +1426,26 @@ class TpuEngine:
                     runnable.remove(s)
                     break
                 s.pages.append(pid)
+
+    async def _decode_iter(self) -> bool:
+        if self._inflight is not None:
+            return await self._pipeline_consume()
+        runnable = [s for s in self._running if s.prefilled]
+        if not runnable:
+            return False
+        mcfg, cfg = self.model_cfg, self.config
+        # Fixed burst length + fixed batch width below ⇒ exactly ONE decode
+        # compilation for the engine's lifetime. Underfull lanes/steps waste
+        # a little compute; recompiles (tens of seconds) waste far more.
+        # Spec bursts serve EVERY sampling config (the rejection test
+        # runs on each lane's FILTERED, penalty-adjusted, DFA-masked
+        # distribution — engine/spec.py), so a draft engine always
+        # speculates; only non-spec engines route constrained lanes to
+        # the constrained burst.
+        use_spec = self.draft_params is not None
+        k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
+                   if use_spec else cfg.decode_steps_per_sync)
+        self._prep_decode_lanes(runnable, k_steps)
         if not runnable:
             return False
         b = cfg.max_batch_size
@@ -1146,6 +1470,11 @@ class TpuEngine:
         # top-k alternatives ride the packed burst only when some lane
         # asked (separate compiled variant; hot path unaffected)
         tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
+        if any(not s.prefilled for s in self._running):
+            # decode progressed while some prompt's prefill is still
+            # mid-flight — the interleaving the budgeted scheduler
+            # exists to create (every path below dispatches a burst)
+            self.perf["decode_steps_during_prefill"] += k_steps
         max_pages = mcfg.max_pages_per_seq
         tokens = np.zeros(b, dtype=np.int32)
         positions = np.zeros(b, dtype=np.int32)
@@ -1528,58 +1857,81 @@ class TpuEngine:
         seq id). Shared by prompt prefill (target AND draft) and the
         draft catch-up replay, so bucketing/compile shapes can't diverge
         between them."""
-        cfg = self.config
         last_logits: dict[int, Any] = {}
         while True:
             ready = [s for s in seqs if offsets[id(s)] < target_len_of(s)]
             if not ready:
                 break
-            # rounds are grouped by page-alignment of the cached
-            # offset: mid-page starts (disagg imports) need the row
-            # write path — batching them with aligned lanes would
-            # drag everyone onto it
-            aligned_s = [s for s in ready
-                         if offsets[id(s)] % model_cfg.page_size == 0]
-            active = aligned_s or ready
-            aligned = bool(aligned_s)
-            # pow2 batch width: compiles stay bounded to log2 widths
-            # per bucket while low-concurrency prefill (compute-bound,
-            # unlike decode) avoids paying max_batch_size× the FLOPs
-            if cfg.prefill_batch_widths:
-                bp = next((w for w in cfg.prefill_batch_widths
-                           if w >= len(active)),
-                          cfg.prefill_batch_widths[-1])
-                bp = min(bp, cfg.max_batch_size)
-            else:
-                bp = _next_pow2(len(active), 1, cfg.max_batch_size)
-            active = active[:bp]
-            chunk_lens = [min(target_len_of(s) - offsets[id(s)],
-                              cfg.prefill_chunk) for s in active]
-            t_bucket = _next_bucket(max(chunk_lens),
-                                    cfg.min_prefill_bucket,
-                                    cfg.prefill_chunk,
-                                    align=model_cfg.page_size)
-            toks = np.zeros((bp, t_bucket), dtype=np.int32)
-            tables = np.zeros((bp, model_cfg.max_pages_per_seq),
-                              dtype=np.int32)
-            cached = np.zeros(bp, dtype=np.int32)
-            seq_lens = np.zeros(bp, dtype=np.int32)
-            for i, s in enumerate(active):
-                off, n = offsets[id(s)], chunk_lens[i]
-                toks[i, :n] = tokens_of(s)[off:off + n]
-                tables[i, :len(s.pages)] = s.pages
-                cached[i] = off
-                seq_lens[i] = off + n
-            logits_b, kc, vc = prefill_batch(
-                params_, kc, vc,
-                jax.numpy.asarray(toks), jax.numpy.asarray(tables),
-                jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
-                model_cfg, aligned)
-            for i, s in enumerate(active):
-                offsets[id(s)] += chunk_lens[i]
-                if offsets[id(s)] >= target_len_of(s):
-                    last_logits[id(s)] = logits_b[i]
+            kc, vc, done, _ = self._chunk_round_once(
+                params_, model_cfg, kc, vc, ready, offsets, tokens_of,
+                target_len_of)
+            last_logits.update(done)
         return kc, vc, last_logits
+
+    def _prefill_width(self, n: int) -> int:
+        """Compile-bounded prefill batch width for an n-sequence round:
+        pow2 (compiles stay bounded to log2 widths per T bucket while
+        low-concurrency prefill — compute-bound, unlike decode — avoids
+        paying max_batch_size× the FLOPs), or the configured
+        prefill_batch_widths ladder."""
+        cfg = self.config
+        if cfg.prefill_batch_widths:
+            bp = next((w for w in cfg.prefill_batch_widths if w >= n),
+                      cfg.prefill_batch_widths[-1])
+            return min(bp, cfg.max_batch_size)
+        return _next_pow2(n, 1, cfg.max_batch_size)
+
+    def _chunk_round_once(self, params_, model_cfg, kc, vc, ready,
+                          offsets, tokens_of, target_len_of, caps=None):
+        """ONE batched prefill chunk round: group by page-alignment,
+        pick the pow2 batch width and T bucket, run prefill_batch, and
+        advance the offsets. `caps` (optional {id(s): max_tokens})
+        bounds each sequence's chunk below cfg.prefill_chunk — the
+        budgeted scheduler's token budget. Returns (kc, vc,
+        {id(s): last-token logits} for sequences whose offset REACHED
+        target this round, tokens consumed)."""
+        cfg = self.config
+        # rounds are grouped by page-alignment of the cached
+        # offset: mid-page starts (disagg imports) need the row
+        # write path — batching them with aligned lanes would
+        # drag everyone onto it
+        aligned_s = [s for s in ready
+                     if offsets[id(s)] % model_cfg.page_size == 0]
+        active = aligned_s or ready
+        aligned = bool(aligned_s)
+        bp = self._prefill_width(len(active))
+        active = active[:bp]
+        chunk_lens = [min(target_len_of(s) - offsets[id(s)],
+                          cfg.prefill_chunk,
+                          caps[id(s)] if caps else cfg.prefill_chunk)
+                      for s in active]
+        t_bucket = _next_bucket(max(chunk_lens),
+                                cfg.min_prefill_bucket,
+                                cfg.prefill_chunk,
+                                align=model_cfg.page_size)
+        toks = np.zeros((bp, t_bucket), dtype=np.int32)
+        tables = np.zeros((bp, model_cfg.max_pages_per_seq),
+                          dtype=np.int32)
+        cached = np.zeros(bp, dtype=np.int32)
+        seq_lens = np.zeros(bp, dtype=np.int32)
+        for i, s in enumerate(active):
+            off, n = offsets[id(s)], chunk_lens[i]
+            toks[i, :n] = tokens_of(s)[off:off + n]
+            tables[i, :len(s.pages)] = s.pages
+            cached[i] = off
+            seq_lens[i] = off + n
+        logits_b, kc, vc = prefill_batch(
+            params_, kc, vc,
+            jax.numpy.asarray(toks), jax.numpy.asarray(tables),
+            jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
+            model_cfg, aligned)
+        self.perf["prefill_chunks"] += 1
+        done: dict[int, Any] = {}
+        for i, s in enumerate(active):
+            offsets[id(s)] += chunk_lens[i]
+            if offsets[id(s)] >= target_len_of(s):
+                done[id(s)] = logits_b[i]
+        return kc, vc, done, sum(chunk_lens)
 
     # -- guided decoding ----------------------------------------------------
 
@@ -1589,6 +1941,10 @@ class TpuEngine:
     # layer). Lanes that don't ask pay nothing: the no-topk variant is a
     # separate compiled burst.
     TOPK_WIDTH = 8
+
+    # raw ITL sample FIFO cap (exact percentiles for bench; the
+    # histogram in perf["itl_hist"] is unbounded and wire-published)
+    ITL_SAMPLE_CAP = 8192
 
     MAX_GUIDED_GRAMMARS = 32
     GUIDED_STOP_WIDTH = 8
@@ -1966,6 +2322,18 @@ class TpuEngine:
             if finish is not None:
                 self._finish(seq, finish)
             return 0
+        now = time.monotonic()
+        if seq.last_emit_t:
+            # inter-token latency at the EMISSION boundary — the gap the
+            # consumer actually experiences, including any prefill chunk
+            # rounds that ran between this lane's bursts (the stall the
+            # budgeted scheduler exists to bound)
+            gap_ms = (now - seq.last_emit_t) * 1000.0
+            itl_observe(self.perf["itl_hist"], gap_ms)
+            self.itl_samples.append(gap_ms)
+            if len(self.itl_samples) > self.ITL_SAMPLE_CAP:
+                del self.itl_samples[:-self.ITL_SAMPLE_CAP]
+        seq.last_emit_t = now
         emit_toks = [int(t) for t in toks[:n_emit]]
         guided = seq.guided
         count = seq.has_penalties
@@ -2144,6 +2512,7 @@ class TpuEngine:
             self.model_cfg.page_size, seq.prompt).seq_hashes()
         seq.token_seq = TokenBlockSequence(self.model_cfg.page_size)
         seq.cached_len = 0
+        seq.prefill_pos = 0
         seq.prefilled = False
         self._waiting.insert(0, seq)
 
@@ -2161,4 +2530,12 @@ class TpuEngine:
                 kv_total_blocks=self.pool.capacity,
                 hbm_cache_usage=self.pool.usage()),
             spec_decode_stats=self._spec_stats,
+            scheduler_stats={
+                "prefill_chunks": self.perf["prefill_chunks"],
+                "decode_steps_during_prefill":
+                    self.perf["decode_steps_during_prefill"],
+                "mixed_steps": self.perf["mixed_steps"],
+                "itl_p50_ms": itl_percentile(self.perf["itl_hist"], 0.5),
+                "itl_p99_ms": itl_percentile(self.perf["itl_hist"], 0.99),
+            },
         ))

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.compat import shard_map
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -234,7 +236,7 @@ def sp_mesh(sp: int, devices=None) -> Mesh:
 def _ring_attention_jit(q, k, v, mesh: Mesh, causal: bool, axis: str,
                         layout: str = "contiguous"):
     seq_spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention_local, axis_name=axis,
                           causal=causal, layout=layout),
         mesh=mesh, in_specs=(seq_spec, seq_spec, seq_spec),

@@ -11,6 +11,51 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
+# ---------------------------------------------------------------------------
+# ITL histogram (shared with engine.perf["itl_hist"])
+# ---------------------------------------------------------------------------
+
+# Log-spaced upper edges in ms; the last bucket is open-ended. Fixed at
+# import time so engine counters, _publish_metrics snapshots, and offline
+# analysis all agree on bucket meaning without shipping edges on the wire.
+ITL_BUCKET_EDGES_MS: tuple[float, ...] = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0, float("inf"))
+
+
+def itl_new_hist() -> list[int]:
+    """Fresh zeroed histogram (one count per bucket edge)."""
+    return [0] * len(ITL_BUCKET_EDGES_MS)
+
+
+def itl_observe(hist: list[int], gap_ms: float) -> None:
+    """Count one inter-token gap into `hist` (in place)."""
+    for i, edge in enumerate(ITL_BUCKET_EDGES_MS):
+        if gap_ms <= edge:
+            hist[i] += 1
+            return
+
+
+def itl_percentile(hist: list[int], q: float) -> float | None:
+    """Approximate q-quantile (0..1) from a bucket histogram: the upper
+    edge of the bucket containing the q-th observation (None when empty;
+    the open last bucket reports its lower edge). Histogram quantiles
+    are what the wire carries — exact sample percentiles stay engine-
+    local (TpuEngine keeps a capped raw-sample list for bench)."""
+    total = sum(hist)
+    if total == 0:
+        return None
+    rank = q * total
+    seen = 0
+    for i, n in enumerate(hist):
+        seen += n
+        if seen >= rank and n:
+            edge = ITL_BUCKET_EDGES_MS[i]
+            if edge == float("inf"):
+                return ITL_BUCKET_EDGES_MS[i - 1]
+            return edge
+    return ITL_BUCKET_EDGES_MS[-2]
+
 
 def count_tokens(item: Any) -> int:
     """Tokens carried by one stream item — engine dicts (token_ids) or

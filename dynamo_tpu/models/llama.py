@@ -484,6 +484,175 @@ def decode_multi_step(params: dict, k_cache: jax.Array, v_cache: jax.Array,
     return out, k_cache, v_cache
 
 
+def _mixed_forward(params: dict, k_cache: tuple, v_cache: tuple,
+                   ch_tokens: jax.Array, ch_tables: jax.Array,
+                   ch_cached: jax.Array, ch_seq_lens: jax.Array,
+                   d_tokens: jax.Array, d_positions: jax.Array,
+                   d_tables: jax.Array, d_valid: jax.Array,
+                   cfg: LlamaConfig, aligned: bool
+                   ) -> tuple[jax.Array, jax.Array, tuple, tuple]:
+    """One fused layer sweep over a prefill chunk sub-batch AND one
+    decode step: each layer's weight stream is read once and serves
+    both sub-batches; attention routes through
+    engine.attention.mixed_attention. The sub-batches are different
+    sequences (disjoint page tables and disjoint KV write slots), and
+    each side's ops mirror paged_forward / _decode_once exactly —
+    separate matmuls per sub-batch, never a concatenated one — so the
+    interleaving cannot perturb either side's numerics vs the
+    stand-alone steps. Returns (chunk hidden (Bp, T, E) final-normed,
+    decode hidden (B, E) final-normed, k_cache, v_cache)."""
+    from dynamo_tpu.engine.attention import mixed_attention, use_pallas
+    from dynamo_tpu.engine.kernels import (
+        kv_write_supported,
+        paged_kv_write_pages,
+    )
+
+    Bp, T = ch_tokens.shape
+    B = d_tokens.shape[0]
+    # chunk-side bookkeeping (as paged_forward)
+    xc = params["embed"][ch_tokens]                        # (Bp, T, E)
+    c_positions = ch_cached[:, None] + jnp.arange(T)[None, :]
+    new_valid = c_positions < ch_seq_lens[:, None]
+    page_ids = jnp.take_along_axis(
+        ch_tables, c_positions // cfg.page_size, axis=1)
+    offsets = c_positions % cfg.page_size
+
+    def flat(a):
+        return a.reshape((Bp * T,) + a.shape[2:])
+
+    f_pages, f_offs, f_valid = flat(page_ids), flat(offsets), flat(new_valid)
+    P = cfg.page_size
+    page_path = (aligned and T % P == 0 and use_pallas()
+                 and kv_write_supported(P, cfg.head_dim))
+    if page_path:
+        slot_pages = jnp.where(new_valid[:, ::P], page_ids[:, ::P],
+                               0).reshape(-1)
+
+        def to_blocks(a):
+            a = a.reshape(Bp, T // P, P, cfg.num_kv_heads, cfg.head_dim)
+            return jnp.swapaxes(a, 2, 3).reshape(
+                Bp * (T // P), cfg.num_kv_heads, P, cfg.head_dim)
+
+    # decode-side bookkeeping (as _decode_once)
+    xd = params["embed"][d_tokens]                         # (B, E)
+    d_page_ids = jnp.take_along_axis(
+        d_tables, (d_positions // cfg.page_size)[:, None], axis=1)[:, 0]
+    d_offsets = d_positions % cfg.page_size
+    d_lengths = jnp.where(d_valid, d_positions + 1, 0)
+
+    new_k, new_v = [], []
+    for l in range(cfg.num_layers):
+        lp = _layer_params(params, l)
+        kc, vc = k_cache[l], v_cache[l]
+        hn = rms_norm(xc, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = qkv_proj(hn, lp, cfg)
+        q = q.reshape(Bp, T, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(Bp, T, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, c_positions, cfg.rope_theta)
+        k = rope(k, c_positions, cfg.rope_theta)
+        hnd = rms_norm(xd, lp["attn_norm"], cfg.rms_eps)
+        qd, kd, vd = qkv_proj(hnd, lp, cfg)
+        qd = qd.reshape(B, cfg.num_heads, cfg.head_dim)
+        kd = kd.reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        vd = vd.reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        qd = rope(qd[:, None], d_positions[:, None], cfg.rope_theta)[:, 0]
+        kd = rope(kd[:, None], d_positions[:, None], cfg.rope_theta)[:, 0]
+        if page_path:
+            kc, vc = paged_kv_write_pages(
+                kc, vc, to_blocks(k), to_blocks(v), slot_pages)
+        else:
+            kc, vc = _write_kv(kc, vc, flat(k), flat(v), f_pages, f_offs,
+                               f_valid)
+        kc, vc = _write_kv(kc, vc, kd, vd, d_page_ids, d_offsets, d_valid)
+        attn_d, attn_c = mixed_attention(
+            qd, q, kc, vc, d_lengths, d_tables, ch_tables, c_positions,
+            ch_seq_lens, page_size=cfg.page_size)
+        xc = xc + qm(attn_c.reshape(Bp, T, -1), lp["wo"])
+        xc = xc + _mlp(rms_norm(xc, lp["mlp_norm"], cfg.rms_eps), lp, cfg)
+        xd = xd + qm(attn_d.reshape(B, -1), lp["wo"])
+        xd = xd + _mlp(rms_norm(xd, lp["mlp_norm"], cfg.rms_eps), lp, cfg)
+        new_k.append(kc)
+        new_v.append(vc)
+
+    xc = rms_norm(xc, params["final_norm"], cfg.rms_eps)
+    xd = rms_norm(xd, params["final_norm"], cfg.rms_eps)
+    return xc, xd, tuple(new_k), tuple(new_v)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "num_steps", "aligned", "topk_lp"),
+         donate_argnums=(1, 2))
+def mixed_prefill_decode(params: dict, k_cache: tuple, v_cache: tuple,
+                         ch_tokens: jax.Array, ch_tables: jax.Array,
+                         ch_cached: jax.Array, ch_seq_lens: jax.Array,
+                         tokens: jax.Array, positions: jax.Array,
+                         page_tables: jax.Array, valid: jax.Array,
+                         seeds: jax.Array, steps0: jax.Array,
+                         temperature: jax.Array, top_p: jax.Array,
+                         top_k: jax.Array, cfg: LlamaConfig,
+                         num_steps: int, aligned: bool = False,
+                         topk_lp: int = 0
+                         ) -> tuple[jax.Array, jax.Array, tuple, tuple]:
+    """One jitted MIXED step: a prefill chunk sub-batch rides along with
+    a full decode burst, so decode lanes keep emitting between a long
+    prompt's chunks (the budgeted scheduler's device dispatch).
+
+    Step 0 of the burst fuses with the chunk forward (_mixed_forward —
+    one weight stream for both); steps 1..num_steps-1 are the plain
+    fori_loop decode body. Sampling is exactly decode_multi_step's, so a
+    lane's token stream is identical whether its burst ran mixed or
+    plain. Chunk args are the prefill_batch batch arrays; decode args
+    are the decode_multi_step arrays. Compile shapes bucket on
+    (Bp pow2, T bucket) × the fixed decode width. Returns
+    (packed (2 + 2*topk_lp, num_steps, B) f32, chunk last-token logits
+    (Bp, V) f32, k_cache, v_cache)."""
+    from dynamo_tpu.engine.sampling import (
+        chosen_logprob,
+        sample_tokens_traced,
+        topk_logprobs,
+    )
+
+    xc, xd, k_cache, v_cache = _mixed_forward(
+        params, k_cache, v_cache, ch_tokens, ch_tables, ch_cached,
+        ch_seq_lens, tokens, positions, page_tables, valid, cfg, aligned)
+    last = jnp.maximum(ch_seq_lens - ch_cached - 1, 0)     # (Bp,)
+    x_last = jnp.take_along_axis(xc, last[:, None, None], axis=1)[:, 0]
+    ch_logits = qm(x_last, params["lm_head"]).astype(jnp.float32)
+
+    logits0 = qm(xd, params["lm_head"]).astype(jnp.float32)
+
+    def record(out, i, logits, sampled):
+        chosen = chosen_logprob(logits, sampled)
+        out = out.at[0, i].set(sampled.astype(jnp.float32))
+        out = out.at[1, i].set(chosen)
+        if topk_lp:
+            ids, vals = topk_logprobs(logits, topk_lp)
+            out = lax.dynamic_update_slice(
+                out, ids.T[:, None, :], (2, i, 0))
+            out = lax.dynamic_update_slice(
+                out, vals.T[:, None, :], (2 + topk_lp, i, 0))
+        return out
+
+    out0 = jnp.zeros((2 + 2 * topk_lp, num_steps, tokens.shape[0]),
+                     dtype=jnp.float32)
+    sampled0 = sample_tokens_traced(
+        logits0, seeds, steps0, temperature, top_p, top_k)
+    out0 = record(out0, 0, logits0, sampled0)
+
+    def body(i, carry):
+        toks, kc, vc, out = carry
+        logits, kc, vc = _decode_once(
+            params, kc, vc, toks, positions + i, page_tables, valid, cfg)
+        sampled = sample_tokens_traced(
+            logits, seeds, steps0 + i, temperature, top_p, top_k)
+        return sampled, kc, vc, record(out, i, logits, sampled)
+
+    _, k_cache, v_cache, out = lax.fori_loop(
+        1, num_steps, body, (sampled0, k_cache, v_cache, out0))
+    return out, ch_logits, k_cache, v_cache
+
+
 @partial(jax.jit, static_argnames=("cfg", "num_steps", "topk_lp"),
          donate_argnums=(1, 2))
 def decode_multi_step_guided(params: dict, k_cache, v_cache,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.compat import pcast, shard_map
 from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.models.llama import (
     LlamaConfig,
@@ -84,7 +85,7 @@ def _pp_forward_local(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
     out0 = jnp.zeros((M, Bm, V), jnp.float32)
     x0 = jnp.zeros((Bm, T, E), cfg.dtype)
-    out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
+    out0, x0 = pcast((out0, x0), (axis,), to='varying')
 
     def step(carry, t):
         x_recv, out = carry
@@ -146,7 +147,7 @@ def pp_param_specs(with_bias: bool = False, moe: bool = False) -> dict:
 def _pp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
                     axis: str, n_micro: int):
     n_stages = mesh.shape[axis]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pp_forward_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_micro=n_micro),
         mesh=mesh,
@@ -204,7 +205,7 @@ def _pp_prefill_paged_local(params, kc_all, vc_all, tokens_c,
 
     out0 = jnp.zeros((B, V), jnp.float32)
     x0 = jnp.zeros((B, Tc, E), cfg.dtype)
-    out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
+    out0, x0 = pcast((out0, x0), (axis,), to='varying')
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     def step(carry, r):
@@ -278,7 +279,7 @@ def _pp_prefill_paged_jit(params, k_cache, v_cache, tokens_c,
                           cfg: LlamaConfig, mesh: Mesh, axis: str,
                           n_chunks: int):
     n_stages = mesh.shape[axis]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pp_prefill_paged_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_chunks=n_chunks),
         mesh=mesh,
@@ -364,7 +365,7 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
 
     out0 = jnp.zeros((n_rows, num_steps, M, Bm), jnp.float32)
     x0 = jnp.zeros((Bm, E), cfg.dtype)
-    out0, x0 = lax.pcast((out0, x0), (axis,), to='varying')
+    out0, x0 = pcast((out0, x0), (axis,), to='varying')
     perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
     if use_constrained:
         V = cfg.vocab_size
@@ -478,16 +479,16 @@ def _pp_decode_local(params, k_cache, v_cache, tokens0, positions,
         x_next = lax.ppermute(x, axis, perm_fwd)
         return (x_next, mailbox, gst, counts, kc_all, vc_all, out), None
 
-    mailbox0 = lax.pcast(tokens0, (axis,), to='varying')
+    mailbox0 = pcast(tokens0, (axis,), to='varying')
     if use_constrained:
-        gst0 = lax.pcast(g_states.astype(jnp.int32), (axis,),
+        gst0 = pcast(g_states.astype(jnp.int32), (axis,),
                          to='varying')
-        counts0 = lax.pcast(out_counts.astype(jnp.int32), (axis,),
+        counts0 = pcast(out_counts.astype(jnp.int32), (axis,),
                             to='varying')
     else:
-        gst0 = lax.pcast(jnp.zeros((M, Bm), jnp.int32), (axis,),
+        gst0 = pcast(jnp.zeros((M, Bm), jnp.int32), (axis,),
                          to='varying')
-        counts0 = lax.pcast(jnp.zeros((M, Bm, 1), jnp.int32), (axis,),
+        counts0 = pcast(jnp.zeros((M, Bm, 1), jnp.int32), (axis,),
                             to='varying')
     rounds = total + n_stages - 1
     (_, _, _, _, k_cache, v_cache, out), _ = lax.scan(
@@ -512,7 +513,7 @@ def _pp_decode_jit(params, k_cache, v_cache, tokens, positions,
     n_stages = mesh.shape[axis]
     mb2 = P(None, None)
     mb3 = P(None, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pp_decode_local, cfg=cfg, axis=axis,
                           n_stages=n_stages, n_micro=n_micro,
                           num_steps=num_steps,

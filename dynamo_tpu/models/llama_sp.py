@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.compat import shard_map
 from dynamo_tpu.engine.quant import qm
 from dynamo_tpu.engine.ring_attention import ring_attention_local
 from dynamo_tpu.models.llama import (
@@ -126,7 +127,7 @@ def _param_in_specs(params, tp_axis):
                                     "tp_axis"))
 def _sp_prefill_jit(params, tokens, cfg: LlamaConfig, mesh: Mesh,
                     axis: str, layout: str = "contiguous", tp_axis=None):
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_sp_forward_local, cfg=cfg, axis=axis,
                           layout=layout, tp_axis=tp_axis),
         mesh=mesh,

@@ -117,6 +117,11 @@ class ForwardPassMetrics:
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
     spec_decode_stats: Optional["SpecDecodeStats"] = None
+    # Scheduler stall/interleave counters (engine.perf snapshot:
+    # prefill_chunks, decode_steps_during_prefill, itl_p50_ms/itl_p99_ms
+    # from the ITL histogram). Plain dict so new counters don't need a
+    # wire-schema change; absent on old publishers.
+    scheduler_stats: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = {
@@ -126,6 +131,8 @@ class ForwardPassMetrics:
         }
         if self.spec_decode_stats is not None:
             d["spec_decode_stats"] = self.spec_decode_stats.to_dict()
+        if self.scheduler_stats is not None:
+            d["scheduler_stats"] = self.scheduler_stats
         return d
 
     @classmethod
@@ -143,6 +150,7 @@ class ForwardPassMetrics:
             spec_decode_stats=(
                 SpecDecodeStats(**known(SpecDecodeStats, spec))
                 if spec is not None else None),
+            scheduler_stats=d.get("scheduler_stats"),
         )
 
 

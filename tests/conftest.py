@@ -27,6 +27,14 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tier0: fast smoke suites (`make tier0`, < 60 s total)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate run")
+
+
 @pytest.fixture
 def cpu_mesh_devices():
     return jax.devices("cpu")

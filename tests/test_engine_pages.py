@@ -1,7 +1,11 @@
 """PagePool unit tests: refcounts, prefix reuse, LRU eviction, KV events."""
 
+import pytest
+
 from dynamo_tpu.engine.pages import PagePool
 from dynamo_tpu.tokens import TokenBlockSequence
+
+pytestmark = pytest.mark.tier0
 
 
 def hashes(tokens, bs=4):

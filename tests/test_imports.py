@@ -10,6 +10,8 @@ import pathlib
 
 import pytest
 
+pytestmark = pytest.mark.tier0
+
 PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent / "dynamo_tpu"
 
 

@@ -1,6 +1,8 @@
 """KvRouter + KvPushRouter e2e with mock engines over the runtime
 (reference: tests/router/test_router_e2e_with_mockers.py pattern)."""
 
+import pytest
+
 import asyncio
 
 from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
@@ -15,6 +17,8 @@ from dynamo_tpu.router.kv_router import (
 from dynamo_tpu.runtime.config import RuntimeConfig
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.tier0
 
 BS = 16
 

@@ -6,6 +6,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dynamo_tpu.compat import set_mesh
+
 from dynamo_tpu.models.mixtral import (
     MoeConfig,
     ep_param_specs,
@@ -57,7 +59,7 @@ def test_moe_forward_ep_sharded_matches_unsharded(cpu_mesh_devices):
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, ep_param_specs(),
         is_leaf=lambda x: not isinstance(x, dict))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = moe_forward(sharded, tokens, cfg)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
@@ -116,7 +118,7 @@ def test_capacity_forward_ep_sharded_matches_unsharded(cpu_mesh_devices):
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, ep_param_specs(),
         is_leaf=lambda x: not isinstance(x, dict))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         out = moe_forward(sharded, tokens, cfg, dispatch="capacity")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)

@@ -14,6 +14,8 @@ from dynamo_tpu.engine.pages import (
     PagePool,
 )
 
+pytestmark = pytest.mark.tier0
+
 
 def test_partial_to_registered_lifecycle():
     pool = PagePool(num_pages=8, page_size=4)

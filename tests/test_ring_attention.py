@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dynamo_tpu.compat import shard_map
 from dynamo_tpu.engine.ring_attention import (
     ring_attention,
     ring_attention_local,
@@ -104,7 +105,7 @@ def test_ring_local_inside_custom_shard_map(cpu_mesh_devices):
     q, k, v = (_rand((b, t, h, d), s) for s in (0, 1, 2))
     mesh = sp_mesh(4, cpu_mesh_devices)
     spec = P(None, "sp", None, None)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         functools.partial(ring_attention_local, axis_name="sp"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
     args = [jax.device_put(x, NamedSharding(mesh, spec))

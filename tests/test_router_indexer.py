@@ -1,6 +1,8 @@
 """RadixTree / KvIndexer / ApproxKvIndexer unit tests
 (reference: indexer.rs inline tests, approx.rs)."""
 
+import pytest
+
 from dynamo_tpu.protocols import (
     KV_CLEARED,
     KV_REMOVED,
@@ -14,6 +16,8 @@ from dynamo_tpu.tokens import (
     compute_block_hashes,
     compute_seq_hashes,
 )
+
+pytestmark = pytest.mark.tier0
 
 BS = 4
 

@@ -1,6 +1,8 @@
 """ActiveSequences + DefaultWorkerSelector unit tests
 (reference: scheduler.rs:462-560, sequence.rs tests)."""
 
+import pytest
+
 import random
 
 from dynamo_tpu.router.scheduler import (
@@ -10,6 +12,8 @@ from dynamo_tpu.router.scheduler import (
     SelectorConfig,
     WorkerLoad,
 )
+
+pytestmark = pytest.mark.tier0
 
 
 def test_active_sequences_lifecycle():

@@ -501,9 +501,13 @@ async def test_spec_topk_logprobs_match_no_spec():
         "top-k lanes must keep speculation, not fall back"
     assert len(spec_topks) == len(base_topks) == 12
     for st, bt in zip(spec_topks, base_topks):
-        assert [e[0] for e in st] == [e[0] for e in bt]
+        # the spec and no-spec bursts are separately compiled graphs:
+        # bf16 near-ties can legitimately swap adjacent ALTERNATIVES'
+        # order, so compare the candidate SET and align values by id
+        assert {e[0] for e in st} == {e[0] for e in bt}, (st, bt)
+        bvals = {e[0]: e[1] for e in bt}
         np.testing.assert_allclose([e[1] for e in st],
-                                   [e[1] for e in bt], atol=2e-2)
-        # top-1 is the chosen token under greedy
+                                   [bvals[e[0]] for e in st], atol=2e-2)
+        # top-1 is the chosen token under greedy — order matters THERE
     for t, st in zip(spec_toks, spec_topks):
         assert st[0][0] == t

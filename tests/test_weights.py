@@ -14,6 +14,7 @@ import pytest
 
 import jax.numpy as jnp
 
+from dynamo_tpu.compat import tree_leaves_with_path
 from dynamo_tpu.engine.attention import set_attention_impl
 
 set_attention_impl("xla")
@@ -221,7 +222,7 @@ def test_loader_bit_exact_across_fresh_loads(checkpoint):
     def leaves(p):
         return [(k, np.asarray(x.q) if isinstance(x, QTensor) else
                  np.asarray(x))
-                for k, x in sorted(jax.tree.leaves_with_path(
+                for k, x in sorted(tree_leaves_with_path(
                     p, is_leaf=lambda v: isinstance(v, QTensor)),
                     key=lambda kv: str(kv[0]))]
 

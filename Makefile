@@ -3,7 +3,7 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1
+.PHONY: tier0 tier1 chaos
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -13,3 +13,10 @@ tier0:
 # the full gate the driver runs (everything but slow)
 tier1:
 	$(PYTEST) tests/ -m 'not slow' --continue-on-collection-errors
+
+# robustness gate (docs/robustness.md): deterministic fault injection
+# (seeded — every run sees the same faults) + the chaos soak, which
+# kills/stalls workers mid-stream and requires 100% of requests to
+# complete token-identically. tier0-marked, < 60 s.
+chaos:
+	$(PYTEST) tests/test_faults.py tests/test_chaos.py

@@ -30,6 +30,12 @@ def add_runtime_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--health-check-interval", type=float, default=None,
                    help="idle seconds before a canary probe fires")
     p.add_argument("--health-check-timeout", type=float, default=None)
+    p.add_argument("--request-deadline", type=float, default=None,
+                   help="overall per-request wall clock, seconds "
+                        "(0 = unbounded; stalled requests migrate)")
+    p.add_argument("--stream-idle-timeout", type=float, default=None,
+                   help="max silence between response frames before the "
+                        "stream is declared dead and migrated")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
 
@@ -48,6 +54,10 @@ def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
         cfg.health_check_interval = args.health_check_interval
     if getattr(args, "health_check_timeout", None) is not None:
         cfg.health_check_timeout = args.health_check_timeout
+    if getattr(args, "request_deadline", None) is not None:
+        cfg.request_deadline = args.request_deadline
+    if getattr(args, "stream_idle_timeout", None) is not None:
+        cfg.stream_idle_timeout = args.stream_idle_timeout
     return cfg
 
 

@@ -40,6 +40,12 @@ _LOCAL_PREFILL: dict[int, "PrefillWorkerHandler"] = {}
 # layout ≈ tens of MB — large enough to amortize, small enough to stream.
 DEFAULT_PULL_CHUNK_PAGES = 64
 
+# overall bound on one KV pull (all paths: device / plane / wire). A
+# stalled prefill worker must degrade to local serve, not hang the decode
+# request; the bound is generous because a 70B-scale wire pull is tens of
+# seconds on DCN. 0 disables.
+DEFAULT_PULL_DEADLINE_S = 60.0
+
 
 def _bf16_bytes(arr: np.ndarray) -> tuple[bytes, list[int], str]:
     return arr.tobytes(), list(arr.shape), str(arr.dtype)
@@ -178,12 +184,14 @@ class DecodeWorkerHandler:
                  kv_pull_router: Optional[PushRouter] = None,
                  disagg_router: Optional[DisaggRouter] = None,
                  pull_chunk_pages: int = DEFAULT_PULL_CHUNK_PAGES,
+                 pull_deadline: float = DEFAULT_PULL_DEADLINE_S,
                  prefill_queue_client=None) -> None:
         self.engine = engine
         self.prefill_router = prefill_router
         self.kv_pull_router = kv_pull_router
         self.disagg_router = disagg_router or DisaggRouter()
         self.pull_chunk_pages = pull_chunk_pages
+        self.pull_deadline = pull_deadline
         # pull-model alternative to prefill_router: jobs ride the durable
         # queue, any prefill worker takes them (prefill_queue.py)
         self.prefill_queue_client = prefill_queue_client
@@ -385,7 +393,22 @@ class DecodeWorkerHandler:
             return
 
         # --- 2. pull the KV pages from the owning prefill worker ---
-        kv_data = await self._pull_kv(ktp, context)
+        # Deadline-bounded: a wedged prefill worker mid-pull must degrade
+        # to local serve (re-prefill here), not hang this decode stream.
+        # The transport's own idle/deadline timeouts (runtime config)
+        # surface as ConnectionError inside _pull_kv → None; this bound
+        # also covers the device/plane paths that never touch the wire.
+        import asyncio as _aio
+
+        try:
+            kv_data = await _aio.wait_for(
+                self._pull_kv(ktp, context),
+                self.pull_deadline or None)
+        except _aio.TimeoutError:
+            logger.warning("KV pull for transfer %s exceeded %.1fs; "
+                           "serving locally", ktp.get("transfer_id"),
+                           self.pull_deadline)
+            kv_data = None
         if kv_data is not None:
             logger.info("kv pull path: %s (%d tokens)",
                         self.last_pull_path, int(ktp["prefill_len"]))

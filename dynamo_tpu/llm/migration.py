@@ -6,6 +6,11 @@ the response stream dies (worker crash, connection loss) it retries on a
 far, so generation continues seamlessly mid-stream
 (docs/architecture/request_migration.md).
 
+The trigger is ConnectionError, which the transport raises for worker
+death AND — with deadlines configured (`stream_idle_timeout` /
+`request_deadline`, docs/robustness.md) — for a wedged-but-connected
+worker whose stream went silent. Hangs become migrations.
+
 Sits between Backend and the router: requests/responses at this hop are
 PreprocessedRequest / EngineOutput dicts (token ids, not text), so replayed
 requests append accumulated tokens to the prompt.
@@ -26,6 +31,9 @@ class Migration(Operator):
     def __init__(self, migration_limit: int = 0) -> None:
         super().__init__()
         self.migration_limit = migration_limit
+        # observability: how often streams died and how many were replayed
+        # vs. exhausted (surfaced beside the transport/breaker counters)
+        self.stats = {"migrations": 0, "exhausted": 0}
 
     async def forward(self, request: dict, context: Context
                       ) -> AsyncIterator[dict]:
@@ -53,8 +61,11 @@ class Migration(Operator):
                 return  # clean end of stream
             except ConnectionError as e:
                 if context.is_cancelled() or attempts_left <= 0:
+                    if not context.is_cancelled():
+                        self.stats["exhausted"] += 1
                     raise
                 attempts_left -= 1
+                self.stats["migrations"] += 1
                 logger.warning(
                     "stream for request %s died (%s); migrating "
                     "(%d attempts left, %d tokens accumulated)",

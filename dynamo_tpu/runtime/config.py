@@ -36,6 +36,22 @@ class RuntimeConfig:
     health_check_enabled: bool = False
     health_check_interval: float = 5.0
     health_check_timeout: float = 3.0
+    # Request-path robustness (transport.py / push.py; docs/robustness.md).
+    # Overall per-request wall clock, seconds; 0 = unbounded. Propagated
+    # to the server so an abandoned handler is aborted too.
+    request_deadline: float = 0.0
+    # Max silence between response frames before the stream is declared
+    # dead (raises the Migration-retryable error); 0 = wait forever.
+    stream_idle_timeout: float = 0.0
+    # Extra dial attempts on connection setup (jittered exp backoff).
+    connect_retries: int = 2
+    connect_backoff_base: float = 0.05
+    connect_backoff_max: float = 2.0
+    # Per-instance circuit breaker: consecutive infra failures before the
+    # instance leaves the candidate set, and the open → half-open probe
+    # cooldown, seconds.
+    breaker_fail_limit: int = 3
+    breaker_cooldown: float = 5.0
     # Graceful shutdown drain timeout.
     shutdown_timeout: float = 30.0
     # Arbitrary extra engine/component settings.

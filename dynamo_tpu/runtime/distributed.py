@@ -30,7 +30,18 @@ class DistributedRuntime:
         self.config = config
         self.store = store
         self.transport_server = transport_server
-        self.transport_client = TransportClient()
+        self.transport_client = TransportClient(
+            idle_timeout=config.stream_idle_timeout,
+            deadline=config.request_deadline,
+            connect_retries=config.connect_retries,
+            connect_backoff_base=config.connect_backoff_base,
+            connect_backoff_max=config.connect_backoff_max)
+        # process-wide per-instance circuit breaker: every PushRouter in
+        # this process shares it, so one router's failures steer them all
+        from dynamo_tpu.runtime.breaker import CircuitBreaker
+
+        self.breaker = CircuitBreaker(config.breaker_fail_limit,
+                                      config.breaker_cooldown)
         self.lease_id = lease_id
         # Event plane: the StoreClient exposes pub/sub over its connection;
         # in static (memory) mode a LocalEventBus serves the process.
@@ -40,6 +51,10 @@ class DistributedRuntime:
             store if isinstance(store, EventBus) else LocalEventBus()
         )
         self.metrics = MetricsRegistry("dynamo")
+        # surface retry/timeout/breaker counters on both observability
+        # planes: the `_sys.stats` scrape and the Prometheus registry
+        transport_server.extra_stats = self._robustness_stats
+        self._wire_robustness_metrics()
         self._local_engines: dict[str, AsyncEngine] = {}
         self._shutdown = asyncio.Event()
         self._status_server = None
@@ -50,6 +65,32 @@ class DistributedRuntime:
         self._reregisters: list = []
         if hasattr(store, "on_reconnect"):
             store.on_reconnect.append(self._on_store_reconnect)
+
+    def _robustness_stats(self) -> dict:
+        """Process-level failure-handling counters, merged into the
+        `_sys.stats` scrape (service_stats.py picks them up per address)."""
+        return {"transport": dict(self.transport_client.stats),
+                "breaker": self.breaker.snapshot()}
+
+    def _wire_robustness_metrics(self) -> None:
+        events = self.metrics.gauge(
+            "transport_client_events",
+            "client-side transport events (retries, timeouts) by kind")
+        transitions = self.metrics.gauge(
+            "breaker_transitions",
+            "circuit breaker state transitions by target state")
+        open_g = self.metrics.gauge(
+            "breaker_open_instances",
+            "instances currently filtered from routing (open/half-open)")
+
+        def sync() -> None:
+            for kind, v in self.transport_client.stats.items():
+                events.set(v, kind=kind)
+            for state, n in self.breaker.transitions.items():
+                transitions.set(n, state=state)
+            open_g.set(self.breaker.open_count())
+
+        self.metrics.on_scrape(sync)
 
     def replay_on_reconnect(self, fn) -> None:
         """Register an async callable that re-publishes one

@@ -38,6 +38,10 @@ class ProcessedEndpoints:
     """Merged scrape of one endpoint across its instances."""
 
     endpoints: list[EndpointStats] = field(default_factory=list)
+    # per-address process-level extras: client-side transport counters
+    # (retries, timeouts) + circuit-breaker snapshot, when the scraped
+    # process's runtime wired them in (distributed.py:_robustness_stats)
+    client_stats: dict[str, dict] = field(default_factory=dict)
 
     def total_requests(self) -> int:
         return sum(e.requests for e in self.endpoints)
@@ -72,6 +76,9 @@ class ServiceClient:
                                 instance_id=inst.instance_id,
                                 address=inst.address,
                                 subject=inst.subject, **stat))
+                        if payload.get("client"):
+                            out.client_stats[inst.address] = \
+                                payload["client"]
                         break
                 except ConnectionError:
                     continue  # instance died between watch + scrape

@@ -21,15 +21,29 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from typing import Any, AsyncIterator, Optional
+import random
+from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.runtime import codec
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
+from dynamo_tpu.runtime.faults import FaultInjector
 
 logger = logging.getLogger(__name__)
 
 STREAM_ERR_MSG = "stream disconnected"  # matched by Migration retry logic
+
+# Remaining-budget header (seconds): the client stamps its overall deadline
+# onto the request so the server aborts the handler when the client has
+# already given up — otherwise a timed-out request keeps burning engine
+# steps for a reader that left (reference: context.rs kill signal).
+DEADLINE_HEADER = "x-dyn-deadline-s"
+
+
+class ConnectError(ConnectionError):
+    """Dial failed — no request bytes ever reached the instance, so a
+    router may safely retry a different one (unlike a mid-stream death,
+    where replay is the Migration operator's job)."""
 
 
 class TransportServer:
@@ -47,6 +61,10 @@ class TransportServer:
         # per-subject service stats, scrapable via STATS_SUBJECT
         # (the reference's NATS $SRV.STATS analog)
         self.stats: dict[str, dict] = {}
+        # optional process-level extras merged into the stats scrape
+        # (the runtime wires client/breaker counters here so routers'
+        # failure handling is observable from the same endpoint)
+        self.extra_stats: Optional[Callable[[], dict]] = None
 
     def _stat(self, subject: str) -> dict:
         return self.stats.setdefault(subject, {
@@ -75,10 +93,21 @@ class TransportServer:
             self._server.close()
         # Force-close live connections: wait_closed() blocks on connection
         # handlers, which block on reads from clients that may never close.
-        for w in list(self._conn_writers):
+        writers = list(self._conn_writers)
+        for w in writers:
             w.close()
         for t in list(self._conn_tasks):
             t.cancel()
+        if writers:
+            # bounded flush of the transports: without it every stop()
+            # leaks half-closed sockets (test warnings, fd pressure); the
+            # bound keeps a peer that never ACKs from wedging shutdown
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(w.wait_closed() for w in writers),
+                                   return_exceptions=True), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
         if self._server is not None:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
@@ -105,10 +134,19 @@ class TransportServer:
             ctx = inflight[rid][1]
             if subject == self.STATS_SUBJECT:
                 try:
-                    # builtin scrape: snapshot of every subject's counters
+                    # builtin scrape: snapshot of every subject's counters,
+                    # plus process-level client/breaker counters when the
+                    # runtime wired them in
+                    extra = None
+                    if self.extra_stats is not None:
+                        try:
+                            extra = self.extra_stats()
+                        except Exception:
+                            logger.exception("extra_stats callback failed")
                     await send({"t": "data", "rid": rid,
                                 "payload": {"stats": self.stats,
-                                            "address": self.address}})
+                                            "address": self.address,
+                                            "client": extra}})
                     await send({"t": "end", "rid": rid})
                 finally:
                     inflight.pop(rid, None)
@@ -130,6 +168,23 @@ class TransportServer:
             stat["requests"] += 1
             stat["inflight"] += 1
             t0 = _time.perf_counter()
+            # Server-side deadline: the client stamped its overall budget
+            # on the request; once it passes, the client is gone (its own
+            # timer fired first), so abort the handler instead of
+            # generating into the void. Cancelling ctx first makes this
+            # look like a user cancel — no error frame needed.
+            timer: Optional[asyncio.TimerHandle] = None
+            deadline_s = (headers or {}).get(DEADLINE_HEADER)
+            if deadline_s:
+                task_ref = asyncio.current_task()
+
+                def _expire() -> None:
+                    ctx.cancel()
+                    if task_ref is not None:
+                        task_ref.cancel()
+
+                timer = asyncio.get_running_loop().call_later(
+                    float(deadline_s) + 0.05, _expire)
             try:
                 # server span: the request's trace continues across the
                 # wire via the traceparent header (logging.rs W3C prop)
@@ -163,6 +218,8 @@ class TransportServer:
                 except Exception:
                     pass
             finally:
+                if timer is not None:
+                    timer.cancel()
                 stat["inflight"] -= 1
                 stat["total_processing_s"] += _time.perf_counter() - t0
                 inflight.pop(rid, None)
@@ -200,16 +257,24 @@ class TransportServer:
 class _Connection:
     """One pooled client connection; demultiplexes response streams."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str,
+                 injector: Optional[FaultInjector] = None,
+                 stats: Optional[dict] = None) -> None:
         self.address = address
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._streams: dict[str, asyncio.Queue] = {}
+        self._subjects: dict[str, str] = {}  # rid → subject (fault matching)
         self._rx_task: Optional[asyncio.Task] = None
         self._write_lock = asyncio.Lock()
+        self._injector = injector
+        self._stats = stats
+        self._decode_error_logged = False
         self.closed = False
 
     async def connect(self) -> None:
+        if self._injector is not None:
+            self._injector.check_connect(self.address)
         host, _, port = self.address.rpartition(":")
         self._reader, self._writer = await asyncio.open_connection(host, int(port))
         self._rx_task = asyncio.get_running_loop().create_task(self._rx_loop())
@@ -218,16 +283,48 @@ class _Connection:
         assert self._reader is not None
         try:
             while True:
-                msg = await codec.read_frame(self._reader)
-                q = self._streams.get(msg.get("rid"))
+                try:
+                    msg = await codec.read_frame(self._reader)
+                except ConnectionError:
+                    break
+                except Exception:
+                    # Corrupt/undecodable frame: the framing state is
+                    # suspect, so the only safe recovery is dropping the
+                    # connection — but say which peer sent it (once per
+                    # connection) and count it, or undecodable peers are
+                    # undiagnosable.
+                    if self._stats is not None:
+                        self._stats["decode_errors"] = \
+                            self._stats.get("decode_errors", 0) + 1
+                    if not self._decode_error_logged:
+                        self._decode_error_logged = True
+                        logger.warning(
+                            "undecodable frame from %s; dropping the "
+                            "connection", self.address, exc_info=True)
+                    break
+                rid = msg.get("rid")
+                if self._injector is not None:
+                    action = self._injector.on_frame(
+                        self.address, self._subjects.get(rid), rid, msg)
+                    if action is not None:
+                        if action[0] == "drop":
+                            continue          # silently stalled stream
+                        if action[0] == "kill":
+                            break             # as if the peer vanished
+                        if action[0] == "delay":
+                            await asyncio.sleep(action[1])
+                        elif action[0] == "err":
+                            msg = {"t": "err", "rid": rid,
+                                   "error": action[1]}
+                q = self._streams.get(rid)
                 if q is not None:
                     q.put_nowait(msg)
         except asyncio.CancelledError:
             pass
-        except Exception:  # ConnectionError or a corrupt/undecodable frame
-            pass
         finally:
             self.closed = True
+            if self._writer is not None:
+                self._writer.close()
             for q in list(self._streams.values()):
                 q.put_nowait({"t": "err", "error": STREAM_ERR_MSG})
 
@@ -238,13 +335,17 @@ class _Connection:
             codec.write_frame(self._writer, obj)
             await self._writer.drain()
 
-    def open_stream(self, rid: str) -> asyncio.Queue:
+    def open_stream(self, rid: str, subject: Optional[str] = None
+                    ) -> asyncio.Queue:
         q: asyncio.Queue = asyncio.Queue()
         self._streams[rid] = q
+        if subject is not None:
+            self._subjects[rid] = subject
         return q
 
     def close_stream(self, rid: str) -> None:
         self._streams.pop(rid, None)
+        self._subjects.pop(rid, None)
 
     def close(self) -> None:
         self.closed = True
@@ -255,43 +356,105 @@ class _Connection:
 
 
 class TransportClient:
-    """Pooled connections keyed by address, with streaming request API."""
+    """Pooled connections keyed by address, with streaming request API.
 
-    def __init__(self) -> None:
+    Robustness knobs (all default-off / conservative, usually set from
+    `RuntimeConfig` by the runtime):
+
+    - ``idle_timeout``: max seconds between response frames. A stream that
+      goes silent longer raises ``ConnectionError(STREAM_ERR_MSG)`` — the
+      exact signal the Migration operator replays on, turning a
+      wedged-but-connected worker into a recovery instead of a hang.
+    - ``deadline``: overall per-request wall clock; also stamped onto the
+      request (`DEADLINE_HEADER`) so the server aborts the handler.
+    - ``connect_retries`` + jittered exponential backoff on dial failure;
+      exhaustion raises `ConnectError` so routers can try another instance.
+    """
+
+    def __init__(self, *, idle_timeout: float = 0.0, deadline: float = 0.0,
+                 connect_retries: int = 2,
+                 connect_backoff_base: float = 0.05,
+                 connect_backoff_max: float = 2.0,
+                 fault_injector: Optional[FaultInjector] = None) -> None:
         self._conns: dict[str, _Connection] = {}
         self._rids = itertools.count(1)
         # Per-address locks: a black-holed host must not head-of-line-block
         # connection setup to healthy addresses.
         self._locks: dict[str, asyncio.Lock] = {}
+        self.idle_timeout = idle_timeout
+        self.deadline = deadline
+        self.connect_retries = connect_retries
+        self.connect_backoff_base = connect_backoff_base
+        self.connect_backoff_max = connect_backoff_max
+        self.fault_injector = fault_injector or FaultInjector.from_env()
+        self._rng = random.Random()
+        # client-side robustness counters (scraped via the server's
+        # `_sys.stats` extras + exported through runtime metrics)
+        self.stats: dict[str, int] = {
+            "connect_retries": 0, "connect_failures": 0,
+            "idle_timeouts": 0, "deadline_exceeded": 0,
+            "decode_errors": 0, "route_retries": 0,
+        }
 
     async def _conn(self, address: str) -> _Connection:
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:
             conn = self._conns.get(address)
-            if conn is None or conn.closed:
-                conn = _Connection(address)
-                await conn.connect()
+            if conn is not None and not conn.closed:
+                return conn
+            last: Optional[Exception] = None
+            for attempt in range(self.connect_retries + 1):
+                if attempt:
+                    # full-jitter exponential backoff: desynchronises the
+                    # redial herd when a popular worker restarts
+                    delay = min(self.connect_backoff_max,
+                                self.connect_backoff_base
+                                * (2 ** (attempt - 1)))
+                    delay *= 0.5 + self._rng.random()
+                    self.stats["connect_retries"] += 1
+                    await asyncio.sleep(delay)
+                conn = _Connection(address, injector=self.fault_injector,
+                                   stats=self.stats)
+                try:
+                    await conn.connect()
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    continue
                 self._conns[address] = conn
-            return conn
+                return conn
+            self.stats["connect_failures"] += 1
+            raise ConnectError(
+                f"connect to {address} failed after "
+                f"{self.connect_retries + 1} attempts: {last!r}") from last
 
     async def request(self, address: str, subject: str, payload: Any,
-                      context: Optional[Context] = None) -> AsyncIterator[Any]:
+                      context: Optional[Context] = None, *,
+                      idle_timeout: Optional[float] = None,
+                      deadline: Optional[float] = None) -> AsyncIterator[Any]:
         """Send one request; yield response payloads until end.
 
-        Raises ConnectionError(STREAM_ERR_MSG) if the stream dies mid-way —
-        the signal the Migration operator retries on.
+        Raises ConnectionError(STREAM_ERR_MSG) if the stream dies mid-way
+        OR stalls past the idle timeout / overall deadline — the signal the
+        Migration operator retries on. Per-call timeouts override the
+        client-level defaults; 0 disables.
         """
         from dynamo_tpu.runtime.tracing import inject_headers
 
         ctx = context or Context()
+        idle = self.idle_timeout if idle_timeout is None else idle_timeout
+        total = self.deadline if deadline is None else deadline
+        loop = asyncio.get_running_loop()
+        expires = loop.time() + total if total else None
         conn = await self._conn(address)
         rid = f"{ctx.request_id}.{next(self._rids)}"
         cancel_task = None
         try:
-            q = conn.open_stream(rid)
+            q = conn.open_stream(rid, subject)
+            headers = inject_headers(dict(ctx.headers))
+            if total:
+                headers[DEADLINE_HEADER] = total
             await conn.send({"t": "req", "rid": rid, "subject": subject,
-                             "payload": payload,
-                             "headers": inject_headers(dict(ctx.headers))})
+                             "payload": payload, "headers": headers})
 
             async def watch_cancel() -> None:
                 await ctx.wait_cancelled()
@@ -303,7 +466,33 @@ class TransportClient:
 
             cancel_task = asyncio.get_running_loop().create_task(watch_cancel())
             while True:
-                msg = await q.get()
+                timeout = idle if idle else None
+                if expires is not None:
+                    remaining = expires - loop.time()
+                    timeout = (remaining if timeout is None
+                               else min(timeout, remaining))
+                if timeout is None:
+                    msg = await q.get()
+                else:
+                    try:
+                        msg = (await asyncio.wait_for(q.get(), timeout)
+                               if timeout > 0 else None)
+                    except asyncio.TimeoutError:
+                        msg = None
+                if msg is None:
+                    # Stalled stream or blown deadline: abort the server
+                    # side (best effort) and surface the Migration-visible
+                    # error so the request is replayed, not hung.
+                    kind = ("deadline_exceeded"
+                            if expires is not None
+                            and loop.time() >= expires
+                            else "idle_timeouts")
+                    self.stats[kind] += 1
+                    try:
+                        await conn.send({"t": "cancel", "rid": rid})
+                    except ConnectionError:
+                        pass
+                    raise ConnectionError(STREAM_ERR_MSG)
                 t = msg.get("t")
                 if t == "data":
                     yield msg["payload"]

@@ -14,6 +14,7 @@ device-to-device is the planned fast path.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any, AsyncIterator, Optional
 
@@ -39,6 +40,10 @@ _LOCAL_PREFILL: dict[int, "PrefillWorkerHandler"] = {}
 # and lets the consumer overlap receive with assembly. 64 pages of a 70B
 # layout ≈ tens of MB — large enough to amortize, small enough to stream.
 DEFAULT_PULL_CHUNK_PAGES = 64
+
+# strong refs to in-flight fire-and-forget transfer aborts (a bare
+# create_task result may be GC'd mid-flight)
+_ABORT_TASKS: set = set()
 
 # overall bound on one KV pull (all paths: device / plane / wire). A
 # stalled prefill worker must degrade to local serve, not hang the decode
@@ -88,6 +93,14 @@ class PrefillWorkerHandler:
         are still in flight (VERDICT r1 #6: the single-frame transfer
         was hundreds of MB for 70B-scale KV)."""
         tid = request["transfer_id"]
+        if request.get("abort"):
+            # the decode side gave up on this pull (deadline fired /
+            # degraded to local serve): release the pinned pages now
+            # instead of holding page-pool capacity until the TTL
+            # reaper; complete_transfer is an idempotent pop
+            self.engine.complete_transfer(tid)
+            yield {"aborted": True}
+            return
         try:
             pages, prefill_len = self.engine.take_transfer(tid)
         except KeyError:
@@ -218,6 +231,37 @@ class DecodeWorkerHandler:
         return len(self.engine.pool.match_prefix(hashes)) \
             * self.engine.model_cfg.page_size
 
+    def _abort_remote_transfer(self, ktp: dict) -> None:
+        """Fire-and-forget release of a failed/expired pull's pinned
+        pages on the owning prefill worker. Without it a 60 s pin of a
+        transfer nobody will pull again wastes page-pool capacity there;
+        the device path released on cancellation already, so the abort's
+        pop is idempotent. Uses a fresh Context — the request's own may
+        be cancelled or past its deadline."""
+        if self.kv_pull_router is None:
+            return
+
+        async def _abort() -> None:
+            try:
+                async for _ in self.kv_pull_router.direct(
+                        {"transfer_id": ktp["transfer_id"], "abort": True},
+                        ktp["instance_id"], Context()):
+                    break
+            except Exception:
+                logger.debug("transfer abort for %s not delivered",
+                             ktp["transfer_id"], exc_info=True)
+
+        task = asyncio.get_running_loop().create_task(
+            asyncio.wait_for(_abort(), 5.0))
+        _ABORT_TASKS.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            _ABORT_TASKS.discard(t)
+            if not t.cancelled():
+                t.exception()  # best effort: swallow the wait_for timeout
+
+        task.add_done_callback(_done)
+
     async def _pull_kv(self, ktp: dict, context: Context):
         """Fetch the pinned pages. Device path when the owning prefill
         engine lives in this process (gather on its devices → device_put
@@ -228,33 +272,45 @@ class DecodeWorkerHandler:
         if src is not None:
             import jax
 
+            tid = ktp["transfer_id"]
             try:
-                import asyncio as _aio
-
-                pages, _plen = src.engine.take_transfer(ktp["transfer_id"])
-                dev = await src.engine.read_kv_pages_device(pages)
-                target = self.engine.kv_import_sharding()
-
-                def copy():
-                    out = jax.device_put(dev, target)
-                    out.block_until_ready()  # a 70B-scale copy: not on
-                    return out               # the event loop
-
-                out = await _aio.to_thread(copy)
-                src.engine.complete_transfer(ktp["transfer_id"])
-                self.last_pull_path = "device"
-                return out
+                pages, _plen = src.engine.take_transfer(tid)
             except KeyError:
                 # stale registry entry (instance id reused by a remote
                 # worker): fall through to the wire path
                 logger.warning("transfer %s not on local engine; trying "
-                               "the transport", ktp["transfer_id"])
-            except Exception:
-                # device_put/gather failure (mesh mismatch, OOM): the
-                # transfer stays pinned — the wire path below can still
-                # pull it, and its failure path falls back to local serve
-                logger.exception("device-side KV pull failed; trying "
-                                 "the transport")
+                               "the transport", tid)
+            else:
+                try:
+                    dev = await src.engine.read_kv_pages_device(pages)
+                    target = self.engine.kv_import_sharding()
+
+                    def copy():
+                        out = jax.device_put(dev, target)
+                        out.block_until_ready()  # a 70B-scale copy: not
+                        return out               # on the event loop
+
+                    out = await asyncio.to_thread(copy)
+                except asyncio.CancelledError:
+                    # The pull deadline cancelled us mid-copy
+                    # (CancelledError is not Exception, so the handler
+                    # below never sees it). Nothing will pull this
+                    # transfer again — the caller degrades to local
+                    # serve — so release the pinned pages now instead of
+                    # leaking them for a transfer_ttl.
+                    src.engine.complete_transfer(tid)
+                    raise
+                except Exception:
+                    # device_put/gather failure (mesh mismatch, OOM):
+                    # the transfer stays pinned — the wire path below
+                    # can still pull it, and its failure path falls
+                    # back to local serve
+                    logger.exception("device-side KV pull failed; trying "
+                                     "the transport")
+                else:
+                    src.engine.complete_transfer(tid)
+                    self.last_pull_path = "device"
+                    return out
         # cross-process device-to-device plane: ask the owner to STAGE
         # the pages on its transfer server, then pull them straight onto
         # our devices (jax.experimental.transfer — no host bounce). Any
@@ -277,7 +333,6 @@ class DecodeWorkerHandler:
                                     "the host wire", frame.get("error"))
                         break
                     staged = True
-                    import asyncio as _aio
                     import jax as _jax
 
                     dev = list(self.engine.k_cache[0].devices())[0]
@@ -292,9 +347,19 @@ class DecodeWorkerHandler:
                         out.block_until_ready()
                         return out
 
-                    out = await _aio.to_thread(pull_and_place)
+                    out = await asyncio.to_thread(pull_and_place)
                     self.last_pull_path = "plane"
                     return out
+            except asyncio.CancelledError:
+                if staged:
+                    # the producer released its pages at staging and the
+                    # transfer API has no cancel: the staged device copy
+                    # is leaked (bounded by one sequence's KV) — say so
+                    logger.warning(
+                        "KV plane pull for %s cancelled after staging; "
+                        "one staged copy leaks on the producer",
+                        ktp["transfer_id"])
+                raise
             except ConnectionError:
                 return None
             except Exception:
@@ -398,13 +463,11 @@ class DecodeWorkerHandler:
         # The transport's own idle/deadline timeouts (runtime config)
         # surface as ConnectionError inside _pull_kv → None; this bound
         # also covers the device/plane paths that never touch the wire.
-        import asyncio as _aio
-
         try:
-            kv_data = await _aio.wait_for(
+            kv_data = await asyncio.wait_for(
                 self._pull_kv(ktp, context),
                 self.pull_deadline or None)
-        except _aio.TimeoutError:
+        except asyncio.TimeoutError:
             logger.warning("KV pull for transfer %s exceeded %.1fs; "
                            "serving locally", ktp.get("transfer_id"),
                            self.pull_deadline)
@@ -413,6 +476,9 @@ class DecodeWorkerHandler:
             logger.info("kv pull path: %s (%d tokens)",
                         self.last_pull_path, int(ktp["prefill_len"]))
         if kv_data is None:
+            # tell the owning prefill worker to drop the pin now rather
+            # than at transfer_ttl (best effort, off the serving path)
+            self._abort_remote_transfer(ktp)
             logger.warning("KV pull failed; serving locally")
             async for out in self.engine.generate(request, context):
                 yield out

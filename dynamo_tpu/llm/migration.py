@@ -18,6 +18,7 @@ requests append accumulated tokens to the prompt.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import AsyncIterator
 
@@ -60,7 +61,13 @@ class Migration(Operator):
                         return
                 return  # clean end of stream
             except ConnectionError as e:
-                if context.is_cancelled() or attempts_left <= 0:
+                # a spent request budget (Context.deadline stamped by the
+                # transport) makes every replay fail instantly — surface
+                # the error now instead of churning through the limit
+                expired = (context.deadline is not None
+                           and asyncio.get_running_loop().time()
+                           >= context.deadline)
+                if context.is_cancelled() or attempts_left <= 0 or expired:
                     if not context.is_cancelled():
                         self.stats["exhausted"] += 1
                     raise

@@ -47,6 +47,9 @@ class RuntimeConfig:
     connect_retries: int = 2
     connect_backoff_base: float = 0.05
     connect_backoff_max: float = 2.0
+    # Seconds an exhausted dial cycle poisons its address so callers
+    # queued on the same dial lock fail fast (0 disables).
+    connect_neg_cache: float = 0.25
     # Per-instance circuit breaker: consecutive infra failures before the
     # instance leaves the candidate set, and the open → half-open probe
     # cooldown, seconds.

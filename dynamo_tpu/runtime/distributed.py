@@ -35,7 +35,8 @@ class DistributedRuntime:
             deadline=config.request_deadline,
             connect_retries=config.connect_retries,
             connect_backoff_base=config.connect_backoff_base,
-            connect_backoff_max=config.connect_backoff_max)
+            connect_backoff_max=config.connect_backoff_max,
+            connect_neg_cache=config.connect_neg_cache)
         # process-wide per-instance circuit breaker: every PushRouter in
         # this process shares it, so one router's failures steer them all
         from dynamo_tpu.runtime.breaker import CircuitBreaker

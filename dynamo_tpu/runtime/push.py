@@ -79,13 +79,17 @@ class PushRouter:
                 instances = ok
         return instances
 
-    def select(self, instance_id: Optional[int] = None) -> Instance:
-        instances = self._candidates()
+    def select(self, instance_id: Optional[int] = None,
+               candidates: Optional[list[Instance]] = None) -> Instance:
         if instance_id is not None:
             for inst in self.client.instances():
                 if inst.instance_id == instance_id:
                     return inst
             raise NoInstancesError(f"instance {instance_id:x} not found")
+        # callers that already breaker-filtered pass the list in;
+        # recomputing would consult the side-effectful allow() a second
+        # time and double-consume half-open probes
+        instances = self._candidates() if candidates is None else candidates
         if not instances:
             raise NoInstancesError(
                 f"no instances for {self.client.endpoint.instance_prefix}")
@@ -107,13 +111,24 @@ class PushRouter:
         ctx = context or Context()
         rt = self._runtime
         breaker = self.breaker
+        # One routing decision consults the breaker exactly ONCE:
+        # `allow()` is side-effectful (an open entry past cooldown flips
+        # half-open and admits its single probe), so the candidate list
+        # is computed here and reused for both the attempt budget and
+        # selection. Counting and selecting with separate _candidates()
+        # passes would consume the probe in the count, then filter the
+        # instance out in the select — locking an opened instance out of
+        # rotation for as long as any healthy peer exists.
+        candidates = self._candidates() if instance_id is None else None
         # one attempt per current candidate: enough to walk the whole set
         # once when instances keep refusing, without retrying forever
-        attempts = (max(1, len(self._candidates()))
-                    if instance_id is None else 1)
+        attempts = max(1, len(candidates)) if candidates is not None else 1
         last_err: Optional[ConnectionError] = None
-        for _ in range(attempts):
-            inst = self.select(instance_id)
+        for attempt in range(attempts):
+            if attempt and candidates is not None:
+                # re-filter only after a failure fed the breaker
+                candidates = self._candidates()
+            inst = self.select(instance_id, candidates)
             local = rt.local_engine(inst.subject)
             yielded = False
             try:

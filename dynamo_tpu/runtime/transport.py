@@ -33,6 +33,12 @@ logger = logging.getLogger(__name__)
 
 STREAM_ERR_MSG = "stream disconnected"  # matched by Migration retry logic
 
+# Raised when the request's shared budget is already spent before any
+# bytes move. Deliberately distinct from STREAM_ERR_MSG: no instance was
+# at fault, so routers must not feed their breaker, and Migration knows
+# a replay would fail instantly.
+DEADLINE_ERR_MSG = "request deadline exceeded"
+
 # Remaining-budget header (seconds): the client stamps its overall deadline
 # onto the request so the server aborts the handler when the client has
 # already given up — otherwise a timed-out request keeps burning engine
@@ -365,27 +371,39 @@ class TransportClient:
       goes silent longer raises ``ConnectionError(STREAM_ERR_MSG)`` — the
       exact signal the Migration operator replays on, turning a
       wedged-but-connected worker into a recovery instead of a hang.
-    - ``deadline``: overall per-request wall clock; also stamped onto the
-      request (`DEADLINE_HEADER`) so the server aborts the handler.
-    - ``connect_retries`` + jittered exponential backoff on dial failure;
-      exhaustion raises `ConnectError` so routers can try another instance.
+    - ``deadline``: overall per-request wall clock. The first request()
+      call on a context stamps the absolute expiry onto it
+      (``Context.deadline``); retries and Migration replays reusing that
+      context inherit the REMAINING time, so the budget is per request,
+      not per attempt. The remaining time is also stamped onto the wire
+      (`DEADLINE_HEADER`) so the server aborts the handler.
+    - ``connect_retries`` + jittered exponential backoff on dial failure
+      (bounded by the request's remaining deadline); exhaustion raises
+      `ConnectError` so routers can try another instance, and briefly
+      negative-caches the address so callers queued on the same dial
+      lock fail fast instead of serially re-running the backoff cycle.
     """
 
     def __init__(self, *, idle_timeout: float = 0.0, deadline: float = 0.0,
                  connect_retries: int = 2,
                  connect_backoff_base: float = 0.05,
                  connect_backoff_max: float = 2.0,
+                 connect_neg_cache: float = 0.25,
                  fault_injector: Optional[FaultInjector] = None) -> None:
         self._conns: dict[str, _Connection] = {}
         self._rids = itertools.count(1)
         # Per-address locks: a black-holed host must not head-of-line-block
         # connection setup to healthy addresses.
         self._locks: dict[str, asyncio.Lock] = {}
+        # address → (poisoned-until loop time, reason) after an exhausted
+        # dial cycle; entries expire after connect_neg_cache seconds
+        self._neg_cache: dict[str, tuple[float, str]] = {}
         self.idle_timeout = idle_timeout
         self.deadline = deadline
         self.connect_retries = connect_retries
         self.connect_backoff_base = connect_backoff_base
         self.connect_backoff_max = connect_backoff_max
+        self.connect_neg_cache = connect_neg_cache
         self.fault_injector = fault_injector or FaultInjector.from_env()
         self._rng = random.Random()
         # client-side robustness counters (scraped via the server's
@@ -396,12 +414,29 @@ class TransportClient:
             "decode_errors": 0, "route_retries": 0,
         }
 
-    async def _conn(self, address: str) -> _Connection:
+    async def _conn(self, address: str,
+                    deadline_at: Optional[float] = None) -> _Connection:
+        loop = asyncio.get_running_loop()
         lock = self._locks.setdefault(address, asyncio.Lock())
         async with lock:
             conn = self._conns.get(address)
             if conn is not None and not conn.closed:
                 return conn
+            # Negative cache: the dial cycle below runs under the
+            # per-address lock, so once it exhausts its retries every
+            # caller already queued behind it would serially re-run the
+            # whole backoff cycle against the same dead host. A briefly
+            # poisoned address makes them fail fast instead, so routers
+            # move to the next instance within the caller's deadline.
+            neg = self._neg_cache.get(address)
+            if neg is not None:
+                until, why = neg
+                if loop.time() < until:
+                    self.stats["connect_failures"] += 1
+                    raise ConnectError(
+                        f"connect to {address} failed {why}; redial "
+                        f"suppressed for {until - loop.time():.2f}s")
+                del self._neg_cache[address]
             last: Optional[Exception] = None
             for attempt in range(self.connect_retries + 1):
                 if attempt:
@@ -411,18 +446,44 @@ class TransportClient:
                                 self.connect_backoff_base
                                 * (2 ** (attempt - 1)))
                     delay *= 0.5 + self._rng.random()
+                    if deadline_at is not None:
+                        delay = min(delay, max(0.0, deadline_at - loop.time()))
                     self.stats["connect_retries"] += 1
                     await asyncio.sleep(delay)
+                # the caller's remaining request budget bounds the whole
+                # dial loop — backoff past it only delays router failover
+                budget = (None if deadline_at is None
+                          else deadline_at - loop.time())
+                if budget is not None and budget <= 0:
+                    if last is None:
+                        last = asyncio.TimeoutError(
+                            "request deadline elapsed while dialing")
+                    break
                 conn = _Connection(address, injector=self.fault_injector,
                                    stats=self.stats)
                 try:
-                    await conn.connect()
-                except (ConnectionError, OSError) as e:
+                    if budget is None:
+                        await conn.connect()
+                    else:
+                        await asyncio.wait_for(conn.connect(), budget)
+                except (ConnectionError, OSError,
+                        asyncio.TimeoutError) as e:
+                    conn.close()
                     last = e
                     continue
                 self._conns[address] = conn
                 return conn
             self.stats["connect_failures"] += 1
+            # poison only on a genuinely exhausted cycle: a dial cut
+            # short by the CALLER's deadline says nothing about the
+            # host's health and must not fail other requests fast
+            deadline_cut = (deadline_at is not None
+                            and loop.time() >= deadline_at)
+            if self.connect_neg_cache > 0 and not deadline_cut:
+                self._neg_cache[address] = (
+                    loop.time() + self.connect_neg_cache,
+                    f"after {self.connect_retries + 1} attempts "
+                    f"({last!r})")
             raise ConnectError(
                 f"connect to {address} failed after "
                 f"{self.connect_retries + 1} attempts: {last!r}") from last
@@ -444,15 +505,27 @@ class TransportClient:
         idle = self.idle_timeout if idle_timeout is None else idle_timeout
         total = self.deadline if deadline is None else deadline
         loop = asyncio.get_running_loop()
-        expires = loop.time() + total if total else None
-        conn = await self._conn(address)
+        # ONE budget per request, not per attempt: the first call stamps
+        # the absolute expiry on the context; router retries and
+        # Migration replays reuse the context and inherit the remaining
+        # time, so worst-case wall clock stays ~deadline rather than
+        # deadline × attempts.
+        expires = ctx.deadline
+        if expires is None and total:
+            expires = ctx.deadline = loop.time() + total
+        if expires is not None and loop.time() >= expires:
+            self.stats["deadline_exceeded"] += 1
+            raise ConnectionError(DEADLINE_ERR_MSG)
+        conn = await self._conn(address, deadline_at=expires)
         rid = f"{ctx.request_id}.{next(self._rids)}"
         cancel_task = None
         try:
             q = conn.open_stream(rid, subject)
             headers = inject_headers(dict(ctx.headers))
-            if total:
-                headers[DEADLINE_HEADER] = total
+            if expires is not None:
+                # stamp the REMAINING time, not the configured total: the
+                # server-side abort timer must share this request's budget
+                headers[DEADLINE_HEADER] = max(0.0, expires - loop.time())
             await conn.send({"t": "req", "rid": rid, "subject": subject,
                              "payload": payload, "headers": headers})
 

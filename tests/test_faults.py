@@ -455,6 +455,49 @@ async def test_router_retries_next_instance_on_connect_failure():
         await rt.close()
 
 
+async def test_half_open_probe_survives_candidate_counting():
+    """Regression: direct() used to run _candidates() twice per routing
+    decision (attempt count + select). allow() is side-effectful — the
+    counting pass consumed the one half-open probe per cooldown window
+    and extended retry_at, so the select pass filtered the instance out
+    again. With a healthy peer present, an opened instance was never
+    probed and never rejoined rotation."""
+    clock = [0.0]
+    br = CircuitBreaker(fail_limit=1, cooldown=1.0, clock=lambda: clock[0])
+    rt = await DistributedRuntime.create(RuntimeConfig(connect_retries=0))
+    rt.breaker = br
+    try:
+        served_from = []
+
+        def mk(tag):
+            async def gen(request, context):
+                served_from.append(tag)
+                yield {"from": tag}
+            return gen
+
+        ep = rt.namespace("ns").component("c").endpoint("gen")
+        s1 = await ep.serve(mk(1), instance_id=1)
+        await ep.serve(mk(2), instance_id=2)
+        client = await ep.client()
+        await client.start()
+        router = PushRouter(client)
+        subject = s1.instance.subject
+        br.record_failure(subject)                 # opened (fail_limit=1)
+        assert br.state(subject) == OPEN
+        for _ in range(6):
+            clock[0] += 1.5                        # fresh probe window
+            async for _x in router.generate({}, Context()):
+                pass
+            if br.state(subject) == CLOSED:
+                break
+        # the probe must actually land on the opened instance and close
+        # its breaker while the healthy peer keeps serving
+        assert br.state(subject) == CLOSED
+        assert 1 in served_from
+    finally:
+        await rt.close()
+
+
 async def test_breaker_half_open_recovers_instance():
     clock = [0.0]
     br = CircuitBreaker(fail_limit=1, cooldown=1.0, clock=lambda: clock[0])
@@ -608,3 +651,218 @@ async def test_stalled_kv_pull_falls_back_to_local_serve():
     # degraded to the local engine instead of hanging on the pull
     assert out == [{"token_ids": [7], "finish_reason": "stop"}]
     assert asyncio.get_running_loop().time() - t0 < 5.0
+
+
+# -- one deadline budget per request (not per attempt) ------------------------
+
+
+async def test_deadline_budget_shared_across_migration_replays():
+    """The overall deadline is stamped on the Context once; Migration
+    replays inherit the REMAINING time instead of restarting a full
+    budget, so worst-case wall clock is ~deadline, not
+    deadline x (migration_limit + 1)."""
+    from dynamo_tpu.llm.migration import Migration
+
+    async def drips(request, context):
+        for i in range(1000):
+            yield {"token_ids": [i]}
+            await asyncio.sleep(0.05)
+
+    server, addr, subject = await _serve(drips)
+    client = TransportClient(deadline=0.3)
+
+    class _Edge:
+        async def generate(self, request, context):
+            async for x in client.request(addr, subject, request, context):
+                yield x
+
+    mig = Migration(migration_limit=5).link(_Edge())
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    try:
+        with pytest.raises(ConnectionError):
+            async for _ in mig.generate(
+                    {"stop": {"max_tokens": 100}}, Context()):
+                pass
+        # per-attempt budgets would stretch this to ~6 x 0.3 s
+        assert loop.time() - t0 < 0.9
+        assert mig.stats["exhausted"] == 1
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_spent_budget_raises_distinct_error_before_dialing():
+    """A request whose shared budget is already gone fails immediately
+    with DEADLINE_ERR_MSG — not STREAM_ERR_MSG, so routers don't feed
+    the breaker for an instance that never saw a byte."""
+    from dynamo_tpu.runtime.transport import DEADLINE_ERR_MSG
+
+    client = TransportClient(deadline=5.0)
+    ctx = Context()
+    ctx.deadline = asyncio.get_running_loop().time() - 1.0  # already spent
+    with pytest.raises(ConnectionError) as ei:
+        async for _ in client.request("127.0.0.1:1", "s", {}, ctx):
+            pass
+    assert str(ei.value) == DEADLINE_ERR_MSG
+    assert client.stats["deadline_exceeded"] == 1
+    assert client.stats["connect_retries"] == 0     # never dialed
+    await client.close()
+
+
+# -- dial loop: deadline bound + negative cache -------------------------------
+
+
+async def test_deadline_bounds_dial_retries():
+    inj = FaultInjector.from_spec("kind=connect_refused,times=*")
+    client = TransportClient(deadline=0.2, connect_retries=50,
+                             connect_backoff_base=0.2,
+                             connect_backoff_max=0.2,
+                             connect_neg_cache=0.0, fault_injector=inj)
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    with pytest.raises(ConnectError):
+        async for _ in client.request("127.0.0.1:1", "s", {}):
+            pass
+    # without the bound this would sit through ~50 x 0.2 s of backoff
+    assert loop.time() - t0 < 1.5
+    await client.close()
+
+
+async def test_negative_cache_fails_queued_dials_fast():
+    inj = FaultInjector.from_spec("kind=connect_refused,times=*")
+    client = TransportClient(connect_retries=2, connect_backoff_base=0.05,
+                             connect_neg_cache=5.0, fault_injector=inj)
+
+    async def one():
+        with pytest.raises(ConnectError):
+            async for _ in client.request("127.0.0.1:1", "s", {}):
+                pass
+
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    await asyncio.gather(*(one() for _ in range(5)))
+    # the first caller pays one full retry cycle; the four queued on the
+    # same dial lock hit the poisoned address and fail fast instead of
+    # serially re-running the backoff cycle
+    assert loop.time() - t0 < 0.5
+    assert client.stats["connect_failures"] == 5
+    assert inj.fired["connect_refused"] == 3        # one dial cycle total
+    await client.close()
+
+
+# -- disagg: abandoned pulls release the pinned transfer ----------------------
+
+
+async def test_cancelled_device_pull_releases_pinned_pages():
+    """The pull deadline cancels _pull_kv with wait_for; CancelledError
+    is not Exception, so the device path must release the transfer it
+    took explicitly or the prefill engine's pages stay pinned for a
+    whole transfer_ttl."""
+    from dynamo_tpu.disagg import handlers as H
+
+    released = []
+
+    class _SrcEngine:
+        def take_transfer(self, tid):
+            return [1, 2], 8
+
+        async def read_kv_pages_device(self, pages):
+            await asyncio.Event().wait()            # wedged device gather
+
+        def complete_transfer(self, tid):
+            released.append(tid)
+
+    class _Src:
+        engine = _SrcEngine()
+
+    handler = H.DecodeWorkerHandler.__new__(H.DecodeWorkerHandler)
+    handler.engine = None
+    handler.kv_pull_router = None
+    handler.last_pull_path = None
+    H._LOCAL_PREFILL[777] = _Src()
+    try:
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                handler._pull_kv({"instance_id": 777, "transfer_id": "t9"},
+                                 Context()), 0.2)
+        assert released == ["t9"]
+    finally:
+        H._LOCAL_PREFILL.pop(777, None)
+
+
+async def test_kv_pull_abort_releases_transfer():
+    from dynamo_tpu.disagg.handlers import PrefillWorkerHandler
+
+    released = []
+
+    class _Engine:
+        def take_transfer(self, tid):
+            raise AssertionError("abort must not (re)take the transfer")
+
+        def complete_transfer(self, tid):
+            released.append(tid)
+
+    h = PrefillWorkerHandler(_Engine(), instance_id=1)
+    out = [x async for x in h.kv_pull(
+        {"transfer_id": "t1", "abort": True}, Context())]
+    assert out == [{"aborted": True}]
+    assert released == ["t1"]
+
+
+async def test_failed_pull_sends_abort_to_remote_worker():
+    """When the pull fails and the decode worker degrades to local
+    serve, it must tell the owning prefill worker to drop its pin now
+    (best effort) instead of leaving the pages pinned until the TTL
+    reaper fires."""
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+
+    aborts = []
+
+    class _Engine:
+        async def generate(self, request, context):
+            yield {"token_ids": [7], "finish_reason": "stop"}
+
+    class _PrefillRouter:
+        async def generate(self, request, context):
+            yield {"token_ids": [5],
+                   "kv_transfer_params": {"instance_id": 42,
+                                          "transfer_id": "tx",
+                                          "prefill_len": 2}}
+
+    class _PullRouter:
+        class client:
+            @staticmethod
+            def instances():
+                return [object()]
+
+        async def direct(self, request, instance_id, context=None):
+            if request.get("abort"):
+                aborts.append(request["transfer_id"])
+                yield {"aborted": True}
+                return
+            raise ConnectionError("wire down")
+            yield {}  # pragma: no cover — makes this an async generator
+
+    class _Always:
+        def prefill_remote(self, n, hit):
+            return True
+
+    handler = DecodeWorkerHandler.__new__(DecodeWorkerHandler)
+    handler.engine = _Engine()
+    handler.prefill_router = _PrefillRouter()
+    handler.kv_pull_router = _PullRouter()
+    handler.prefill_queue_client = None
+    handler.pull_chunk_pages = 4
+    handler.pull_deadline = 2.0
+    handler.last_pull_path = None
+    handler._prefix_hit_len = lambda toks: 0
+    handler.disagg_router = _Always()
+    out = [x async for x in handler.generate(
+        {"token_ids": [1, 2], "stop": {"max_tokens": 4}}, Context())]
+    assert out == [{"token_ids": [7], "finish_reason": "stop"}]
+    for _ in range(200):                            # fire-and-forget task
+        if aborts:
+            break
+        await asyncio.sleep(0.01)
+    assert aborts == ["tx"]

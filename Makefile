@@ -3,7 +3,7 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos
+.PHONY: tier0 tier1 chaos kvbm-soak
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -19,4 +19,12 @@ tier1:
 # kills/stalls workers mid-stream and requires 100% of requests to
 # complete token-identically. tier0-marked, < 60 s.
 chaos:
-	$(PYTEST) tests/test_faults.py tests/test_chaos.py
+	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
+		tests/test_kvbm_pipeline.py
+
+# KVBM pipeline soak (docs/kvbm.md): loop admission/eviction with the
+# offload worker fault-delayed on every batch — output must stay
+# token-identical to a clean engine. Includes the slow-marked soak
+# body the tier gates skip.
+kvbm-soak:
+	$(PYTEST) tests/test_kvbm_pipeline.py tests/test_kvbm.py

@@ -478,6 +478,9 @@ async def _phase_long_body(cfg, eng):
         "decode_steps_during_prefill":
             p1["decode_steps_during_prefill"]
             - p0["decode_steps_during_prefill"],
+        "admission_stall_ms": round(
+            p1.get("admission_stall_ms", 0.0)
+            - p0.get("admission_stall_ms", 0.0), 1),
     }
     del params
     return out
@@ -974,7 +977,8 @@ _MARK = "BENCH_PHASE_JSON: "
 # the 8B ckpt phase has its own inner DYN_BENCH_CKPT_TIMEOUT too).
 # quant builds THREE 1B engines (one per mode) + three b32 loop shapes
 # — cold-cache compiles need more than the default box.
-_PHASE_TIMEOUT_S = {"ckpt": 2400.0, "quant": 2400.0, "disagg": 1800.0}
+_PHASE_TIMEOUT_S = {"ckpt": 2400.0, "quant": 2400.0, "disagg": 1800.0,
+                    "preflight": 240.0}
 _DEFAULT_TIMEOUT_S = 1200.0
 
 
@@ -1044,7 +1048,9 @@ def _device_preflight(attempts: int = 2) -> Optional[str]:
              "numpy.asarray(jax.numpy.ones(4) + 1); print('DEV_OK')"],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         try:
-            out_s, err_s = proc.communicate(timeout=240)
+            out_s, err_s = proc.communicate(
+                timeout=_PHASE_TIMEOUT_S.get("preflight",
+                                             _DEFAULT_TIMEOUT_S))
         except subprocess.TimeoutExpired:
             proc.terminate()
             try:
@@ -1076,7 +1082,11 @@ def main():
     if set(PHASES) - skip:          # all-skipped runs never touch the chip
         pf = _device_preflight()
         if pf is not None:
-            out.update({"value": 0.0, "vs_baseline": 0.0, "error": pf})
+            # distinct SKIPPED record: a wedged relay is an outage, not a
+            # measurement — value stays null so the trajectory isn't
+            # polluted with fake zeros (BENCH_r04/r05)
+            out.update({"value": None, "vs_baseline": None,
+                        "skipped": True, "error": pf})
             print(json.dumps(out), flush=True)
             return
 

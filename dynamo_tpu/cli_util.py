@@ -36,6 +36,15 @@ def add_runtime_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--stream-idle-timeout", type=float, default=None,
                    help="max silence between response frames before the "
                         "stream is declared dead and migrated")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="deterministic fault-injection spec "
+                        "(runtime/faults.py grammar); exported as "
+                        "DYN_FAULTS so every injector in the process — "
+                        "transport, engine, KVBM offload worker — "
+                        "picks it up")
+    p.add_argument("--faults-seed", type=int, default=None,
+                   help="seed for probabilistic fault rules "
+                        "(DYN_FAULTS_SEED; default 0)")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
 
@@ -58,6 +67,17 @@ def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
         cfg.request_deadline = args.request_deadline
     if getattr(args, "stream_idle_timeout", None) is not None:
         cfg.stream_idle_timeout = args.stream_idle_timeout
+    if getattr(args, "faults", None) is not None:
+        # publish via env, not config: FaultInjector.from_env() is read
+        # independently by the transport layer AND the KVBM manager, and
+        # child components must inherit the spec for cluster game days
+        import os
+
+        from dynamo_tpu.runtime.faults import ENV_SEED, ENV_SPEC
+
+        os.environ[ENV_SPEC] = args.faults
+        if getattr(args, "faults_seed", None) is not None:
+            os.environ[ENV_SEED] = str(args.faults_seed)
     return cfg
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -646,15 +647,30 @@ class TpuEngine:
                      "prefill_new_tokens": 0, "prefill_emitted": 0,
                      "tokens_emitted": 0, "pipelined_bursts": 0,
                      "prefill_chunks": 0, "decode_steps_during_prefill": 0,
-                     "mixed_steps": 0, "itl_hist": itl_new_hist()}
+                     "mixed_steps": 0, "itl_hist": itl_new_hist(),
+                     # wall time _admit spends per admission on page
+                     # allocation (inline eviction gathers ride here) +
+                     # tier onboard — the stall the async KVBM pipeline
+                     # (docs/kvbm.md) exists to shrink
+                     "admission_stall_ms": 0.0}
         # raw ITL samples (ms), capped FIFO — bench reads these for
         # exact percentiles; the wire carries only the histogram
         self.itl_samples: list[float] = []
+        self._admit_fail_since: Optional[float] = None
         self._rng = np.random.RandomState(cfg.rng_seed)
         # Serializes device access: step functions donate the cache buffers
         # (the pre-step arrays die mid-call), so concurrent readers
         # (kv_pull) must not touch k_cache/v_cache while a step runs.
         self._device_lock = asyncio.Lock()
+        # The asyncio lock can't exclude SYNCHRONOUS event-loop code:
+        # onboard()'s donating write_kv_pages runs inside _admit with no
+        # await, and the KVBM offload worker's gather runs in a thread
+        # (holding _device_lock) at the same time — the donation deletes
+        # the cache tuple out from under the in-flight gather. This
+        # thread lock covers only the two sync cache-buffer entry points
+        # (_gather_kv_pages / write_kv_pages); holders never await or
+        # take other locks, so it cannot deadlock.
+        self._kv_buffer_lock = threading.Lock()
         # decode-burst pipeline state (config.pipeline_bursts): the
         # in-flight burst awaiting its host sync, and — while one is in
         # flight — a redirect for page releases (freeing pages a running
@@ -846,6 +862,10 @@ class TpuEngine:
         if self._loop_task is not None:
             self._loop_task.cancel()
         self._drain_inflight_sync()
+        if self.kvbm is not None:
+            # stop the offload/prefetch pipeline and release any
+            # pending-offload pins before freeing sequences below
+            await self.kvbm.close()
         # unblock any generate() caller still awaiting its queue
         for s in self._running + self._waiting:
             s.queue.put_nowait(EngineOutput(
@@ -889,6 +909,11 @@ class TpuEngine:
             try:
                 self._reap_transfers()
                 self._admit()
+                if self.kvbm is not None and self._waiting:
+                    # stage tier blocks for still-queued requests so
+                    # their admission onboard is one device write
+                    # (no-op unless kvbm prefetch_blocks > 0)
+                    self.kvbm.prefetch_waiting(self._waiting)
                 if self.kvbm is not None and self.kvbm.remote is not None:
                     # G4: continue freshly-admitted prompts' block chains
                     # from peer workers' tiers before prefill. Fetches
@@ -950,6 +975,41 @@ class TpuEngine:
 
     # -- admission ----------------------------------------------------------
 
+    # how long admission may keep failing with offload pins outstanding
+    # before the queued batches are force-drained inline. Must comfortably
+    # exceed a healthy worker's gather+demote latency INCLUDING its wait
+    # for the device lock behind in-flight decode bursts — an iteration
+    # count would not: an otherwise-idle scheduler loop burns iterations
+    # far faster than the worker's to_thread gather can land, and an
+    # early flush degrades every deficit eviction to the inline copy
+    _ADMIT_FLUSH_GRACE_S = 0.25
+
+    def _alloc_admission(self, hashes, prompt_len: int):
+        """allocate_sequence with a pinned-page escape hatch.
+
+        A failed allocation with offload pins outstanding is NORMAL in
+        pipelined mode — the evicted victims are pinned until the
+        worker's gather lands, so the caller is expected to retry next
+        scheduler iteration. But if it KEEPS failing past the grace
+        period (worker stuck on a slow tier, or wedged entirely), the
+        pins are HBM the allocator needs: the queued-but-unclaimed
+        batches are drained inline, their pins recycle, and the
+        allocation is retried. Batches the worker already claimed stay
+        with it, so a wedged worker strands at most one drain round."""
+        alloc = self.pool.allocate_sequence(hashes, prompt_len)
+        if alloc is not None:
+            self._admit_fail_since = None
+            return alloc
+        if self.kvbm is not None and self.pool.pending_offload_pages:
+            now = time.monotonic()
+            if self._admit_fail_since is None:
+                self._admit_fail_since = now
+            elif (now - self._admit_fail_since >= self._ADMIT_FLUSH_GRACE_S
+                    and self.kvbm.flush_queued_offloads()):
+                self._admit_fail_since = None
+                alloc = self.pool.allocate_sequence(hashes, prompt_len)
+        return alloc
+
     def _admit(self) -> None:
         cfg = self.config
         while self._waiting and len(self._running) < cfg.max_batch_size:
@@ -961,19 +1021,29 @@ class TpuEngine:
             hashes = cand.prompt_hashes
             need_pages = (len(cand.prompt) + self.model_cfg.page_size - 1) \
                 // self.model_cfg.page_size
-            if (self.pool.active_pages + need_pages
+            # pinned pages are HBM-occupied but free themselves without
+            # any sequence finishing (the offload worker's gather lands);
+            # netting them out keeps the watermark from refusing
+            # admissions the pipeline will unblock in a step or two
+            occupied = self.pool.active_pages - self.pool.pending_offload_pages
+            if (occupied + need_pages
                     > cfg.watermark * self.pool.capacity and self._running):
                 break
+            t_adm = time.perf_counter()
             if cand.import_kv is not None:
                 # disagg import: fresh pages only (remote KV overwrites
                 # them); cached_len comes from the transfer, not hashing
-                alloc = self.pool.allocate_sequence([], len(cand.prompt))
+                alloc = self._alloc_admission([], len(cand.prompt))
                 if alloc is None:
+                    self.perf["admission_stall_ms"] += \
+                        (time.perf_counter() - t_adm) * 1e3
                     break
                 cand.pages, cand.cached_len = alloc[0], cand.import_kv[1]
             else:
-                alloc = self.pool.allocate_sequence(hashes, len(cand.prompt))
+                alloc = self._alloc_admission(hashes, len(cand.prompt))
                 if alloc is None:
+                    self.perf["admission_stall_ms"] += \
+                        (time.perf_counter() - t_adm) * 1e3
                     break
                 cand.pages, cand.cached_len = alloc
                 if self.kvbm is not None:
@@ -981,6 +1051,11 @@ class TpuEngine:
                     # live in the host/disk tiers are DMA'd into the fresh
                     # pages so prefill skips them
                     cand.cached_len = self.kvbm.onboard(cand)
+            # allocation covers any inline eviction gathers; onboard
+            # covers tier reads + the device write — both shrink when
+            # the async pipeline stages them ahead of time
+            self.perf["admission_stall_ms"] += \
+                (time.perf_counter() - t_adm) * 1e3
             # budgeted prefill resumes from here; legacy prefill keys its
             # offsets off cached_len directly and ignores the cursor
             cand.prefill_pos = cand.cached_len
@@ -2428,8 +2503,9 @@ class TpuEngine:
         bounded by distinct page-group sizes (page-aligned transfer
         lengths)."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
-        out.block_until_ready()
+        with self._kv_buffer_lock:
+            out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
+            out.block_until_ready()
         return out
 
     def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
@@ -2466,8 +2542,9 @@ class TpuEngine:
         prefill path does, for disagg imports). One jitted scatter —
         see _write_kv_pages_jit."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
-        self.k_cache, self.v_cache = _write_kv_pages_jit(
-            self.k_cache, self.v_cache, ids, jax.numpy.asarray(data))
+        with self._kv_buffer_lock:
+            self.k_cache, self.v_cache = _write_kv_pages_jit(
+                self.k_cache, self.v_cache, ids, jax.numpy.asarray(data))
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown
@@ -2537,5 +2614,7 @@ class TpuEngine:
                 "mixed_steps": self.perf["mixed_steps"],
                 "itl_p50_ms": itl_percentile(self.perf["itl_hist"], 0.5),
                 "itl_p99_ms": itl_percentile(self.perf["itl_hist"], 0.99),
+                "admission_stall_ms":
+                    round(self.perf["admission_stall_ms"], 3),
             },
         ))

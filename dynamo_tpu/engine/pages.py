@@ -83,6 +83,14 @@ class PagePool:
         self._pages: dict[int, _Page] = {}
         self._registered: dict[int, int] = {}       # seq_hash -> page_id
         self._inactive: OrderedDict[int, None] = OrderedDict()  # LRU page ids
+        # pending-offload pins (async KVBM pipeline, docs/kvbm.md): the
+        # evict hook may CLAIM evicted registered pages instead of copying
+        # their device data inline. A pinned page is in limbo — out of
+        # _registered/_inactive/_free — and must not be recycled until the
+        # offload worker's device gather lands and releases the pin; its
+        # device data stays intact because only allocated pages are ever
+        # written.
+        self._pending_offload: set[int] = set()
         self._event_ids = itertools.count(1)
 
     # -- introspection ------------------------------------------------------
@@ -98,6 +106,14 @@ class PagePool:
     @property
     def used_pages(self) -> int:
         return self.capacity - len(self._free)
+
+    @property
+    def pending_offload_pages(self) -> int:
+        """Pages pinned for a not-yet-landed tier offload. They count as
+        active/used (their HBM is genuinely unavailable) but free again
+        without any sequence finishing, so admission watermarks should
+        net them out (engine._admit does)."""
+        return len(self._pending_offload)
 
     def usage(self) -> float:
         return self.active_pages / self.capacity if self.capacity else 1.0
@@ -127,9 +143,15 @@ class PagePool:
         page.refcount += 1
 
     def allocate_page(self) -> Optional[int]:
-        """One fresh (writable) page; evicts LRU inactive if needed."""
-        if not self._free and not self._evict_one():
-            return None
+        """One fresh (writable) page; evicts LRU inactive if needed.
+        An eviction can succeed WITHOUT freeing — the hook may pin the
+        victim for deferred offload. Evict at most once and report
+        exhaustion rather than looping: draining the whole LRU into
+        pins would trash the prefix cache for one page; the caller
+        retries after the offload worker recycles the pins."""
+        if not self._free:
+            if not self._evict_one() or not self._free:
+                return None
         pid = self._free.pop()
         self._pages[pid] = _Page(page_id=pid, refcount=1)
         return pid
@@ -160,7 +182,10 @@ class PagePool:
             self._evict_many(deficit)
         for _ in range(fresh_needed):
             pid = self.allocate_page()
-            if pid is None:  # raced our own estimate (shouldn't happen)
+            # reachable when the evict hook pinned the victims for
+            # deferred offload: evicted-but-not-freed, so the capacity
+            # estimate above was optimistic — caller retries next step
+            if pid is None:
                 self.release_sequence(pages)
                 return None
             pages.append(pid)
@@ -230,6 +255,37 @@ class PagePool:
         forgetting, not demoting to a slower tier."""
         return self._evict_many(len(self._inactive), fire_hook=False)
 
+    # -- pending-offload pins (async KVBM pipeline) -------------------------
+
+    def pin_for_offload(self, page_ids: list[int]) -> None:
+        """Claim eviction victims for a deferred tier copy. ONLY legal
+        from inside the evict hook, while the victims' device data is
+        still intact: pinned victims skip the free-list return at the
+        end of `_evict_many` and are recycled by `release_offload_pin`
+        once their gather lands."""
+        for pid in page_ids:
+            page = self._pages.get(pid)
+            if page is None:
+                raise BlockStateInvalid(
+                    f"offload pin of freed/unknown page {pid}")
+            if page.refcount != 0 or page.state == PARTIAL:
+                raise BlockStateInvalid(
+                    f"offload pin of page {pid} in state {page.state} "
+                    f"refcount {page.refcount}")
+            self._pending_offload.add(pid)
+
+    def release_offload_pin(self, page_ids: list[int]) -> None:
+        """The deferred gather landed (or was abandoned): recycle the
+        pinned pages. Idempotent — close paths may race the worker's
+        own cleanup."""
+        for pid in page_ids:
+            if pid not in self._pending_offload:
+                continue
+            self._pending_offload.discard(pid)
+            page = self._pages.get(pid)
+            if page is not None:
+                self._discard(page)
+
     def _discard(self, page: _Page) -> None:
         self._pages.pop(page.page_id, None)
         self._free.append(page.page_id)
@@ -264,5 +320,9 @@ class PagePool:
                     dp_rank=self.dp_rank, event_id=next(self._event_ids),
                     seq_hashes=[page.seq_hash]))
         for page in victims:
+            # a hook that pinned the page (pin_for_offload) owns its
+            # recycling; everything else frees immediately as before
+            if page.page_id in self._pending_offload:
+                continue
             self._discard(page)
         return len(victims)

@@ -65,6 +65,7 @@ class KvbmDistributed:
         self._router = None
         self._publish_task: Optional[asyncio.Task] = None
         self._publish_dirty = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._adverts: Optional[list] = None
         self._adverts_at = 0.0
         manager.remote = self
@@ -77,6 +78,7 @@ class KvbmDistributed:
     async def start(self) -> None:
         from dynamo_tpu.runtime.push import PushRouter
 
+        self._loop = asyncio.get_running_loop()
         ep = (self.runtime.namespace(self.namespace)
               .component(self.component).endpoint(KVBM_PULL_ENDPOINT))
         self._served = await ep.serve(self._handle_pull,
@@ -106,6 +108,12 @@ class KvbmDistributed:
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
+            # tier mutation from a KVBM pipeline worker thread (offload
+            # demote, prefetch promote): hop onto our loop — dropping the
+            # advert here would leave it stale until the next loop-side
+            # mutation
+            if self._loop is not None and not self._loop.is_closed():
+                self._loop.call_soon_threadsafe(self._schedule_publish)
             return
         self._publish_task = loop.create_task(self._debounced_publish())
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -150,15 +151,24 @@ class DiskTier:
 
 
 class TieredStore:
-    """Host + disk tiers behind one interface; disk hits promote to host."""
+    """Host + disk tiers behind one interface; disk hits promote to host.
+
+    Thread-safe: the async KVBM pipeline (kvbm/manager.py) mutates the
+    store from offload/prefetch worker threads while the scheduler
+    coroutine and kvbm_pull serving threads read it, so every operation
+    holds one re-entrant lock (re-entrant because a disk hit's promote
+    path calls `put` from inside `get`)."""
 
     def __init__(self, host_blocks: int = 1024, disk_blocks: int = 0,
                  disk_dir: Optional[str] = None) -> None:
         self.host = HostTier(host_blocks)
         self.disk = DiskTier(disk_blocks, disk_dir) if disk_blocks else None
+        self._lock = threading.RLock()
         # fired after ANY mutation of the held-block set (insert, LRU
         # displacement/drop, promotion) — the distributed advert
-        # subscribes so it can never over-claim for long
+        # subscribes so it can never over-claim for long. May fire from a
+        # pipeline worker thread; subscribers must be thread-safe
+        # (KvbmDistributed._schedule_publish hops to its event loop).
         self.on_change = None
 
     def _changed(self) -> None:
@@ -166,65 +176,73 @@ class TieredStore:
             self.on_change()
 
     def contains(self, seq_hash: int) -> bool:
-        return self.host.contains(seq_hash) or (
-            self.disk is not None and self.disk.contains(seq_hash))
+        with self._lock:
+            return self.host.contains(seq_hash) or (
+                self.disk is not None and self.disk.contains(seq_hash))
 
     def put(self, seq_hash: int, data: np.ndarray) -> None:
-        for demoted_hash, demoted in self.host.put(seq_hash, data):
-            if self.disk is not None:
-                self.disk.put(demoted_hash, demoted)
-            # disk-capacity unlinks and no-disk drops both shrink the set
+        with self._lock:
+            for demoted_hash, demoted in self.host.put(seq_hash, data):
+                if self.disk is not None:
+                    self.disk.put(demoted_hash, demoted)
+                # disk-capacity unlinks and no-disk drops both shrink
+                # the set
         self._changed()
 
     def get(self, seq_hash: int) -> Optional[np.ndarray]:
-        data = self.host.get(seq_hash)
-        if data is not None:
+        with self._lock:
+            data = self.host.get(seq_hash)
+            if data is not None:
+                return data
+            if self.disk is None:
+                return None
+            data = self.disk.get(seq_hash)
+            if data is not None:
+                # promote: hot again, keep it a RAM copy away — and free
+                # the disk slot (a lingering entry would double-count the
+                # block against disk capacity and strand its file)
+                self.disk.pop(seq_hash)
+                self.put(seq_hash, data)   # fires _changed
             return data
-        if self.disk is None:
-            return None
-        data = self.disk.get(seq_hash)
-        if data is not None:
-            # promote: hot again, keep it a RAM copy away — and free the
-            # disk slot (a lingering entry would double-count the block
-            # against disk capacity and strand its file)
-            self.disk.pop(seq_hash)
-            self.put(seq_hash, data)   # fires _changed
-        return data
 
     def match_prefix(self, seq_hashes: list[int]) -> int:
         """Longest leading chain of blocks present in any tier."""
-        n = 0
-        for h in seq_hashes:
-            if not self.contains(h):
-                break
-            n += 1
-        return n
+        with self._lock:
+            n = 0
+            for h in seq_hashes:
+                if not self.contains(h):
+                    break
+                n += 1
+            return n
 
     def clear(self, level: str = "all") -> dict:
         """Manual flush (reference controller ResetPool/ResetAll):
         level "g2" (host), "g3" (disk), or "all". Returns blocks dropped
         per tier."""
-        dropped = {}
-        if level in ("g2", "all"):
-            dropped["g2"] = self.host.clear()
-        if level in ("g3", "all") and self.disk is not None:
-            dropped["g3"] = self.disk.clear()
+        with self._lock:
+            dropped = {}
+            if level in ("g2", "all"):
+                dropped["g2"] = self.host.clear()
+            if level in ("g3", "all") and self.disk is not None:
+                dropped["g3"] = self.disk.clear()
         if dropped:
             self._changed()
         return dropped
 
     def occupancy(self) -> dict:
-        out = {"g2": {"blocks": len(self.host),
-                      "capacity": self.host.capacity}}
-        if self.disk is not None:
-            out["g3"] = {"blocks": len(self.disk),
-                         "capacity": self.disk.capacity}
-        return out
+        with self._lock:
+            out = {"g2": {"blocks": len(self.host),
+                          "capacity": self.host.capacity}}
+            if self.disk is not None:
+                out["g3"] = {"blocks": len(self.disk),
+                             "capacity": self.disk.capacity}
+            return out
 
     def hashes(self) -> list[int]:
         """All block hashes across tiers (the distributed advert)."""
-        out = list(self.host._blocks.keys())
-        if self.disk is not None:
-            out += [h for h in self.disk._lru.keys()
-                    if h not in self.host._blocks]
-        return out
+        with self._lock:
+            out = list(self.host._blocks.keys())
+            if self.disk is not None:
+                out += [h for h in self.disk._lru.keys()
+                        if h not in self.host._blocks]
+            return out

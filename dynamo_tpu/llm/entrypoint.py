@@ -194,6 +194,9 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      decode_steps_per_sync: int = 8, mesh=None,
                      worker_id: int = 0, dp_rank: int = 0,
                      random_init: bool = False, kvbm_host_blocks: int = 0,
+                     kvbm_offload_queue: int = 0,
+                     kvbm_offload_workers: int = 0,
+                     kvbm_prefetch_blocks: int = 0,
                      quantize: Optional[str] = None,
                      draft_model: Optional[str] = None, spec_gamma: int = 4,
                      spec_iters_per_sync: int = 8, sp_degree: int = 0,
@@ -316,7 +319,11 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
     if kvbm_host_blocks:
         from dynamo_tpu.kvbm import KvbmConfig, KvbmManager
 
-        KvbmManager(engine, KvbmConfig(host_blocks=kvbm_host_blocks))
+        KvbmManager(engine, KvbmConfig(
+            host_blocks=kvbm_host_blocks,
+            offload_queue_depth=kvbm_offload_queue,
+            offload_workers=kvbm_offload_workers,
+            prefetch_blocks=kvbm_prefetch_blocks))
     # a checkpoint without tokenizer files (weight-only export, random-
     # init benchmarking) must not publish a card the frontend can't build
     has_tok = any(os.path.exists(os.path.join(path, f)) for f in

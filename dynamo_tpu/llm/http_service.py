@@ -364,9 +364,11 @@ class HttpService:
 
     async def _kvbm_status(self, request: web.Request) -> web.Response:
         """KVBM controller status (block_manager/controller.rs
-        ControlMessage::Status): per-tier occupancy + offload/onboard
-        stats from every worker running a KVBM manager. Workers without
-        KVBM simply expose no kvbm_controller endpoint and are absent."""
+        ControlMessage::Status): per-tier occupancy, offload/onboard
+        stats, and the async pipeline counters (queue depth, staged
+        bytes, prefetch hits, admission_stall_ms — docs/kvbm.md) from
+        every worker running a KVBM manager. Workers without KVBM simply
+        expose no kvbm_controller endpoint and are absent."""
         results = await self._fanout_admin("kvbm_controller",
                                            {"op": "status"})
         return web.json_response({"status": "success", "results": results})
@@ -542,7 +544,8 @@ class HttpService:
             "/v1/responses": ("Responses API (typed SSE events when "
                               "stream=true)", True),
             "/v1/models": ("Served models", False),
-            "/kvbm/status": ("KVBM per-tier occupancy + stats", False),
+            "/kvbm/status": ("KVBM per-tier occupancy + stats + "
+                             "pipeline counters", False),
             "/kvbm/reset": ("Flush KVBM tiers (level: g1/g2/g3/all)",
                             False),
             "/clear_kv_blocks": ("Drop every worker's reusable KV cache",

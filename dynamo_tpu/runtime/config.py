@@ -55,6 +55,14 @@ class RuntimeConfig:
     # cooldown, seconds.
     breaker_fail_limit: int = 3
     breaker_cooldown: float = 5.0
+    # KVBM async offload/onboard pipeline (kvbm/manager.py;
+    # docs/kvbm.md). All default to 0 = the synchronous in-scheduler
+    # behavior, byte-for-byte. Queue bound (blocks) for evictions staged
+    # to the background offload worker; tier-IO thread pool width;
+    # blocks prefetched per waiting request.
+    kvbm_offload_queue: int = 0
+    kvbm_offload_workers: int = 0
+    kvbm_prefetch_blocks: int = 0
     # Graceful shutdown drain timeout.
     shutdown_timeout: float = 30.0
     # Arbitrary extra engine/component settings.

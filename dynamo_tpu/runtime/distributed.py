@@ -56,6 +56,9 @@ class DistributedRuntime:
         # planes: the `_sys.stats` scrape and the Prometheus registry
         transport_server.extra_stats = self._robustness_stats
         self._wire_robustness_metrics()
+        # KVBM pipeline counters ride the same two planes once a worker
+        # calls wire_kvbm(manager)
+        self._kvbm_manager = None
         self._local_engines: dict[str, AsyncEngine] = {}
         self._shutdown = asyncio.Event()
         self._status_server = None
@@ -70,8 +73,11 @@ class DistributedRuntime:
     def _robustness_stats(self) -> dict:
         """Process-level failure-handling counters, merged into the
         `_sys.stats` scrape (service_stats.py picks them up per address)."""
-        return {"transport": dict(self.transport_client.stats),
-                "breaker": self.breaker.snapshot()}
+        out = {"transport": dict(self.transport_client.stats),
+               "breaker": self.breaker.snapshot()}
+        if self._kvbm_manager is not None:
+            out["kvbm"] = self._kvbm_manager.pipeline_stats()
+        return out
 
     def _wire_robustness_metrics(self) -> None:
         events = self.metrics.gauge(
@@ -90,6 +96,22 @@ class DistributedRuntime:
             for state, n in self.breaker.transitions.items():
                 transitions.set(n, state=state)
             open_g.set(self.breaker.open_count())
+
+        self.metrics.on_scrape(sync)
+
+    def wire_kvbm(self, manager) -> None:
+        """Export a KvbmManager's pipeline counters (docs/kvbm.md) on the
+        `_sys.stats` scrape and the Prometheus registry — the same two
+        planes as the robustness counters above."""
+        self._kvbm_manager = manager
+        g = self.metrics.gauge(
+            "kvbm_pipeline",
+            "KVBM offload/onboard pipeline counters by kind (blocks "
+            "unless the kind is suffixed _bytes/_ms/_pages)")
+
+        def sync() -> None:
+            for kind, v in manager.pipeline_stats().items():
+                g.set(v, kind=kind)
 
         self.metrics.on_scrape(sync)
 

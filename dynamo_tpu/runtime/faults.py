@@ -20,6 +20,11 @@ Spec grammar (``DYN_FAULTS`` env var, or `FaultInjector.from_spec`):
     kind=err               matching frame is replaced by an error frame
     kind=engine_err        FaultyEngine raises before yielding
     kind=engine_stall      FaultyEngine hangs (until context cancel)
+    kind=offload_delay     KVBM offload worker sleeps `delay_s` before a
+                           drained batch's gather (slow tier pipeline)
+    kind=offload_stall     KVBM offload worker parks forever (stuck
+                           pipeline; the bounded staging queue then
+                           backpressures evictions into the inline path)
 
     addr=<glob>            match the dialed/peer address   (default *)
     subject=<glob>         match the request subject       (default *)
@@ -64,9 +69,12 @@ ERR = "err"
 # engine-level fault kinds (FaultyEngine)
 ENGINE_ERR = "engine_err"
 ENGINE_STALL = "engine_stall"
+# KVBM pipeline fault kinds (kvbm/manager.py offload worker)
+OFFLOAD_DELAY = "offload_delay"
+OFFLOAD_STALL = "offload_stall"
 
 _KINDS = {CONNECT_REFUSED, DISCONNECT, STALL, DELAY, ERR,
-          ENGINE_ERR, ENGINE_STALL}
+          ENGINE_ERR, ENGINE_STALL, OFFLOAD_DELAY, OFFLOAD_STALL}
 
 
 @dataclass
@@ -204,6 +212,19 @@ class FaultInjector:
             return None
         if r.kind == ENGINE_ERR:
             return ("err", r.error)
+        return ("stall",)
+
+    def on_offload(self, point: str = "kvbm.offload") -> Optional[tuple]:
+        """Consulted by the KVBM offload worker before each drained
+        batch. ("delay", s): the worker sleeps, simulating slow tier IO;
+        ("stall",): the worker parks until cancelled — a stuck pipeline,
+        which the bounded staging queue must absorb by falling back to
+        inline eviction copies (pins released only at close)."""
+        r = self._fire((OFFLOAD_DELAY, OFFLOAD_STALL), None, point)
+        if r is None:
+            return None
+        if r.kind == OFFLOAD_DELAY:
+            return ("delay", r.delay_s)
         return ("stall",)
 
 

@@ -125,6 +125,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "stages (default: the stage count)")
     p.add_argument("--kvbm-host-blocks", type=int, default=0,
                    help="enable the KVBM host tier with this many blocks")
+    p.add_argument("--kvbm-offload-queue", type=int, default=None,
+                   help="async KVBM pipeline: staging-queue bound in "
+                        "blocks for background offload (default: "
+                        "DYN_KVBM_OFFLOAD_QUEUE or 0 = inline/sync)")
+    p.add_argument("--kvbm-offload-workers", type=int, default=None,
+                   help="tier-IO thread pool width (default: "
+                        "DYN_KVBM_OFFLOAD_WORKERS or 0 = one thread)")
+    p.add_argument("--kvbm-prefetch-blocks", type=int, default=None,
+                   help="blocks prefetched per waiting request into the "
+                        "staged host buffer (default: "
+                        "DYN_KVBM_PREFETCH_BLOCKS or 0 = off)")
     # mocker knobs
     p.add_argument("--mock-speedup", type=float, default=1.0)
     p.add_argument("--mock-decode-ms", type=float, default=4.0)
@@ -213,6 +224,9 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         worker_id=instance_id, mesh=mesh,
         random_init=args.random_init,
         kvbm_host_blocks=args.kvbm_host_blocks,
+        kvbm_offload_queue=args.kvbm_offload_queue or 0,
+        kvbm_offload_workers=args.kvbm_offload_workers or 0,
+        kvbm_prefetch_blocks=args.kvbm_prefetch_blocks or 0,
         quantize=args.quantize, draft_model=args.draft_model,
         spec_gamma=args.spec_gamma,
         spec_iters_per_sync=args.spec_iters_per_sync,
@@ -347,6 +361,15 @@ def main(argv=None) -> None:
         from dynamo_tpu.worker.monitor import EngineDeathMonitor
 
         cfg = runtime_config_from_args(args)
+        # unset pipeline flags fall back to the layered runtime config
+        # (DYN_KVBM_* env / config file) so fleets can flip the pipeline
+        # without touching every unit file
+        if args.kvbm_offload_queue is None:
+            args.kvbm_offload_queue = cfg.kvbm_offload_queue
+        if args.kvbm_offload_workers is None:
+            args.kvbm_offload_workers = cfg.kvbm_offload_workers
+        if args.kvbm_prefetch_blocks is None:
+            args.kvbm_prefetch_blocks = cfg.kvbm_prefetch_blocks
         rt = await DistributedRuntime.create(cfg)
         if args.encode_worker:
             from dynamo_tpu.multimodal import (
@@ -420,6 +443,8 @@ def main(argv=None) -> None:
                 worker_id=instance_id)
             await kvbm_dist.start()
             extra.append(_Stoppable(kvbm_dist.close))
+            # pipeline counters → _sys.stats scrape + Prometheus gauges
+            rt.wire_kvbm(engine.kvbm)
         handle = await serve_engine(rt, serving, card,
                                     instance_id=instance_id)
         monitor = EngineDeathMonitor(engine)

@@ -3,7 +3,7 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos kvbm-soak
+.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -28,3 +28,10 @@ chaos:
 # body the tier gates skip.
 kvbm-soak:
 	$(PYTEST) tests/test_kvbm_pipeline.py tests/test_kvbm.py
+
+# observability gate (docs/observability.md): one DYN_TRACE'd request
+# through frontend → TCP transport → engine must land in a single
+# connected trace; plus traceparent-through-retries, compile-tracker
+# warm path, breaker events, /debug/requests, doctor trace analyzer.
+trace-smoke:
+	$(PYTEST) tests/test_trace_smoke.py tests/test_tracing.py

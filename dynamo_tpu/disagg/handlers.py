@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, AsyncIterator, Optional
 
 import numpy as np
@@ -464,9 +465,13 @@ class DecodeWorkerHandler:
         # surface as ConnectionError inside _pull_kv → None; this bound
         # also covers the device/plane paths that never touch the wire.
         try:
+            t_pull = time.perf_counter()
             kv_data = await asyncio.wait_for(
                 self._pull_kv(ktp, context),
                 self.pull_deadline or None)
+            em = getattr(self.engine, "metrics", None)
+            if em is not None:
+                em.kv_pull.observe(time.perf_counter() - t_pull)
         except asyncio.TimeoutError:
             logger.warning("KV pull for transfer %s exceeded %.1fs; "
                            "serving locally", ktp.get("transfer_id"),

@@ -75,6 +75,13 @@ def _frontend(url: str):
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "trace":
+        # subcommand dispatch ahead of argparse: `doctor trace x.jsonl`
+        # analyzes a DYN_TRACE span file (doctor/trace.py)
+        from dynamo_tpu.doctor.trace import main as trace_main
+
+        return trace_main(argv[1:])
     p = argparse.ArgumentParser(prog="python -m dynamo_tpu.doctor")
     p.add_argument("--store", default=None,
                    help="control-plane url to ping (tcp://host:port)")

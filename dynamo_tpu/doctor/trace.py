@@ -1,0 +1,178 @@
+"""`python -m dynamo_tpu.doctor trace trace.jsonl` — offline analysis of
+DYN_TRACE output.
+
+The tracer (runtime/tracing.py) writes one OTLP-shaped span JSON object
+per line. This reconstructs the span trees per trace id and prints:
+
+- the span tree (indentation = parent/child), with wall durations and
+  recorded events (enqueued/admitted/first_token/compile/...);
+- a per-stage breakdown aggregated over every trace (count, total,
+  mean, max per span name) — where the corpus spent its time;
+- the critical path of the slowest trace: from the root, repeatedly
+  descend into the child that finishes last, reporting each hop's own
+  duration — the chain an optimizer has to shorten.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Optional, TextIO
+
+
+def load_spans(fp: TextIO) -> list[dict]:
+    """Parse a JSONL trace file, skipping non-span lines. The Recorder
+    wraps each span as {"timestamp": ..., "event": <span>}; bare span
+    objects are accepted too."""
+    spans = []
+    for line in fp:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("event"), dict):
+            obj = obj["event"]
+        if isinstance(obj, dict) and obj.get("traceId") \
+                and obj.get("spanId"):
+            spans.append(obj)
+    return spans
+
+
+def _dur_ms(span: dict) -> float:
+    return max(span.get("endTimeUnixNano", 0)
+               - span.get("startTimeUnixNano", 0), 0) / 1e6
+
+
+def _attr(span: dict, key: str) -> Optional[str]:
+    for a in span.get("attributes", ()):
+        if a.get("key") == key:
+            return (a.get("value") or {}).get("stringValue")
+    return None
+
+
+class TraceTree:
+    """One trace id's spans, indexed for tree walks."""
+
+    def __init__(self, trace_id: str, spans: list[dict]) -> None:
+        self.trace_id = trace_id
+        self.spans = sorted(spans,
+                            key=lambda s: s.get("startTimeUnixNano", 0))
+        self.by_id = {s["spanId"]: s for s in self.spans}
+        self.children: dict[str, list[dict]] = defaultdict(list)
+        self.roots: list[dict] = []
+        for s in self.spans:
+            parent = s.get("parentSpanId") or ""
+            if parent and parent in self.by_id:
+                self.children[parent].append(s)
+            else:
+                self.roots.append(s)
+
+    @property
+    def start_ns(self) -> int:
+        return min((s.get("startTimeUnixNano", 0) for s in self.spans),
+                   default=0)
+
+    @property
+    def duration_ms(self) -> float:
+        if not self.spans:
+            return 0.0
+        end = max(s.get("endTimeUnixNano", 0) for s in self.spans)
+        return max(end - self.start_ns, 0) / 1e6
+
+    def critical_path(self) -> list[dict]:
+        """Root-to-leaf chain via the child that finishes last at each
+        level — the spans whose durations bound the trace's wall time."""
+        if not self.roots:
+            return []
+        cur = max(self.roots, key=lambda s: s.get("endTimeUnixNano", 0))
+        path = [cur]
+        while True:
+            kids = self.children.get(cur["spanId"])
+            if not kids:
+                return path
+            cur = max(kids, key=lambda s: s.get("endTimeUnixNano", 0))
+            path.append(cur)
+
+    def render(self, events: bool = True) -> list[str]:
+        lines = [f"trace {self.trace_id}  "
+                 f"({len(self.spans)} spans, {self.duration_ms:.2f} ms)"]
+        t0 = self.start_ns
+
+        def walk(span: dict, depth: int) -> None:
+            pad = "  " * (depth + 1)
+            off = (span.get("startTimeUnixNano", 0) - t0) / 1e6
+            status = span.get("status", {}).get("code", "OK")
+            flag = "" if status == "OK" else f"  [{status}]"
+            lines.append(f"{pad}{span['name']:<24} "
+                         f"+{off:9.3f} ms  {_dur_ms(span):9.3f} ms{flag}")
+            if events:
+                for ev in span.get("events", ()):
+                    eoff = (ev.get("timeUnixNano", 0) - t0) / 1e6
+                    attrs = ", ".join(
+                        f"{a['key']}={a['value'].get('stringValue')}"
+                        for a in ev.get("attributes", ()))
+                    lines.append(f"{pad}  * {ev.get('name'):<20} "
+                                 f"+{eoff:9.3f} ms"
+                                 + (f"  ({attrs})" if attrs else ""))
+            for kid in self.children.get(span["spanId"], ()):
+                walk(kid, depth + 1)
+
+        for root in self.roots:
+            walk(root, 0)
+        return lines
+
+
+def analyze(spans: list[dict], events: bool = True) -> list[str]:
+    by_trace: dict[str, list[dict]] = defaultdict(list)
+    for s in spans:
+        by_trace[s["traceId"]].append(s)
+    trees = sorted((TraceTree(tid, ss) for tid, ss in by_trace.items()),
+                   key=lambda t: t.start_ns)
+    out: list[str] = [f"{len(spans)} spans in {len(trees)} trace(s)", ""]
+    for tree in trees:
+        out.extend(tree.render(events=events))
+        out.append("")
+
+    # per-stage breakdown across the whole corpus
+    agg: dict[str, list[float]] = defaultdict(list)
+    for s in spans:
+        agg[s["name"]].append(_dur_ms(s))
+    out.append("per-stage breakdown (all traces):")
+    out.append(f"  {'stage':<26} {'count':>6} {'total ms':>10} "
+               f"{'mean ms':>9} {'max ms':>9}")
+    for name, ds in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        out.append(f"  {name:<26} {len(ds):>6} {sum(ds):>10.3f} "
+                   f"{sum(ds) / len(ds):>9.3f} {max(ds):>9.3f}")
+
+    if trees:
+        slow = max(trees, key=lambda t: t.duration_ms)
+        out.append("")
+        out.append(f"critical path (slowest trace {slow.trace_id}, "
+                   f"{slow.duration_ms:.2f} ms):")
+        for hop in slow.critical_path():
+            out.append(f"  {hop['name']:<26} {_dur_ms(hop):9.3f} ms")
+    return out
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m dynamo_tpu.doctor trace <trace.jsonl> "
+              "[--no-events]")
+        return 0 if argv else 2
+    path = argv[0]
+    events = "--no-events" not in argv[1:]
+    try:
+        with open(path, "r", encoding="utf-8") as fp:
+            spans = load_spans(fp)
+    except OSError as e:
+        print(f"doctor trace: cannot read {path}: {e}")
+        return 1
+    if not spans:
+        print(f"doctor trace: no spans found in {path} "
+              "(was DYN_TRACE=1 set?)")
+        return 1
+    print("\n".join(analyze(spans, events=events)))
+    return 0

@@ -31,9 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dynamo_tpu.engine.metrics import EngineMetrics
 from dynamo_tpu.engine.pages import PagePool
 from dynamo_tpu.engine.sampling import sample_tokens_lp
-from dynamo_tpu.llm.perf import itl_new_hist, itl_observe, itl_percentile
+from dynamo_tpu.llm.perf import itl_percentile
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     decode_multi_step,
@@ -56,6 +57,7 @@ from dynamo_tpu.protocols import (
     WorkerStats,
 )
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.tracing import RequestTrace
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
@@ -312,6 +314,16 @@ class _Seq:
     finished: bool = False
     seed: int = 0
     arrival: int = 0
+    # lifecycle timestamps (perf_counter for metrics, time_ns for span
+    # boundaries) + the per-request trace handle. `trace` is None unless
+    # DYN_TRACE is on — every scheduler touch is `if seq.trace is not
+    # None`, so disabled tracing allocates nothing on the hot loop.
+    t_enqueue: float = 0.0
+    t_enqueue_ns: int = 0
+    t_admit_ns: int = 0
+    t_first_ns: int = 0
+    trace: Optional[RequestTrace] = None
+    decode_compiled: bool = False         # a decode burst compiled mid-flight
 
     @property
     def pos(self) -> int:
@@ -629,30 +641,15 @@ class TpuEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self._progress = 0  # scheduler forward-progress token (canary)
-        # Cumulative phase counters (bench/perf tooling reads deltas):
-        # wall time inside prefill / decode scheduler steps, prompt
-        # tokens newly prefilled (cache hits excluded), and tokens
-        # emitted overall vs by prefill (decode emits = difference).
-        # The reference separates these phases at the metrics layer too
-        # (TTFT vs ITL in aiperf; ForwardPassMetrics prefill/decode
-        # queues) — here the split is measured at the source.
-        # prefill_chunks counts chunk ROUNDS (device dispatches), mixed
-        # or plain; decode_steps_during_prefill counts decode steps that
-        # ran while some admitted prompt's prefill was still mid-flight
-        # (the interleaving the budgeted scheduler exists to create);
-        # itl_hist is the llm/perf.py bucket histogram of per-lane
-        # inter-emission gaps (ms) — snapshot with list() before
-        # delta-ing, the engine mutates it in place.
-        self.perf = {"prefill_s": 0.0, "decode_s": 0.0,
-                     "prefill_new_tokens": 0, "prefill_emitted": 0,
-                     "tokens_emitted": 0, "pipelined_bursts": 0,
-                     "prefill_chunks": 0, "decode_steps_during_prefill": 0,
-                     "mixed_steps": 0, "itl_hist": itl_new_hist(),
-                     # wall time _admit spends per admission on page
-                     # allocation (inline eviction gathers ride here) +
-                     # tier onboard — the stall the async KVBM pipeline
-                     # (docs/kvbm.md) exists to shrink
-                     "admission_stall_ms": 0.0}
+        # ONE bookkeeping path (engine/metrics.py): the scheduler
+        # observes into these histograms/counters directly; `/metrics`,
+        # `_sys.stats` scheduler_stats, and bench all read the same
+        # objects. The historical `perf` dict survives as a derived
+        # read-only property below. The reference separates prefill/
+        # decode phases at the metrics layer too (TTFT vs ITL in aiperf;
+        # ForwardPassMetrics prefill/decode queues) — here the split is
+        # measured at the source.
+        self.metrics = EngineMetrics()
         # raw ITL samples (ms), capped FIFO — bench reads these for
         # exact percentiles; the wire carries only the histogram
         self.itl_samples: list[float] = []
@@ -683,6 +680,14 @@ class TpuEngine:
         # deadline)); reaped by the scheduler loop after transfer_ttl.
         self._transfers: dict[str, tuple[list[int], int, float]] = {}
         self.transfer_ttl = 60.0
+
+    @property
+    def perf(self) -> dict:
+        """Legacy cumulative-counter view, DERIVED from `self.metrics`
+        (one source of truth): snapshot with `dict(eng.perf)` and delta
+        as before. Writes to the returned dict are discarded — the
+        scheduler observes into `self.metrics` directly."""
+        return self.metrics.perf_view()
 
     @property
     def _burst_lookahead(self) -> int:
@@ -789,6 +794,14 @@ class TpuEngine:
                     ).to_dict()
                     return
                 import_kv = (data, plen)
+            # trace root parented to the transport serve span (remote:
+            # ctx.headers traceparent) or the caller task's current span
+            # (in-proc fast path). None when DYN_TRACE is off — the
+            # scheduler never allocates a span for untraced requests.
+            trace = RequestTrace.begin(
+                "engine.request", getattr(context, "headers", None),
+                {"request.id": context.request_id,
+                 "engine.worker_id": cfg.worker_id})
             seq = _Seq(
                 req=req, ctx=context, queue=asyncio.Queue(),
                 token_seq=TokenBlockSequence(mcfg.page_size),
@@ -800,7 +813,14 @@ class TpuEngine:
                 seed=(req.sampling.seed if req.sampling.seed is not None
                       else int(self._rng.randint(0, 2**31 - 1))),
                 arrival=self._arrivals,
+                t_enqueue=time.perf_counter(),
+                t_enqueue_ns=time.time_ns(),
+                trace=trace,
             )
+            if trace is not None:
+                trace.event("enqueued", waiting=len(self._waiting),
+                            running=len(self._running),
+                            prompt_tokens=len(req.token_ids))
             self._arrivals += 1
             self._ensure_loop()
             self._waiting.append(seq)
@@ -868,6 +888,9 @@ class TpuEngine:
             await self.kvbm.close()
         # unblock any generate() caller still awaiting its queue
         for s in self._running + self._waiting:
+            if s.trace is not None:
+                s.trace.end(status="ERROR",
+                            finish_reason=FINISH_CANCELLED)
             s.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=FINISH_CANCELLED).to_dict())
             s.queue.put_nowait(None)
@@ -933,10 +956,11 @@ class TpuEngine:
                     progressed = await self._prefill_pending()
                 t1 = time.perf_counter()
                 if progressed:
-                    self.perf["prefill_s"] += t1 - t0
+                    self.metrics.prefill_seconds.inc(t1 - t0)
                 decoded = await self._decode_iter()
                 if decoded:
-                    self.perf["decode_s"] += time.perf_counter() - t1
+                    self.metrics.decode_seconds.inc(
+                        time.perf_counter() - t1)
                 progressed |= decoded
                 self._publish_metrics()
                 if progressed:
@@ -965,6 +989,8 @@ class TpuEngine:
     def _fail_all(self) -> None:
         self._drain_inflight_sync()
         for s in self._running + self._waiting:
+            if s.trace is not None:
+                s.trace.end(status="ERROR", finish_reason=FINISH_ERROR)
             s.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=FINISH_ERROR,
                 extra={"error": "engine step failed"}).to_dict())
@@ -1035,15 +1061,15 @@ class TpuEngine:
                 # them); cached_len comes from the transfer, not hashing
                 alloc = self._alloc_admission([], len(cand.prompt))
                 if alloc is None:
-                    self.perf["admission_stall_ms"] += \
-                        (time.perf_counter() - t_adm) * 1e3
+                    self.metrics.admission_stall.observe(
+                        time.perf_counter() - t_adm)
                     break
                 cand.pages, cand.cached_len = alloc[0], cand.import_kv[1]
             else:
                 alloc = self._alloc_admission(hashes, len(cand.prompt))
                 if alloc is None:
-                    self.perf["admission_stall_ms"] += \
-                        (time.perf_counter() - t_adm) * 1e3
+                    self.metrics.admission_stall.observe(
+                        time.perf_counter() - t_adm)
                     break
                 cand.pages, cand.cached_len = alloc
                 if self.kvbm is not None:
@@ -1054,8 +1080,19 @@ class TpuEngine:
             # allocation covers any inline eviction gathers; onboard
             # covers tier reads + the device write — both shrink when
             # the async pipeline stages them ahead of time
-            self.perf["admission_stall_ms"] += \
-                (time.perf_counter() - t_adm) * 1e3
+            self.metrics.admission_stall.observe(
+                time.perf_counter() - t_adm)
+            self.metrics.queue_wait.observe(
+                max(time.perf_counter() - cand.t_enqueue, 0.0))
+            if cand.trace is not None:
+                now_ns = time.time_ns()
+                cand.trace.stage(
+                    "engine.queue_wait", cand.t_enqueue_ns, now_ns,
+                    cached_len=cand.cached_len,
+                    prompt_tokens=len(cand.prompt))
+                cand.trace.event("admitted",
+                                 running=len(self._running) + 1)
+                cand.t_admit_ns = now_ns
             # budgeted prefill resumes from here; legacy prefill keys its
             # offsets off cached_len directly and ignores the cursor
             cand.prefill_pos = cand.cached_len
@@ -1111,8 +1148,8 @@ class TpuEngine:
                     self.dk_cache, self.dv_cache, d_offsets)
             return self._first_token_packed(pending, last_logits)
 
-        self.perf["prefill_new_tokens"] += sum(
-            max(len(s.prompt) - s.cached_len, 0) for s in pending)
+        self.metrics.prefill_new_tokens.inc(sum(
+            max(len(s.prompt) - s.cached_len, 0) for s in pending))
         async with self._device_lock:
             packed, tk = await asyncio.to_thread(prefill_all)
         self._emit_first_tokens(pending, packed, tk, draft_done=True)
@@ -1168,16 +1205,18 @@ class TpuEngine:
                 guided_mask)
         tk = (self.TOPK_WIDTH
               if any(s.wants_topk for s in pending) else 0)
-        sampled = sample_tokens_lp(
-            logits_stack,
-            arr(lambda s: s.seed, np.uint32),
-            arr(lambda s: s.generated, np.uint32),
-            arr(lambda s: s.req.sampling.temperature, np.float32),
-            arr(lambda s: s.req.sampling.top_p, np.float32),
-            arr(lambda s: s.req.sampling.top_k, np.int32),
-            arr(lambda s: s.req.sampling.min_p, np.float32),
-            topk_lp=tk)
-        return np.asarray(sampled), tk                # ONE host sync
+        with self.metrics.compile.track("sample_first", (width, tk)):
+            sampled = sample_tokens_lp(
+                logits_stack,
+                arr(lambda s: s.seed, np.uint32),
+                arr(lambda s: s.generated, np.uint32),
+                arr(lambda s: s.req.sampling.temperature, np.float32),
+                arr(lambda s: s.req.sampling.top_p, np.float32),
+                arr(lambda s: s.req.sampling.top_k, np.int32),
+                arr(lambda s: s.req.sampling.min_p, np.float32),
+                topk_lp=tk)
+            out = np.asarray(sampled)                 # ONE host sync
+        return out, tk
 
     def _emit_first_tokens(self, pending: list[_Seq], packed: np.ndarray,
                            tk: int, draft_done: bool) -> None:
@@ -1189,7 +1228,7 @@ class TpuEngine:
         mcfg = self.model_cfg
         tokens = packed[0].astype(np.int32)
         logprobs = packed[1]
-        self.perf["prefill_emitted"] += len(pending)
+        self.metrics.prefill_emitted.inc(len(pending))
         for i, (seq, token, lp) in enumerate(zip(pending, tokens,
                                                  logprobs)):
             # token_seq mirrors what prefill wrote to the device; register
@@ -1298,7 +1337,7 @@ class TpuEngine:
             return needs_stage
         picks = picks[:self._prefill_width(len(picks))]
         chunk_lens = [caps[id(s)] for s in picks]
-        self.perf["prefill_new_tokens"] += sum(chunk_lens)
+        self.metrics.prefill_new_tokens.inc(sum(chunk_lens))
 
         # fuse the round with a decode burst when nothing forces a
         # special burst shape: no burst already in flight, no draft/pp
@@ -1382,29 +1421,37 @@ class TpuEngine:
             top_ks[i] = s.req.sampling.top_k
         tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
 
+        trk = self.metrics.compile.track(
+            "mixed_step", (bp, t_bucket, k_steps, int(aligned), tk))
+
         def dispatch():
-            packed, ch_logits, kc, vc = mixed_prefill_decode(
-                self.params, self.k_cache, self.v_cache,
-                jax.numpy.asarray(ch_toks),
-                jax.numpy.asarray(ch_tables),
-                jax.numpy.asarray(ch_cached),
-                jax.numpy.asarray(ch_seq_lens),
-                jax.numpy.asarray(tokens), jax.numpy.asarray(positions),
-                jax.numpy.asarray(page_tables),
-                jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
-                jax.numpy.asarray(steps), jax.numpy.asarray(temps),
-                jax.numpy.asarray(top_ps), jax.numpy.asarray(top_ks),
-                mcfg, k_steps, aligned, tk)
-            # ONE host sync; chunk logits stay on device for the
-            # first-token sampler
-            return np.asarray(packed), ch_logits, kc, vc
+            with trk:
+                packed, ch_logits, kc, vc = mixed_prefill_decode(
+                    self.params, self.k_cache, self.v_cache,
+                    jax.numpy.asarray(ch_toks),
+                    jax.numpy.asarray(ch_tables),
+                    jax.numpy.asarray(ch_cached),
+                    jax.numpy.asarray(ch_seq_lens),
+                    jax.numpy.asarray(tokens),
+                    jax.numpy.asarray(positions),
+                    jax.numpy.asarray(page_tables),
+                    jax.numpy.asarray(valid), jax.numpy.asarray(seeds),
+                    jax.numpy.asarray(steps), jax.numpy.asarray(temps),
+                    jax.numpy.asarray(top_ps),
+                    jax.numpy.asarray(top_ks),
+                    mcfg, k_steps, aligned, tk)
+                # ONE host sync; chunk logits stay on device for the
+                # first-token sampler
+                return np.asarray(packed), ch_logits, kc, vc
 
         async with self._device_lock:
             packed, ch_logits, self.k_cache, self.v_cache = \
                 await asyncio.to_thread(dispatch)
-        self.perf["prefill_chunks"] += 1
-        self.perf["mixed_steps"] += 1
-        self.perf["decode_steps_during_prefill"] += k_steps
+        self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        self.metrics.mixed_steps.inc()
+        self.metrics.decode_steps_during_prefill.inc(k_steps)
+        self._mark_decode_compile(batch, trk)
+        self._trace_chunk(picks, chunk_lens, trk, mixed=True)
         done_logits: dict[int, Any] = {}
         for i, s in enumerate(picks):
             offsets[id(s)] += chunk_lens[i]
@@ -1414,6 +1461,21 @@ class TpuEngine:
         self._emit_burst(batch, packed, k_steps, tk)
         await self._finish_first_tokens(picks, done_logits)
         return True
+
+    def _trace_chunk(self, picks: list[_Seq], chunk_lens: list[int],
+                     trk, mixed: bool = False) -> None:
+        """Per-traced-pick prefill-chunk stage span. With tracing off
+        every pick's trace is None — the scan allocates nothing."""
+        if all(s.trace is None for s in picks):
+            return
+        end_ns = time.time_ns()
+        start_ns = end_ns - int(trk.elapsed_s * 1e9)
+        for i, s in enumerate(picks):
+            if s.trace is not None:
+                s.trace.stage(
+                    "engine.prefill.chunk", start_ns, end_ns,
+                    tokens=chunk_lens[i], entry=trk.entry,
+                    mixed=mixed, compiled=trk.compiled)
 
     async def _finish_first_tokens(self, picks: list[_Seq],
                                    done_logits: dict[int, Any]) -> None:
@@ -1455,11 +1517,14 @@ class TpuEngine:
             tables[i, :len(s.pages)] = s.pages
             cached[i] = off
             seq_lens[i] = off + n
-        logits, self.k_cache, self.v_cache = pp_prefill_paged(
-            self.params, self.k_cache, self.v_cache,
-            jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
-            cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
-        self.perf["prefill_chunks"] += 1
+        trk = self.metrics.compile.track("pp_prefill", (b_pad, t_pad))
+        with trk:
+            logits, self.k_cache, self.v_cache = pp_prefill_paged(
+                self.params, self.k_cache, self.v_cache,
+                jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
+                cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
+        self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        self._trace_chunk(picks, takes, trk)
         done: dict[int, Any] = {}
         for i, s in enumerate(picks):
             offsets[id(s)] += takes[i]
@@ -1549,7 +1614,7 @@ class TpuEngine:
             # decode progressed while some prompt's prefill is still
             # mid-flight — the interleaving the budgeted scheduler
             # exists to create (every path below dispatches a burst)
-            self.perf["decode_steps_during_prefill"] += k_steps
+            self.metrics.decode_steps_during_prefill.inc(k_steps)
         max_pages = mcfg.max_pages_per_seq
         tokens = np.zeros(b, dtype=np.int32)
         positions = np.zeros(b, dtype=np.int32)
@@ -1608,6 +1673,11 @@ class TpuEngine:
                     prompt_counts=jax.numpy.asarray(p_cnt),
                     out_counts=jax.numpy.asarray(o_cnt))
 
+            trk = self.metrics.compile.track(
+                "spec_decode",
+                (b, cfg.spec_gamma, cfg.spec_iters_per_sync, tk,
+                 *sorted(gkw)))
+
             def run_spec_burst():
                 packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
                     self.params, self.draft_params,
@@ -1623,8 +1693,11 @@ class TpuEngine:
                 return np.asarray(packed), kc, vc, dk, dv  # ONE host sync
 
             async with self._device_lock:
-                (packed, self.k_cache, self.v_cache, self.dk_cache,
-                 self.dv_cache) = await asyncio.to_thread(run_spec_burst)
+                with trk:
+                    (packed, self.k_cache, self.v_cache, self.dk_cache,
+                     self.dv_cache) = \
+                        await asyncio.to_thread(run_spec_burst)
+            self._mark_decode_compile(batch, trk)
             toks_out = packed[0].astype(np.int32)   # (S, gamma+1, B)
             lps_out = packed[1]                     # (S, gamma+1, B)
             counts = packed[2, :, 0, :].astype(np.int32)  # (S, B)
@@ -1718,9 +1791,13 @@ class TpuEngine:
                     n_micro=cfg.pp_microbatches, topk_lp=tk, **ckw)
                 return np.asarray(packed), kc, vc     # ONE host sync
 
+            trk = self.metrics.compile.track(
+                "pp_decode", (b, k_steps, tk, bool(ckw)))
             async with self._device_lock:
-                packed, self.k_cache, self.v_cache = \
-                    await asyncio.to_thread(run_pp_burst)
+                with trk:
+                    packed, self.k_cache, self.v_cache = \
+                        await asyncio.to_thread(run_pp_burst)
+            self._mark_decode_compile(batch, trk)
             self._emit_burst(batch, packed, k_steps, tk)
             return True
 
@@ -1742,9 +1819,13 @@ class TpuEngine:
                     jax.numpy.asarray(top_ks), mcfg, k_steps,
                     topk_lp=tk)
 
+            trk = self.metrics.compile.track(
+                "decode_burst", (b, k_steps, tk))
             async with self._device_lock:
-                packed_dev, self.k_cache, self.v_cache = \
-                    await asyncio.to_thread(dispatch)
+                with trk:
+                    packed_dev, self.k_cache, self.v_cache = \
+                        await asyncio.to_thread(dispatch)
+            self._mark_decode_compile(batch, trk)
             self._inflight = {
                 "k": k_steps, "batch": batch, "packed": packed_dev,
                 "positions": positions, "valid": valid, "seeds": seeds,
@@ -1782,11 +1863,29 @@ class TpuEngine:
                 jax.numpy.asarray(top_ks), mcfg, k_steps, topk_lp=tk)
             return np.asarray(sampled), kc, vc            # ONE host sync
 
+        trk = self.metrics.compile.track(
+            "decode_guided" if use_constrained else "decode_burst",
+            (b, k_steps, tk))
         async with self._device_lock:
-            packed, self.k_cache, self.v_cache = \
-                await asyncio.to_thread(run_burst)
+            with trk:
+                packed, self.k_cache, self.v_cache = \
+                    await asyncio.to_thread(run_burst)
+        self._mark_decode_compile(batch, trk)
         self._emit_burst(batch, packed, k_steps, tk)
         return True
+
+    def _mark_decode_compile(self, batch: list[_Seq], trk) -> None:
+        """Flag this burst's lanes when the dispatch paid an XLA compile
+        — their `engine.decode` span (and any traced lane's compile
+        event) gets `compiled=true` so the ITL outlier is attributable."""
+        if not trk.compiled:
+            return
+        for s in batch:
+            s.decode_compiled = True
+            if s.trace is not None:
+                s.trace.event("compile", entry=trk.entry,
+                              shape="x".join(str(x) for x in trk.shape),
+                              seconds=round(trk.elapsed_s, 4))
 
     def _emit_burst(self, batch: list[_Seq], packed: np.ndarray,
                     k_steps: int, tk: int = 0) -> None:
@@ -1995,12 +2094,18 @@ class TpuEngine:
             tables[i, :len(s.pages)] = s.pages
             cached[i] = off
             seq_lens[i] = off + n
-        logits_b, kc, vc = prefill_batch(
-            params_, kc, vc,
-            jax.numpy.asarray(toks), jax.numpy.asarray(tables),
-            jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
-            model_cfg, aligned)
-        self.perf["prefill_chunks"] += 1
+        trk = self.metrics.compile.track(
+            "prefill_draft" if (self.draft_params is not None
+                                and params_ is self.draft_params)
+            else "prefill", (bp, t_bucket, int(aligned)))
+        with trk:
+            logits_b, kc, vc = prefill_batch(
+                params_, kc, vc,
+                jax.numpy.asarray(toks), jax.numpy.asarray(tables),
+                jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
+                model_cfg, aligned)
+        self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        self._trace_chunk(active, chunk_lens, trk)
         done: dict[int, Any] = {}
         for i, s in enumerate(active):
             offsets[id(s)] += chunk_lens[i]
@@ -2339,7 +2444,7 @@ class TpuEngine:
                 async with self._device_lock:
                     packed2, self.k_cache, self.v_cache = \
                         await asyncio.to_thread(dispatch2)
-                self.perf["pipelined_bursts"] += 1
+                self.metrics.pipelined_bursts.inc()
                 nxt = {"k": k, "batch": batch, "packed": packed2,
                        "positions": inf["positions"] + k,
                        "valid": inf["valid"], "seeds": inf["seeds"],
@@ -2404,10 +2509,22 @@ class TpuEngine:
             # rounds that ran between this lane's bursts (the stall the
             # budgeted scheduler exists to bound)
             gap_ms = (now - seq.last_emit_t) * 1000.0
-            itl_observe(self.perf["itl_hist"], gap_ms)
+            self.metrics.itl.observe(gap_ms)
             self.itl_samples.append(gap_ms)
             if len(self.itl_samples) > self.ITL_SAMPLE_CAP:
                 del self.itl_samples[:-self.ITL_SAMPLE_CAP]
+        elif seq.generated == 0:
+            # this lane's FIRST emission: TTFT measured at the source
+            self.metrics.ttft.observe(
+                max(time.perf_counter() - seq.t_enqueue, 0.0))
+            if seq.trace is not None:
+                seq.t_first_ns = time.time_ns()
+                if seq.t_admit_ns:
+                    seq.trace.stage("engine.prefill", seq.t_admit_ns,
+                                    seq.t_first_ns,
+                                    prompt_tokens=len(seq.prompt),
+                                    cached_len=seq.cached_len)
+                seq.trace.event("first_token")
         seq.last_emit_t = now
         emit_toks = [int(t) for t in toks[:n_emit]]
         guided = seq.guided
@@ -2430,7 +2547,7 @@ class TpuEngine:
                 seq.out_counter[t] = seq.out_counter.get(t, 0) + 1
             seq.next_token = t
         seq.generated += n_emit
-        self.perf["tokens_emitted"] += n_emit
+        self.metrics.tokens_emitted.inc(n_emit)
         out = EngineOutput(token_ids=emit_toks, finish_reason=finish)
         if lps is not None:
             out.log_probs = [float(x) for x in lps[:n_emit]]
@@ -2466,6 +2583,16 @@ class TpuEngine:
 
     def _finish(self, seq: _Seq, reason: str, emit: bool = True,
                 release_pages: bool = True) -> None:
+        if seq.trace is not None:
+            end_ns = time.time_ns()
+            if seq.t_first_ns:
+                seq.trace.stage("engine.decode", seq.t_first_ns, end_ns,
+                                tokens=seq.generated,
+                                compiled=seq.decode_compiled)
+            seq.trace.end(
+                status="OK" if reason in (FINISH_STOP, FINISH_LENGTH)
+                else "ERROR",
+                finish_reason=reason, tokens=seq.generated)
         seq.finished = True
         if seq in self._running:
             self._running.remove(seq)
@@ -2504,8 +2631,10 @@ class TpuEngine:
         lengths)."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
         with self._kv_buffer_lock:
-            out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
-            out.block_until_ready()
+            with self.metrics.compile.track("gather_kv",
+                                            (len(page_ids),)):
+                out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
+                out.block_until_ready()
         return out
 
     def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
@@ -2543,8 +2672,11 @@ class TpuEngine:
         see _write_kv_pages_jit."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
         with self._kv_buffer_lock:
-            self.k_cache, self.v_cache = _write_kv_pages_jit(
-                self.k_cache, self.v_cache, ids, jax.numpy.asarray(data))
+            with self.metrics.compile.track("write_kv",
+                                            (len(page_ids),)):
+                self.k_cache, self.v_cache = _write_kv_pages_jit(
+                    self.k_cache, self.v_cache, ids,
+                    jax.numpy.asarray(data))
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown
@@ -2580,6 +2712,8 @@ class TpuEngine:
     def _preempt(self, seq: _Seq) -> None:
         """Release pages, fold generated tokens into the prompt, requeue at
         the head (re-prefill later; mocker/scheduler.rs preemption)."""
+        if seq.trace is not None:
+            seq.trace.event("preempted", generated=seq.generated)
         if seq in self._running:
             self._running.remove(seq)
         self.pool.release_sequence(seq.pages)
@@ -2596,6 +2730,7 @@ class TpuEngine:
     def _publish_metrics(self) -> None:
         if self.metrics_sink is None:
             return
+        perf = self.perf     # ONE derived snapshot of self.metrics
         self.metrics_sink(ForwardPassMetrics(
             worker_id=self.config.worker_id, dp_rank=self.config.dp_rank,
             worker_stats=WorkerStats(
@@ -2608,13 +2743,14 @@ class TpuEngine:
                 hbm_cache_usage=self.pool.usage()),
             spec_decode_stats=self._spec_stats,
             scheduler_stats={
-                "prefill_chunks": self.perf["prefill_chunks"],
+                "prefill_chunks": perf["prefill_chunks"],
                 "decode_steps_during_prefill":
-                    self.perf["decode_steps_during_prefill"],
-                "mixed_steps": self.perf["mixed_steps"],
-                "itl_p50_ms": itl_percentile(self.perf["itl_hist"], 0.5),
-                "itl_p99_ms": itl_percentile(self.perf["itl_hist"], 0.99),
+                    perf["decode_steps_during_prefill"],
+                "mixed_steps": perf["mixed_steps"],
+                "itl_p50_ms": itl_percentile(perf["itl_hist"], 0.5),
+                "itl_p99_ms": itl_percentile(perf["itl_hist"], 0.99),
                 "admission_stall_ms":
-                    round(self.perf["admission_stall_ms"], 3),
+                    round(perf["admission_stall_ms"], 3),
+                "compiles": self.metrics.compile.total,
             },
         ))

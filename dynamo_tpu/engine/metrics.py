@@ -1,0 +1,132 @@
+"""Engine latency/throughput metrics — the ONE bookkeeping path.
+
+Replaces the `TpuEngine.perf` dict-plus-manual-publish pattern: the
+scheduler observes directly into these `runtime.metrics` histograms and
+counters, and every consumer reads the same objects —
+
+  * `/metrics` (Prometheus): `EngineMetrics.register(rt.metrics)` adopts
+    the fully-named metrics into the runtime registry;
+  * `_sys.stats` / `scheduler_stats`: `_publish_metrics` reads the same
+    histograms;
+  * bench and old tests: `TpuEngine.perf` is now a **derived property**
+    returning this class's `perf_view()` — the legacy key set, computed
+    from the metrics, so numeric deltas between `dict(eng.perf)`
+    snapshots keep working with no second bookkeeping path.
+
+Metric names are fixed at construction (`dynamo_engine_*`) rather than
+registry-prefixed: the engine exists before (and without) any
+DistributedRuntime, and the names must match docs/observability.md
+whether or not a registry ever adopts them.
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.engine.compile_tracker import CompileTracker
+from dynamo_tpu.llm.perf import ITL_BUCKET_EDGES_MS
+from dynamo_tpu.runtime.metrics import (Counter, Histogram,
+                                        MetricsRegistry)
+
+# second-scale stage latencies: sub-ms admission checks up to multi-
+# second cold prefills
+_STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                  30.0)
+# ITL buckets reuse the wire histogram's edges (llm/perf.py) so the
+# Prometheus view, scheduler_stats percentiles, and offline analysis
+# agree on bucket meaning. The +Inf edge is implicit in Histogram.
+_ITL_BUCKETS_MS = tuple(e for e in ITL_BUCKET_EDGES_MS
+                        if e != float("inf"))
+
+
+class EngineMetrics:
+    """Owned by one engine (TpuEngine or MockEngine)."""
+
+    def __init__(self) -> None:
+        h, c = Histogram, Counter
+        self.queue_wait = h(
+            "dynamo_engine_queue_wait_seconds",
+            "enqueue -> admission wait per request", _STAGE_BUCKETS)
+        self.admission_stall = h(
+            "dynamo_engine_admission_stall_seconds",
+            "blocking work (kvbm onboard/offload-drain) inside _admit",
+            _STAGE_BUCKETS)
+        self.prefill_chunk = h(
+            "dynamo_engine_prefill_chunk_seconds",
+            "one prefill chunk round (standalone, mixed, or pp)",
+            _STAGE_BUCKETS)
+        self.ttft = h(
+            "dynamo_engine_ttft_seconds",
+            "enqueue -> first emitted token per request", _STAGE_BUCKETS)
+        self.itl = h(
+            "dynamo_engine_itl_ms",
+            "inter-token gap at the emission boundary (ms)",
+            _ITL_BUCKETS_MS)
+        self.kv_pull = h(
+            "dynamo_engine_kv_pull_seconds",
+            "disagg KV pull, prefill worker -> decode worker",
+            _STAGE_BUCKETS)
+        self.offload_drain = h(
+            "dynamo_engine_offload_drain_seconds",
+            "one kvbm offload batch: device gather + tier demote",
+            _STAGE_BUCKETS)
+        self.prefill_seconds = c(
+            "dynamo_engine_prefill_seconds_total",
+            "scheduler wall seconds in prefill phases")
+        self.decode_seconds = c(
+            "dynamo_engine_decode_seconds_total",
+            "scheduler wall seconds in decode phases")
+        self.tokens_emitted = c(
+            "dynamo_engine_tokens_emitted_total",
+            "tokens emitted to consumers")
+        self.prefill_emitted = c(
+            "dynamo_engine_prefill_emitted_total",
+            "first tokens emitted at prefill completion")
+        self.prefill_new_tokens = c(
+            "dynamo_engine_prefill_new_tokens_total",
+            "prompt tokens actually prefetched/prefilled (cache misses)")
+        self.pipelined_bursts = c(
+            "dynamo_engine_pipelined_bursts_total",
+            "speculatively-dispatched decode bursts")
+        self.mixed_steps = c(
+            "dynamo_engine_mixed_steps_total",
+            "fused prefill-chunk + decode-burst steps")
+        self.decode_steps_during_prefill = c(
+            "dynamo_engine_decode_steps_during_prefill_total",
+            "decode steps interleaved while requests were prefilling")
+        self.compile = CompileTracker()
+
+    def register(self, registry: MetricsRegistry) -> None:
+        """Adopt every metric into a runtime registry so one `/metrics`
+        scrape renders them (idempotent; first engine wins a name)."""
+        for m in (self.queue_wait, self.admission_stall,
+                  self.prefill_chunk, self.ttft, self.itl, self.kv_pull,
+                  self.offload_drain, self.prefill_seconds,
+                  self.decode_seconds, self.tokens_emitted,
+                  self.prefill_emitted, self.prefill_new_tokens,
+                  self.pipelined_bursts, self.mixed_steps,
+                  self.decode_steps_during_prefill):
+            registry.register(m)
+        self.compile.register(registry)
+
+    # -- legacy view ---------------------------------------------------------
+
+    def perf_view(self) -> dict:
+        """The historical `engine.perf` dict, derived (not stored):
+        bench/tests snapshot it with `dict(eng.perf)` and take numeric
+        deltas; `itl_hist` is a fresh counts list in the
+        `llm.perf.itl_new_hist` layout (finite edges + open bucket)."""
+        itl_counts, _, _ = self.itl.snapshot()
+        return {
+            "prefill_s": self.prefill_seconds.get(),
+            "decode_s": self.decode_seconds.get(),
+            "prefill_new_tokens": int(self.prefill_new_tokens.get()),
+            "prefill_emitted": int(self.prefill_emitted.get()),
+            "tokens_emitted": int(self.tokens_emitted.get()),
+            "pipelined_bursts": int(self.pipelined_bursts.get()),
+            "prefill_chunks": self.prefill_chunk.count,
+            "decode_steps_during_prefill":
+                int(self.decode_steps_during_prefill.get()),
+            "mixed_steps": int(self.mixed_steps.get()),
+            "itl_hist": itl_counts,
+            "admission_stall_ms": self.admission_stall.sum * 1e3,
+        }

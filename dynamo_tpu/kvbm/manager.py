@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Optional
@@ -279,6 +280,7 @@ class KvbmManager:
                         # blocks keep their pins, new evictions
                         # backpressure into the inline path
                         await asyncio.Event().wait()
+                t0 = time.perf_counter()
                 async with self.engine._device_lock:
                     data = await asyncio.to_thread(
                         self.engine._read_kv_pages_sync, page_ids)
@@ -291,6 +293,9 @@ class KvbmManager:
 
                 await self._run_io(demote)
                 self.stats.offloaded += len(pairs)
+                em = getattr(self.engine, "metrics", None)
+                if em is not None:
+                    em.offload_drain.observe(time.perf_counter() - t0)
             except Exception:
                 logger.exception("kvbm offload batch failed; dropping "
                                  "%d block(s)", len(pairs))
@@ -414,10 +419,12 @@ class KvbmManager:
         self.stats.onboard_queries += 1
         start = i
         hits = []
+        staged_hits = 0
         while i < min(len(hashes), max_blocks):
             data = self._take_staged(hashes[i])
             if data is not None:
                 self.stats.prefetch_hits += 1
+                staged_hits += 1
             else:
                 data = self.store.get(hashes[i])
             if data is None:
@@ -426,8 +433,16 @@ class KvbmManager:
             i += 1
         if not hits:
             return seq.cached_len
+        t0 = time.perf_counter()
         self._write_and_register(seq, start, hits)
         self.stats.onboarded += len(hits)
+        trace = getattr(seq, "trace", None)
+        if trace is not None:
+            if staged_hits:
+                trace.event("kvbm.prefetch_hit", blocks=staged_hits)
+            trace.event("kvbm.onboard", blocks=len(hits),
+                        staged_hits=staged_hits,
+                        ms=round((time.perf_counter() - t0) * 1e3, 3))
         return i * ps
 
     def _write_and_register(self, seq, start: int, blocks_data) -> None:
@@ -488,6 +503,10 @@ class KvbmManager:
                 self._write_and_register(seq, start, blocks_data)
             self.stats.remote_onboarded += len(blocks_data)
             seq.cached_len = (start + len(blocks_data)) * ps
+            trace = getattr(seq, "trace", None)
+            if trace is not None:
+                trace.event("kvbm.onboard_remote",
+                            blocks=len(blocks_data))
             logger.info("kvbm: onboarded %d remote blocks "
                         "(cached_len=%d)", len(blocks_data),
                         seq.cached_len)

@@ -32,12 +32,16 @@ class Frontend:
     watcher: ModelWatcher
     http: HttpService
     grpc: object = None          # KserveGrpcService when --grpc-port set
+    breaker_events: object = None   # Counter: event-plane breaker changes
+    _breaker_task: object = None
 
     @property
     def url(self) -> str:
         return f"{self.http.scheme}://{self.http.host}:{self.http.port}"
 
     async def stop(self) -> None:
+        if self._breaker_task is not None:
+            self._breaker_task.cancel()
         if self.grpc is not None:
             await self.grpc.stop()
         await self.http.stop()
@@ -80,7 +84,28 @@ async def start_frontend(runtime: DistributedRuntime,
             await watcher.stop()
             await manager.close()
             raise
-    return Frontend(runtime, manager, watcher, http, grpc_svc)
+    # Count breaker state changes off the event plane (the runtime's own
+    # breaker publishes them, and in shared-store deploys so do peers'):
+    # the frontend sees worker health degrade without waiting to dial a
+    # dead instance itself. Exposed on this process's /metrics as
+    # `dynamo_frontend_breaker_events_total{state=...}`.
+    import asyncio as _asyncio
+
+    from dynamo_tpu.runtime.distributed import BREAKER_EVENTS_SUBJECT
+
+    breaker_events = runtime.metrics.counter(
+        "frontend_breaker_events_total",
+        "breaker state changes observed on the event plane, by new state")
+    sub = await runtime.events.subscribe(BREAKER_EVENTS_SUBJECT)
+
+    async def _count_breaker_events() -> None:
+        async for msg in sub:
+            payload = msg.get("payload") or {}
+            breaker_events.inc(state=str(payload.get("to", "unknown")))
+
+    task = _asyncio.get_running_loop().create_task(_count_breaker_events())
+    return Frontend(runtime, manager, watcher, http, grpc_svc,
+                    breaker_events, task)
 
 
 @dataclass
@@ -110,6 +135,15 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
 
     comp = runtime.namespace(card.namespace).component(card.component)
     ep = comp.endpoint(card.endpoint)
+    # one source of truth: the engine's own latency/compile metrics join
+    # this process's /metrics scrape (scheduler_stats and bench read the
+    # same EngineMetrics objects — no second bookkeeping path). Disagg
+    # workers serve a handler wrapping the engine — unwrap one level.
+    em = getattr(engine, "metrics", None)
+    if em is None:
+        em = getattr(getattr(engine, "engine", None), "metrics", None)
+    if em is not None and hasattr(em, "register"):
+        em.register(runtime.metrics)
     # one-token greedy canary (vllm health_check.py builds the same shape);
     # only probed when the runtime's health manager is enabled + idle.
     # The extra.canary marker lets sinks/metrics tell probes from traffic.

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Optional
 
 from aiohttp import web
@@ -114,8 +115,14 @@ class HttpService:
             web.get("/health", self._health),
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
+            web.get("/debug/requests", self._debug_requests),
             web.get("/openapi.json", self._openapi),
         ])
+        # request-lifecycle debug view: in-flight dicts keyed by request
+        # id plus a bounded ring of finished ones, served verbatim by
+        # /debug/requests (per-stage timings, status, trace id)
+        self._dbg_inflight: dict[str, dict] = {}
+        self._dbg_recent: deque = deque(maxlen=128)
         self._runner: Optional[web.AppRunner] = None
         m = manager.runtime.metrics.child("http")
         self._req_counter = m.counter(
@@ -426,41 +433,59 @@ class HttpService:
             attributes={"http.target": request.path,
                         "request.id": request_id, "model": model})
         span.__enter__()
+        rec = {"request_id": request_id, "endpoint": endpoint,
+               "model": model, "stream": stream,
+               "received_at": time.time(),
+               "trace_id": span.trace_id if tracer().enabled else None,
+               "status": "in_flight", "first_token_s": None,
+               "last_token_s": None, "duration_s": None, "usage": None}
+        self._dbg_inflight[request_id] = rec
         try:
             chunks = engine.generate(pipeline_request, ctx)
             if stream:
                 return await self._stream_sse(
-                    request, endpoint, chunks, ctx, start)
+                    request, endpoint, chunks, ctx, start, rec)
             # unary: aggregate the stream
             try:
                 full = await (aggregate_chat_stream(chunks)
                               if kind == KIND_CHAT
                               else aggregate_completion_stream(chunks))
             except OpenAIError as e:
+                rec["status"] = f"error:{e.status}"
                 return self._error(endpoint, e)
             except asyncio.CancelledError:
                 # client disconnected mid-aggregation: stop downstream work
                 ctx.cancel()
+                rec["status"] = "disconnect"
                 self._req_counter.inc(endpoint=endpoint, status="disconnect")
                 raise
             self._req_counter.inc(endpoint=endpoint, status="200")
             self._duration.observe(time.perf_counter() - start)
             self._observe_usage(full.get("usage"))
+            rec["status"] = "200"
+            rec["usage"] = full.get("usage")
             return web.json_response(full)
         except BaseException as e:
             span.record_error(e)
+            if rec["status"] == "in_flight":
+                rec["status"] = "error"
             raise
         finally:
             span.end(_reset=True)
             self._inflight.add(-1)
+            rec["duration_s"] = round(time.perf_counter() - start, 6)
+            self._dbg_inflight.pop(request_id, None)
+            self._dbg_recent.append(rec)
 
     async def _stream_sse(self, request: web.Request, endpoint: str,
-                          chunks, ctx: Context,
-                          start: float) -> web.StreamResponse:
+                          chunks, ctx: Context, start: float,
+                          rec: Optional[dict] = None) -> web.StreamResponse:
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
         })
+        if rec is None:
+            rec = {}
         first_token_at: Optional[float] = None
         last_token_at: Optional[float] = None
         try:
@@ -468,11 +493,15 @@ class HttpService:
                 if first_token_at is None and self._has_content(chunk):
                     first_token_at = time.perf_counter()
                     self._ttft.observe(first_token_at - start)
+                    rec["first_token_s"] = round(first_token_at - start, 6)
                 elif self._has_content(chunk) and last_token_at is not None:
                     self._itl.observe(time.perf_counter() - last_token_at)
                 if self._has_content(chunk):
                     last_token_at = time.perf_counter()
+                    rec["last_token_s"] = round(last_token_at - start, 6)
                 self._observe_usage(chunk.get("usage"))
+                if chunk.get("usage"):
+                    rec["usage"] = chunk["usage"]
                 if not resp.prepared:
                     await resp.prepare(request)
                 await resp.write(sse_encode(chunk))
@@ -480,19 +509,39 @@ class HttpService:
                 await resp.prepare(request)
             await resp.write(SSE_DONE)
             self._req_counter.inc(endpoint=endpoint, status="200")
+            rec["status"] = "200"
         except OpenAIError as e:
+            rec["status"] = f"error:{e.status}"
             if not resp.prepared:
                 return self._error(endpoint, e)
             await resp.write(sse_encode(e.body()))
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel downstream work (disconnect.rs)
             ctx.cancel()
+            rec["status"] = "disconnect"
             self._req_counter.inc(endpoint=endpoint, status="disconnect")
             raise
         finally:
             self._duration.observe(time.perf_counter() - start)
         await resp.write_eof()
         return resp
+
+    async def _debug_requests(self, request: web.Request) -> web.Response:
+        """Request-lifecycle debug view: every in-flight request plus a
+        ring of recently finished ones, with per-stage timings
+        (first/last token offsets from receipt, total duration), final
+        status, usage, and the trace id to grep in DYN_TRACE output.
+        `?limit=N` bounds the recent list (newest first)."""
+        try:
+            limit = int(request.query.get("limit", "50"))
+        except ValueError:
+            limit = 50
+        recent = list(self._dbg_recent)[-max(limit, 0):]
+        recent.reverse()
+        return web.json_response({
+            "in_flight": list(self._dbg_inflight.values()),
+            "recent": recent,
+        })
 
     @staticmethod
     def _has_content(chunk: dict) -> bool:
@@ -553,6 +602,8 @@ class HttpService:
             "/health": ("Model-serving readiness", False),
             "/live": ("Process liveness", False),
             "/metrics": ("Prometheus metrics", False),
+            "/debug/requests": ("In-flight + recent request lifecycle "
+                                "timings", False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -12,9 +12,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
+from dynamo_tpu.engine.metrics import EngineMetrics
 from dynamo_tpu.mocker.kv_manager import MockKvManager
 from dynamo_tpu.protocols import (
     FINISH_CANCELLED,
@@ -29,6 +31,7 @@ from dynamo_tpu.protocols import (
     WorkerStats,
 )
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.tracing import RequestTrace
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
@@ -58,6 +61,13 @@ class _MockRequest:
     generated: int = 0
     prefilled: bool = False
     arrival: int = 0
+    # lifecycle trace — None when DYN_TRACE is off, so every scheduler
+    # touch is a guarded attribute read (same contract as TpuEngine)
+    trace: Optional[RequestTrace] = None
+    t_enqueue_ns: int = 0
+    t_admit_ns: int = 0
+    t_first_ns: int = 0
+    t_last_ns: int = 0
 
     @property
     def max_tokens(self) -> int:
@@ -77,6 +87,9 @@ class MockEngine:
             self.config.worker_id, self.config.dp_rank, event_sink,
         )
         self.metrics_sink = metrics_sink
+        # same one-source-of-truth metrics surface as TpuEngine, so a
+        # mocker deployment's /metrics matches the real worker's
+        self.metrics = EngineMetrics()
         self._waiting: list[_MockRequest] = []
         self._running: list[_MockRequest] = []
         self._arrivals = 0
@@ -118,12 +131,22 @@ class MockEngine:
                 extra={"error": "prompt exceeds KV capacity"},
             ).to_dict()
             return
+        trace = RequestTrace.begin(
+            "engine.request", getattr(context, "headers", None),
+            {"request.id": context.request_id,
+             "engine.worker_id": self.config.worker_id,
+             "engine.kind": "mocker"})
         mreq = _MockRequest(
             req=req, ctx=context, queue=asyncio.Queue(),
             seq=TokenBlockSequence(self.config.block_size, req.token_ids),
             arrival=self._arrivals,
+            trace=trace, t_enqueue_ns=time.time_ns(),
         )
         self._arrivals += 1
+        if trace is not None:
+            trace.event("enqueued", waiting=len(self._waiting),
+                        running=len(self._running),
+                        prompt_tokens=len(req.token_ids))
         self._ensure_loop()
         self._waiting.append(mreq)
         self._wake.set()
@@ -168,6 +191,9 @@ class MockEngine:
             cand = self._waiting[0]
             if cand.ctx.is_cancelled():
                 self._waiting.pop(0)
+                if cand.trace is not None:
+                    cand.trace.end(status="ERROR",
+                                   finish_reason=FINISH_CANCELLED)
                 cand.queue.put_nowait(EngineOutput(
                     token_ids=[], finish_reason=FINISH_CANCELLED).to_dict())
                 cand.queue.put_nowait(None)
@@ -181,6 +207,17 @@ class MockEngine:
                 break
             self._waiting.pop(0)
             self._running.append(cand)
+            now_ns = time.time_ns()
+            if not cand.t_admit_ns:  # re-admits after preempt: events only
+                self.metrics.queue_wait.observe(
+                    (now_ns - cand.t_enqueue_ns) / 1e9)
+                if cand.trace is not None:
+                    cand.trace.stage("engine.queue_wait", cand.t_enqueue_ns,
+                                     now_ns,
+                                     prompt_tokens=len(cand.req.token_ids))
+            if cand.trace is not None:
+                cand.trace.event("admitted", running=len(self._running))
+            cand.t_admit_ns = now_ns
 
     async def _prefill_new(self) -> bool:
         cfg = self.config
@@ -192,10 +229,21 @@ class MockEngine:
                 # cannot fit even after eviction: preempt or requeue
                 self._preempt(r)
                 continue
+            t0_ns = time.time_ns()
             await self._sleep(max(uncached_tokens, 0)
                               * cfg.prefill_us_per_token / 1e6)
             r.prefilled = True
             progressed = True
+            end_ns = time.time_ns()
+            self.metrics.prefill_chunk.observe((end_ns - t0_ns) / 1e9)
+            if r.trace is not None:
+                r.trace.stage("engine.prefill.chunk", t0_ns, end_ns,
+                              tokens=max(uncached_tokens, 0),
+                              cached_blocks=cached)
+                r.trace.stage("engine.prefill", r.t_admit_ns or t0_ns,
+                              end_ns,
+                              prompt_tokens=len(r.req.token_ids),
+                              cached_blocks=cached)
         return progressed
 
     async def _decode_iter(self) -> bool:
@@ -230,6 +278,16 @@ class MockEngine:
                     if not ok:
                         self._preempt(r)
             r.generated += 1
+            now_ns = time.time_ns()
+            if r.generated == 1:
+                r.t_first_ns = now_ns
+                self.metrics.ttft.observe((now_ns - r.t_enqueue_ns) / 1e9)
+                if r.trace is not None:
+                    r.trace.event("first_token")
+            elif r.t_last_ns:
+                self.metrics.itl.observe((now_ns - r.t_last_ns) / 1e6)
+            r.t_last_ns = now_ns
+            self.metrics.tokens_emitted.inc()
             finish = None
             if r.req.stop.stop_token_ids and token in r.req.stop.stop_token_ids:
                 finish = FINISH_STOP
@@ -250,6 +308,15 @@ class MockEngine:
         return (prompt[-1] + i) % self.config.vocab_size if prompt else i
 
     def _finish(self, r: _MockRequest, reason: str, emit: bool = True) -> None:
+        if r.trace is not None:
+            end_ns = time.time_ns()
+            if r.t_first_ns:
+                r.trace.stage("engine.decode", r.t_first_ns, end_ns,
+                              tokens=r.generated)
+            r.trace.end(
+                status="OK" if reason in (FINISH_STOP, FINISH_LENGTH)
+                else "ERROR",
+                finish_reason=reason, tokens=r.generated)
         if r in self._running:
             self._running.remove(r)
         if r in self._waiting:  # finished in the same iter it was preempted
@@ -263,6 +330,8 @@ class MockEngine:
     def _preempt(self, r: _MockRequest) -> None:
         """Push a running request back to the head of the waiting queue,
         releasing its blocks (reference scheduler.rs preemption)."""
+        if r.trace is not None:
+            r.trace.event("preempted", generated=r.generated)
         if r in self._running:
             self._running.remove(r)
         self.kv.free_sequence(r.seq.seq_hashes())

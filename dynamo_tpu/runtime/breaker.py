@@ -22,8 +22,11 @@ deterministically (`faults.py` / `DYN_FAULTS`).
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Callable
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 CLOSED = "closed"
 OPEN = "open"
@@ -48,6 +51,12 @@ class CircuitBreaker:
         self._entries: dict[str, _Entry] = {}
         # lifetime transition counters, exported via service stats/metrics
         self.transitions = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        # observer for state changes: fn(key, old_state, new_state).
+        # Set by the runtime to publish breaker events on the event
+        # plane (frontends shed load before dialing a dead worker).
+        # Must not raise into the request path.
+        self.on_transition: Optional[
+            Callable[[str, str, str], None]] = None
 
     def _entry(self, key: str) -> _Entry:
         e = self._entries.get(key)
@@ -55,10 +64,17 @@ class CircuitBreaker:
             e = self._entries[key] = _Entry()
         return e
 
-    def _transition(self, e: _Entry, state: str) -> None:
+    def _transition(self, key: str, e: _Entry, state: str) -> None:
         if e.state != state:
+            old = e.state
             e.state = state
             self.transitions[state] += 1
+            if self.on_transition is not None:
+                try:
+                    self.on_transition(key, old, state)
+                except Exception:
+                    logger.exception(
+                        "breaker on_transition observer failed")
 
     # -- routing hooks -------------------------------------------------------
 
@@ -75,7 +91,7 @@ class CircuitBreaker:
             return True
         now = self.clock()
         if now >= e.retry_at:
-            self._transition(e, HALF_OPEN)
+            self._transition(key, e, HALF_OPEN)
             e.retry_at = now + self.cooldown
             return True
         return False
@@ -85,14 +101,14 @@ class CircuitBreaker:
         if e is None:
             return
         e.failures = 0
-        self._transition(e, CLOSED)
+        self._transition(key, e, CLOSED)
 
     def record_failure(self, key: str) -> None:
         e = self._entry(key)
         e.failures += 1
         if e.state == HALF_OPEN or e.failures >= self.fail_limit:
             e.retry_at = self.clock() + self.cooldown
-            self._transition(e, OPEN)
+            self._transition(key, e, OPEN)
 
     # -- introspection -------------------------------------------------------
 

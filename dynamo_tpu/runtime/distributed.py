@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import socket
+import time
 from typing import Optional
 
 from dynamo_tpu.runtime.component import Namespace
@@ -22,6 +23,11 @@ from dynamo_tpu.runtime.store import KeyValueStore, MemoryStore, connect_store
 from dynamo_tpu.runtime.transport import TransportClient, TransportServer
 
 logger = logging.getLogger(__name__)
+
+# Event-plane subject for circuit-breaker state changes: frontends
+# subscribe and count opens so they can shed load *before* dialing a
+# worker the breaker already knows is dead (ROADMAP robustness item).
+BREAKER_EVENTS_SUBJECT = "breaker_events"
 
 
 class DistributedRuntime:
@@ -51,6 +57,7 @@ class DistributedRuntime:
         self.events: EventBus = (
             store if isinstance(store, EventBus) else LocalEventBus()
         )
+        self.breaker.on_transition = self._on_breaker_transition
         self.metrics = MetricsRegistry("dynamo")
         # surface retry/timeout/breaker counters on both observability
         # planes: the `_sys.stats` scrape and the Prometheus registry
@@ -69,6 +76,24 @@ class DistributedRuntime:
         self._reregisters: list = []
         if hasattr(store, "on_reconnect"):
             store.on_reconnect.append(self._on_store_reconnect)
+
+    def _on_breaker_transition(self, key: str, old: str,
+                               new: str) -> None:
+        """Publish one breaker state change on the event plane. Runs
+        synchronously inside the request path (record_failure /
+        record_success), so it must never block or raise: local buses
+        take publish_nowait; remote buses get a fire-and-forget task."""
+        payload = {"instance": key, "from": old, "to": new,
+                   "at": time.time()}
+        bus = self.events
+        try:
+            if hasattr(bus, "publish_nowait"):
+                bus.publish_nowait(BREAKER_EVENTS_SUBJECT, payload)
+            else:
+                asyncio.get_running_loop().create_task(
+                    bus.publish(BREAKER_EVENTS_SUBJECT, payload))
+        except Exception:
+            logger.exception("breaker event publish failed")
 
     def _robustness_stats(self) -> dict:
         """Process-level failure-handling counters, merged into the

@@ -10,9 +10,12 @@ Prometheus text format by `render()`.
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 import time
 from typing import Callable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:
@@ -109,30 +112,40 @@ class Histogram:
         return self._sum
 
     def mean(self) -> float:
-        return self._sum / self._total if self._total else 0.0
+        with self._lock:
+            return self._sum / self._total if self._total else 0.0
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(bucket counts incl. +Inf, sum, total) — one consistent view.
+        Observers run on kvbm-io threads; readers must not see a count
+        bumped without its sum."""
+        with self._lock:
+            return list(self._counts), self._sum, self._total
 
     def quantile(self, q: float) -> float:
         """Approximate quantile from bucket upper bounds."""
-        if self._total == 0:
+        counts, _, total = self.snapshot()
+        if total == 0:
             return 0.0
-        target = q * self._total
+        target = q * total
         acc = 0
         for i, ub in enumerate(self.buckets):
-            acc += self._counts[i]
+            acc += counts[i]
             if acc >= target:
                 return ub
         return float("inf")
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        counts, total_sum, total = self.snapshot()
         acc = 0
         for i, ub in enumerate(self.buckets):
-            acc += self._counts[i]
+            acc += counts[i]
             out.append(f'{self.name}_bucket{{le="{ub}"}} {acc}')
-        acc += self._counts[-1]
+        acc += counts[-1]
         out.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._total}")
+        out.append(f"{self.name}_sum {total_sum}")
+        out.append(f"{self.name}_count {total}")
         return out
 
 
@@ -151,6 +164,7 @@ class MetricsRegistry:
         if parent is None:
             self._metrics: dict[str, object] = {}
             self._callbacks: list[Callable[[], None]] = []
+            self._callback_logged: set[int] = set()
 
     def child(self, name: str) -> "MetricsRegistry":
         return MetricsRegistry(f"{self.prefix}_{name}", parent=self)
@@ -175,6 +189,12 @@ class MetricsRegistry:
             metrics[full] = factory(full)
         return metrics[full]
 
+    def register(self, metric) -> None:
+        """Adopt an externally-constructed metric (already fully named) into
+        the scrape set — for metrics owned by a component (e.g. the engine)
+        that must exist before any registry is wired up."""
+        self._root._metrics.setdefault(metric.name, metric)
+
     def on_scrape(self, fn: Callable[[], None]) -> None:
         """Register a pre-scrape update callback (reference `lib.rs:137-160`)."""
         self._root._callbacks.append(fn)
@@ -184,7 +204,12 @@ class MetricsRegistry:
             try:
                 fn()
             except Exception:
-                pass
+                if id(fn) not in self._root._callback_logged:
+                    self._root._callback_logged.add(id(fn))
+                    logger.exception(
+                        "metrics scrape callback %s failed (logged once)",
+                        getattr(fn, "__qualname__", None)
+                        or getattr(fn, "__name__", repr(fn)))
         lines: list[str] = []
         for m in self._root._metrics.values():
             lines.extend(m.render())  # type: ignore[attr-defined]

@@ -40,6 +40,7 @@ class Span:
     start_ns: int = 0
     end_ns: int = 0
     attributes: dict[str, Any] = field(default_factory=dict)
+    events: list[dict] = field(default_factory=list)
     status: str = "OK"
     _tracer: Optional["Tracer"] = None
     _token: Optional[contextvars.Token] = None
@@ -88,6 +89,7 @@ class Span:
             "endTimeUnixNano": self.end_ns,
             "attributes": [{"key": k, "value": {"stringValue": str(v)}}
                            for k, v in self.attributes.items()],
+            "events": self.events,
             "status": {"code": self.status},
         }
 
@@ -153,6 +155,84 @@ class Tracer:
 
 def current_span() -> Optional[Span]:
     return _current_span.get()
+
+
+class RequestTrace:
+    """Per-request lifecycle trace handle for code that runs OUTSIDE the
+    caller's task (the scheduler loop): the contextvar does not propagate
+    there, so `generate()` captures the parent identity at enqueue time and
+    the scheduler emits stage spans/events retroactively with explicit
+    start/end timestamps.
+
+    `begin()` returns **None when tracing is disabled** — the scheduler hot
+    loop guards every touch with `if seq.trace is not None`, so a disabled
+    tracer costs one `None` attribute read per site and zero allocations
+    (the acceptance bar `Tracer.start_span` cannot meet, since its disabled
+    spans still allocate for API compatibility)."""
+
+    __slots__ = ("_tracer", "trace_id", "root", "_events")
+
+    def __init__(self, tr: Tracer, name: str,
+                 traceparent: Optional[str],
+                 attributes: Optional[dict] = None) -> None:
+        self._tracer = tr
+        self.root = tr.start_span(name, traceparent=traceparent,
+                                  attributes=attributes)
+        self.trace_id = self.root.trace_id
+        self._events: list[dict] = []
+
+    @classmethod
+    def begin(cls, name: str, headers: Optional[dict] = None,
+              attributes: Optional[dict] = None) -> Optional["RequestTrace"]:
+        """Start a request-lifecycle root span parented to the caller
+        task's current span (the transport `serve` span on a worker, the
+        http span in-proc), falling back to the incoming traceparent
+        header when no span is current (scheduler-only embedders). None
+        when the process tracer is disabled."""
+        tr = tracer()
+        if not tr.enabled:
+            return None
+        tp = None
+        if _current_span.get() is None:
+            tp = (headers or {}).get(TRACEPARENT)
+        return cls(tr, name, tp, attributes)
+
+    def stage(self, name: str, start_ns: int, end_ns: Optional[int] = None,
+              **attributes: Any) -> None:
+        """Emit one completed stage span (child of the request root) with
+        explicit timestamps — exported immediately; the Recorder drain
+        already moves the file I/O off the loop."""
+        span = Span(name=name, trace_id=self.trace_id,
+                    span_id=secrets.token_hex(8),
+                    parent_span_id=self.root.span_id,
+                    start_ns=start_ns,
+                    attributes={"service.name": self._tracer.service,
+                                **attributes})
+        span.end_ns = end_ns or time.time_ns()
+        self._tracer._export(span)
+
+    def event(self, name: str, **attributes: Any) -> None:
+        """Point-in-time lifecycle event, recorded on the root span as an
+        OTLP event (enqueued/admitted/first_token/prefetch_hit/...)."""
+        self._events.append({
+            "name": name, "timeUnixNano": time.time_ns(),
+            "attributes": [{"key": k, "value": {"stringValue": str(v)}}
+                           for k, v in attributes.items()]})
+
+    def end(self, status: str = "OK", **attributes: Any) -> None:
+        if self.root.end_ns:
+            return
+        self.root.attributes.update(attributes)
+        self.root.status = status
+        self.root.events = self._events
+        self.root.end()
+
+
+def request_trace(name: str, headers: Optional[dict] = None,
+                  attributes: Optional[dict] = None
+                  ) -> Optional[RequestTrace]:
+    """Module-level alias for RequestTrace.begin (call-site brevity)."""
+    return RequestTrace.begin(name, headers, attributes)
 
 
 def inject_headers(headers: dict) -> dict:

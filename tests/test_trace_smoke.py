@@ -1,0 +1,371 @@
+"""End-to-end request-lifecycle tracing (`make trace-smoke`).
+
+One DYN_TRACE'd completion through the full stack — HTTP frontend →
+real TCP transport hop → worker engine scheduler — must land in ONE
+connected trace whose engine-stage spans sit under the transport span,
+plus the satellite guarantees: traceparent survives PushRouter dial
+retries and Migration replays, the compile tracker's warm path records
+nothing, and breaker state changes reach the event plane and the
+frontend counter.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.recorder import Recorder
+from dynamo_tpu.runtime.tracing import (
+    TRACEPARENT,
+    RequestTrace,
+    Tracer,
+    set_tracer,
+)
+
+pytestmark = pytest.mark.tier0
+
+
+async def _start_shared_store():
+    from dynamo_tpu.runtime.store_net import StoreServer
+
+    server = StoreServer()
+    host, port = await server.start()
+    return server, f"tcp://{host}:{port}"
+
+
+async def test_mocker_trace_smoke(tmp_path):
+    """DYN_TRACE=1 completion: one trace, http → serve → engine.request
+    → {queue_wait, prefill.chunk, prefill, decode}, with lifecycle
+    events on the engine root. Worker and frontend are separate
+    runtimes over a TCP store so the request crosses a real transport
+    hop (the in-proc fast path has no serve span to nest under)."""
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    path = tmp_path / "trace.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    set_tracer(t)
+    store_server, store_url = await _start_shared_store()
+    rt_w = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url))
+    rt_f = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin")
+    ev_sink, m_sink = wire_engine_events(rt_w, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=8),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    handle = await serve_engine(rt_w, eng, card, instance_id=1)
+    fe = await start_frontend(rt_f)
+    try:
+        for _ in range(200):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{fe.url}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 6,
+                          "messages": [{"role": "user",
+                                        "content": "hello there"}]}) as r:
+                assert r.status == 200, await r.text()
+    finally:
+        set_tracer(None)
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt_f.close()
+        await rt_w.close()
+        await store_server.stop()
+    await t.close()
+
+    rows = [e for _, e in Recorder.iter_events(path)]
+    http_span = next(r for r in rows if r["name"].startswith("http "))
+    trace_id = http_span["traceId"]
+    ours = [r for r in rows if r["traceId"] == trace_id]
+    by_name = {r["name"]: r for r in ours}
+    # the engine stages all landed in the frontend's trace...
+    engine_stages = {n for n in by_name if n.startswith("engine.")}
+    assert {"engine.request", "engine.queue_wait", "engine.prefill",
+            "engine.prefill.chunk", "engine.decode"} <= engine_stages
+    assert len(engine_stages) >= 5
+    # ...with the engine root nested under the worker's transport span
+    serve = next(r for r in ours if r["name"].startswith("serve "))
+    req = by_name["engine.request"]
+    assert req["parentSpanId"] == serve["spanId"]
+    # every stage span hangs off the engine root — one connected tree
+    ids = {r["spanId"] for r in ours}
+    for r in ours:
+        assert not r["parentSpanId"] or r["parentSpanId"] in ids
+    for stage in ("engine.queue_wait", "engine.prefill", "engine.decode"):
+        assert by_name[stage]["parentSpanId"] == req["spanId"]
+    # lifecycle events ride the engine root
+    ev_names = {e["name"] for e in req.get("events", ())}
+    assert {"enqueued", "admitted", "first_token"} <= ev_names
+    assert req["status"]["code"] == "OK"
+
+
+async def test_traceparent_through_push_router_retries(tmp_path):
+    """A dial failure on the first candidate retries the next one; the
+    request that finally lands still carries the ORIGINAL traceparent —
+    the serve span on the healthy worker joins the caller's trace."""
+    from dynamo_tpu.runtime.component import Instance
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.push import PushRouter
+
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    set_tracer(t)
+    rt_srv = await DistributedRuntime.create(RuntimeConfig(
+        store_url="memory"))
+    rt_cli = await DistributedRuntime.create(RuntimeConfig(
+        store_url="memory"))
+    try:
+        seen_headers: list[dict] = []
+
+        async def handler(req, ctx):
+            seen_headers.append(dict(ctx.headers))
+            yield {"ok": True}
+
+        ep = rt_srv.namespace("ns").component("c").endpoint("e")
+        served = await ep.serve(handler, instance_id=2)
+        good = served.instance
+        # a dead candidate on a port nothing listens on
+        dead = Instance(namespace="ns", component="c", endpoint="e",
+                        instance_id=1, address="127.0.0.1:1")
+        client = await rt_cli.namespace("ns").component("c").endpoint(
+            "e").client(static_instances=[dead, good])
+        await client.start()
+        router = PushRouter(client, mode="round_robin")
+        with t.start_span("caller") as root:
+            items = [x async for x in router.generate({"q": 1}, Context())]
+        assert items == [{"ok": True}]
+        assert rt_cli.transport_client.stats["route_retries"] >= 1
+        await client.stop()
+    finally:
+        set_tracer(None)
+        await rt_cli.close()
+        await rt_srv.close()
+    await t.close()
+    # the retried attempt still presented the caller's traceparent
+    assert seen_headers and TRACEPARENT in seen_headers[0]
+    assert root.trace_id in seen_headers[0][TRACEPARENT]
+    rows = [e for _, e in Recorder.iter_events(path)]
+    serve = next(r for r in rows if r["name"].startswith("serve "))
+    assert serve["traceId"] == root.trace_id
+
+
+async def test_migration_replay_stays_in_original_trace():
+    """Migration replays reuse the same Context — every attempt sees the
+    same traceparent, so the retried stream stays one trace."""
+    from dynamo_tpu.llm.migration import Migration
+    from dynamo_tpu.runtime.context import Context
+    from dynamo_tpu.runtime.transport import STREAM_ERR_MSG
+
+    t = Tracer(enabled=False)
+    set_tracer(t)
+    try:
+        attempts: list[str] = []
+
+        class _Flaky:
+            calls = 0
+
+            async def generate(self, request, context):
+                _Flaky.calls += 1
+                attempts.append(context.headers.get(TRACEPARENT, ""))
+                yield {"token_ids": [_Flaky.calls]}
+                if _Flaky.calls == 1:
+                    raise ConnectionError(STREAM_ERR_MSG)
+                yield {"token_ids": [99], "finish_reason": "stop"}
+
+        tp = "00-" + "e" * 32 + "-" + "f" * 16 + "-01"
+        ctx = Context(headers={TRACEPARENT: tp})
+        mig = Migration(migration_limit=2).link(_Flaky())
+        toks = []
+        async for out in mig.generate(
+                {"token_ids": [5], "stop": {"max_tokens": 8}}, ctx):
+            toks.extend(out.get("token_ids", ()))
+        assert mig.stats["migrations"] == 1
+        assert len(attempts) == 2
+        assert attempts[0] == attempts[1] == tp
+    finally:
+        set_tracer(None)
+
+
+def test_request_trace_disabled_allocates_nothing():
+    """The scheduler's zero-cost-off contract: begin() is None when the
+    tracer is disabled, so every hot-loop touch is one `is not None`."""
+    set_tracer(Tracer(enabled=False))
+    try:
+        assert RequestTrace.begin("engine.request", {"traceparent": "x"}) \
+            is None
+    finally:
+        set_tracer(None)
+
+
+def test_compile_tracker_warm_path_records_nothing():
+    from dynamo_tpu.engine.compile_tracker import CompileTracker
+
+    ct = CompileTracker()
+    with ct.track("decode_burst", (8, 16)) as trk:
+        pass
+    assert trk.compiled and ct.total == 1
+    assert ct.compile_total.get(entry="decode_burst", shape="8x16") == 1
+    # warm path: same shape again — no new compile event, counters flat
+    with ct.track("decode_burst", (8, 16)) as trk2:
+        pass
+    assert not trk2.compiled
+    assert ct.total == 1 and len(ct.events) == 1
+    assert ct.compile_total.get(entry="decode_burst", shape="8x16") == 1
+    # a different bucketed shape is a fresh XLA program
+    with ct.track("decode_burst", (16, 16)):
+        pass
+    assert ct.total == 2
+
+
+async def test_breaker_transitions_reach_event_plane_and_frontend():
+    """Satellite: breaker state changes are published on the event plane
+    and counted by the frontend (ROADMAP robustness item)."""
+    from dynamo_tpu.llm.entrypoint import start_frontend
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import (
+        BREAKER_EVENTS_SUBJECT,
+        DistributedRuntime,
+    )
+
+    rt = await DistributedRuntime.create(RuntimeConfig(
+        store_url="memory", breaker_fail_limit=2))
+    fe = await start_frontend(rt)
+    try:
+        sub = await rt.events.subscribe(BREAKER_EVENTS_SUBJECT)
+        rt.breaker.record_failure("inst-a")
+        rt.breaker.record_failure("inst-a")     # fail_limit → OPEN
+        msg = await asyncio.wait_for(sub.__anext__(), 2)
+        assert msg["payload"]["instance"] == "inst-a"
+        assert msg["payload"]["from"] == "closed"
+        assert msg["payload"]["to"] == "open"
+        rt.breaker.record_success("inst-a")     # → CLOSED
+        msg = await asyncio.wait_for(sub.__anext__(), 2)
+        assert msg["payload"]["to"] == "closed"
+        sub.cancel()
+        # the frontend's event-plane counter saw both transitions
+        for _ in range(100):
+            if fe.breaker_events.get(state="closed") >= 1:
+                break
+            await asyncio.sleep(0.01)
+        assert fe.breaker_events.get(state="open") == 1
+        assert fe.breaker_events.get(state="closed") == 1
+    finally:
+        await fe.stop()
+        await rt.close()
+
+
+async def test_debug_requests_endpoint():
+    """/debug/requests exposes per-request lifecycle timings for
+    finished requests (and would show in-flight ones live)."""
+    from tests.test_http_frontend import setup_stack, teardown_stack
+
+    rt, fe, hs, es = await setup_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{fe.url}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 4,
+                          "stream": True,
+                          "messages": [{"role": "user",
+                                        "content": "hi"}]}) as r:
+                assert r.status == 200
+                await r.read()
+            async with s.get(f"{fe.url}/debug/requests") as r:
+                assert r.status == 200
+                data = await r.json()
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+    assert data["in_flight"] == []
+    assert len(data["recent"]) == 1
+    rec = data["recent"][0]
+    assert rec["status"] == "200" and rec["stream"] is True
+    assert rec["endpoint"] == "chat_completions"
+    assert rec["first_token_s"] is not None
+    assert rec["duration_s"] >= rec["first_token_s"]
+
+
+def test_engine_metrics_one_source_of_truth():
+    """The scheduler's histograms, the legacy perf view, and a /metrics
+    scrape all read the SAME EngineMetrics objects."""
+    from dynamo_tpu.engine.metrics import EngineMetrics
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    em = EngineMetrics()
+    em.ttft.observe(0.03)
+    em.itl.observe(4.0)
+    em.tokens_emitted.inc(7)
+    with em.compile.track("decode_burst", (8, 16)):
+        pass
+    view = em.perf_view()
+    assert view["tokens_emitted"] == 7
+    assert sum(view["itl_hist"]) == 1
+    reg = MetricsRegistry("dynamo")
+    em.register(reg)
+    text = reg.render()
+    assert "dynamo_engine_ttft_seconds" in text
+    assert "dynamo_engine_itl_ms" in text
+    assert "dynamo_engine_tokens_emitted_total 7" in text
+    assert 'dynamo_compile_total{entry="decode_burst",shape="8x16"} 1' \
+        in text
+    # same object, not a copy: a later observe shows up in both readers
+    em.tokens_emitted.inc(3)
+    assert em.perf_view()["tokens_emitted"] == 10
+    assert "dynamo_engine_tokens_emitted_total 10" in reg.render()
+
+
+def test_doctor_trace_analyzer(tmp_path, capsys):
+    """`python -m dynamo_tpu.doctor trace f.jsonl` reconstructs the span
+    tree, aggregates per-stage time, and prints the critical path."""
+    import json
+
+    from dynamo_tpu.doctor.__main__ import main as doctor_main
+
+    base = 1_000_000_000
+    ms = 1_000_000
+    spans = [
+        {"traceId": "t" * 32, "spanId": "a" * 16, "parentSpanId": "",
+         "name": "http chat_completions", "startTimeUnixNano": base,
+         "endTimeUnixNano": base + 20 * ms, "attributes": [],
+         "events": [], "status": {"code": "OK"}},
+        {"traceId": "t" * 32, "spanId": "b" * 16,
+         "parentSpanId": "a" * 16, "name": "engine.request",
+         "startTimeUnixNano": base + 1 * ms,
+         "endTimeUnixNano": base + 19 * ms, "attributes": [],
+         "events": [{"name": "first_token",
+                     "timeUnixNano": base + 5 * ms, "attributes": []}],
+         "status": {"code": "OK"}},
+        {"traceId": "t" * 32, "spanId": "c" * 16,
+         "parentSpanId": "b" * 16, "name": "engine.decode",
+         "startTimeUnixNano": base + 5 * ms,
+         "endTimeUnixNano": base + 19 * ms, "attributes": [],
+         "events": [], "status": {"code": "OK"}},
+    ]
+    f = tmp_path / "trace.jsonl"
+    # Recorder wraps records as {"timestamp", "event"}; the loader unwraps
+    f.write_text("\n".join(
+        json.dumps({"timestamp": 0, "event": s}) for s in spans))
+    rc = doctor_main(["trace", str(f)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "engine.request" in out and "critical path" in out
+    assert "first_token" in out
+    assert "per-stage breakdown" in out

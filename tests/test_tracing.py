@@ -8,6 +8,7 @@ spans frontend → transport → worker across a REAL TCP hop.
 import asyncio
 
 import aiohttp
+import pytest
 
 from dynamo_tpu.runtime.recorder import Recorder
 from dynamo_tpu.runtime.tracing import (
@@ -18,6 +19,8 @@ from dynamo_tpu.runtime.tracing import (
     set_tracer,
     tracer,
 )
+
+pytestmark = pytest.mark.tier0
 
 
 def test_traceparent_roundtrip():

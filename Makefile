@@ -3,7 +3,7 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke
+.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -34,4 +34,13 @@ kvbm-soak:
 # connected trace; plus traceparent-through-retries, compile-tracker
 # warm path, breaker events, /debug/requests, doctor trace analyzer.
 trace-smoke:
-	$(PYTEST) tests/test_trace_smoke.py tests/test_tracing.py
+	$(PYTEST) tests/test_trace_smoke.py tests/test_tracing.py \
+		tests/test_trace_sampling.py
+
+# fleet telemetry gate (docs/observability.md "Fleet view"/"SLOs"):
+# event-plane MetricsSnapshot merge math, worker+frontend publishing
+# over a real TCP store into GET /fleet/status + doctor fleet, the
+# planner running zero-HTTP off the TelemetrySource, and SLO burn-rate
+# transitions on the slo_events subject.
+fleet-smoke:
+	$(PYTEST) tests/test_telemetry.py tests/test_slo.py

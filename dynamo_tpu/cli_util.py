@@ -45,6 +45,10 @@ def add_runtime_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--faults-seed", type=int, default=None,
                    help="seed for probabilistic fault rules "
                         "(DYN_FAULTS_SEED; default 0)")
+    p.add_argument("--telemetry-interval", type=float, default=None,
+                   help="seconds between MetricsSnapshot publishes on "
+                        "the telemetry event subject (0 = off; "
+                        "DYN_TELEMETRY_INTERVAL)")
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
 
@@ -67,6 +71,15 @@ def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
         cfg.request_deadline = args.request_deadline
     if getattr(args, "stream_idle_timeout", None) is not None:
         cfg.stream_idle_timeout = args.stream_idle_timeout
+    if getattr(args, "telemetry_interval", None) is not None:
+        cfg.telemetry_interval = args.telemetry_interval
+    for slo_flag in ("slo_ttft", "slo_itl", "slo_target_ratio",
+                     "slo_fast_window", "slo_slow_window",
+                     "slo_fast_burn", "slo_slow_burn",
+                     "slo_check_interval"):
+        v = getattr(args, slo_flag, None)
+        if v is not None:
+            setattr(cfg, slo_flag, v)
     if getattr(args, "faults", None) is not None:
         # publish via env, not config: FaultInjector.from_env() is read
         # independently by the transport layer AND the KVBM manager, and

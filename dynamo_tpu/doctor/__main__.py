@@ -82,6 +82,12 @@ def main(argv=None) -> int:
         from dynamo_tpu.doctor.trace import main as trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `doctor fleet <frontend-url|status.json>` renders the merged
+        # telemetry view served at /fleet/status (doctor/fleet.py)
+        from dynamo_tpu.doctor.fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     p = argparse.ArgumentParser(prog="python -m dynamo_tpu.doctor")
     p.add_argument("--store", default=None,
                    help="control-plane url to ping (tcp://host:port)")

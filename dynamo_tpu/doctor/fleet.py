@@ -1,0 +1,93 @@
+"""`python -m dynamo_tpu.doctor fleet <url-or-json>` — render the fleet
+telemetry view.
+
+Input is either a frontend base url (fetches ``/fleet/status`` over
+HTTP) or a path to a JSON file holding the same payload (tests and
+offline captures hand the file). Prints per-component TTFT/ITL
+percentiles, the fleet-merged view, and live SLO burn rates when a
+monitor is configured. Exit code 0 when a fleet view was rendered,
+1 when the input was unusable or empty.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_status(source: str) -> Optional[dict]:
+    """Fetch /fleet/status from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/fleet/status"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor fleet: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor fleet: cannot read {source}: {e!r}")
+        return None
+
+
+def _fmt_latency(latency: dict) -> str:
+    parts = []
+    for key in ("ttft", "itl"):
+        s = latency.get(key)
+        if not s:
+            continue
+        parts.append(
+            f"{key} p50={_ms(s.get('p50'))} p90={_ms(s.get('p90'))} "
+            f"p99={_ms(s.get('p99'))} n={s.get('count', 0)}")
+    return "  ".join(parts) if parts else "no latency samples"
+
+
+def _ms(v) -> str:
+    try:
+        return f"{float(v) * 1e3:.1f}ms"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render(status: dict) -> int:
+    components = status.get("components") or []
+    print(f"fleet: {len(components)} component(s) reporting")
+    for c in components:
+        print(f"  [{c.get('role', '?'):<8}] {c.get('component', '?')}"
+              f"/{c.get('instance', '?')} "
+              f"(age {c.get('age_s', '?')}s): "
+              f"{_fmt_latency(c.get('latency') or {})}")
+    fleet = status.get("fleet") or {}
+    print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}")
+    slo = status.get("slo")
+    if slo:
+        print("slo:")
+        for name, s in sorted(slo.items()):
+            print(f"  {name}: state={s.get('state', '?')} "
+                  f"fast_burn={s.get('fast_burn', 0)} "
+                  f"slow_burn={s.get('slow_burn', 0)} "
+                  f"threshold={_ms(s.get('threshold_s'))} "
+                  f"samples={s.get('samples', 0)}")
+    return 0 if components else 1
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m dynamo_tpu.doctor fleet "
+              "<frontend-url | status.json>")
+        return 1
+    status = load_status(argv[0])
+    if status is None:
+        return 1
+    return render(status)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,12 @@ _STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 _ITL_BUCKETS_MS = tuple(e for e in ITL_BUCKET_EDGES_MS
                         if e != float("inf"))
 
+# Canonical histogram names, importable by telemetry consumers
+# (runtime/telemetry.py latency summaries, doctor fleet) so renames
+# can't silently desynchronize the fleet view from the engine.
+TTFT_HISTOGRAM = "dynamo_engine_ttft_seconds"
+ITL_HISTOGRAM = "dynamo_engine_itl_ms"
+
 
 class EngineMetrics:
     """Owned by one engine (TpuEngine or MockEngine)."""
@@ -55,10 +61,10 @@ class EngineMetrics:
             "one prefill chunk round (standalone, mixed, or pp)",
             _STAGE_BUCKETS)
         self.ttft = h(
-            "dynamo_engine_ttft_seconds",
+            TTFT_HISTOGRAM,
             "enqueue -> first emitted token per request", _STAGE_BUCKETS)
         self.itl = h(
-            "dynamo_engine_itl_ms",
+            ITL_HISTOGRAM,
             "inter-token gap at the emission boundary (ms)",
             _ITL_BUCKETS_MS)
         self.kv_pull = h(

@@ -44,6 +44,22 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--request-template", default=None,
                    help="JSON file of request defaults (model, "
                         "temperature, max_completion_tokens)")
+    # SLO burn-rate monitor (runtime/slo.py; docs/observability.md
+    # "SLOs"): objectives default off → no monitor, no behavior change
+    p.add_argument("--slo-ttft", type=float, default=None,
+                   help="TTFT objective threshold seconds (0 = off)")
+    p.add_argument("--slo-itl", type=float, default=None,
+                   help="ITL objective threshold seconds (0 = off)")
+    p.add_argument("--slo-target-ratio", type=float, default=None,
+                   help="fraction of requests that must beat the "
+                        "threshold (default 0.99)")
+    p.add_argument("--slo-fast-window", type=float, default=None)
+    p.add_argument("--slo-slow-window", type=float, default=None)
+    p.add_argument("--slo-fast-burn", type=float, default=None,
+                   help="fast-window burn-rate alert threshold (14.4)")
+    p.add_argument("--slo-slow-burn", type=float, default=None,
+                   help="slow-window burn-rate alert threshold (6)")
+    p.add_argument("--slo-check-interval", type=float, default=None)
     return p.parse_args(argv)
 
 

@@ -34,6 +34,10 @@ class Frontend:
     grpc: object = None          # KserveGrpcService when --grpc-port set
     breaker_events: object = None   # Counter: event-plane breaker changes
     _breaker_task: object = None
+    collector: object = None     # TelemetryCollector (fleet view)
+    publisher: object = None     # TelemetryPublisher when interval > 0
+    slo: object = None           # SloMonitor when objectives configured
+    _slo_task: object = None
 
     @property
     def url(self) -> str:
@@ -42,6 +46,12 @@ class Frontend:
     async def stop(self) -> None:
         if self._breaker_task is not None:
             self._breaker_task.cancel()
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+        if self.publisher is not None:
+            await self.publisher.stop()
+        if self.collector is not None:
+            await self.collector.stop()
         if self.grpc is not None:
             await self.grpc.stop()
         await self.http.stop()
@@ -104,8 +114,62 @@ async def start_frontend(runtime: DistributedRuntime,
             breaker_events.inc(state=str(payload.get("to", "unknown")))
 
     task = _asyncio.get_running_loop().create_task(_count_breaker_events())
+    # Fleet telemetry plane (docs/observability.md "Fleet view"): the
+    # frontend always runs the collector (a passive event-plane
+    # subscription serving /fleet/status and doctor fleet); publishing
+    # its own snapshot and the SLO monitor are opt-in via config.
+    from dynamo_tpu.runtime.slo import (
+        SLO_EVENTS_SUBJECT,
+        SloMonitor,
+        SloObjective,
+    )
+    from dynamo_tpu.runtime.telemetry import (
+        TelemetryCollector,
+        TelemetryPublisher,
+        _publish_best_effort,
+    )
+
+    cfg = runtime.config
+    collector = TelemetryCollector(runtime.events)
+    await collector.start()
+    slo = None
+    slo_task = None
+    if cfg.slo_ttft > 0 or cfg.slo_itl > 0:
+        objectives = []
+        if cfg.slo_ttft > 0:
+            objectives.append(SloObjective(
+                "ttft", cfg.slo_ttft, cfg.slo_target_ratio))
+        if cfg.slo_itl > 0:
+            objectives.append(SloObjective(
+                "itl", cfg.slo_itl, cfg.slo_target_ratio))
+        slo = SloMonitor(objectives,
+                         fast_window=cfg.slo_fast_window,
+                         slow_window=cfg.slo_slow_window,
+                         fast_burn=cfg.slo_fast_burn,
+                         slow_burn=cfg.slo_slow_burn)
+        slo.register(runtime.metrics)
+        http.slo = slo
+
+        async def _slo_loop() -> None:
+            while True:
+                await _asyncio.sleep(cfg.slo_check_interval)
+                for ev in slo.evaluate():
+                    _publish_best_effort(runtime.events,
+                                         SLO_EVENTS_SUBJECT, ev)
+
+        slo_task = _asyncio.get_running_loop().create_task(_slo_loop())
+    http.fleet_status_provider = \
+        lambda: collector.fleet_status(slo=slo)
+    publisher = None
+    if cfg.telemetry_interval > 0:
+        publisher = TelemetryPublisher(
+            runtime.events, runtime.metrics, component="frontend",
+            instance=f"{http.host}:{http.port}", role="frontend",
+            interval=cfg.telemetry_interval)
+        publisher.start()
     return Frontend(runtime, manager, watcher, http, grpc_svc,
-                    breaker_events, task)
+                    breaker_events, task, collector, publisher,
+                    slo, slo_task)
 
 
 @dataclass
@@ -115,8 +179,11 @@ class WorkerHandle:
     served: object
     served_clear: object = None
     served_controller: object = None
+    publisher: object = None     # TelemetryPublisher when interval > 0
 
     async def stop(self) -> None:
+        if self.publisher is not None:
+            await self.publisher.stop()
         if self.served_controller is not None:
             await self.served_controller.shutdown()
         if self.served_clear is not None:
@@ -190,7 +257,21 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
         served_ctl = await comp.endpoint("kvbm_controller").serve(
             controller_handler, instance_id=served.instance.instance_id)
     await register_llm(runtime, card)
-    return WorkerHandle(runtime, card, served, served_clear, served_ctl)
+    # Telemetry plane: publish this worker's MetricsSnapshot (its engine
+    # histograms joined runtime.metrics above) on the event bus so
+    # frontend/planner collectors see it without an HTTP scrape.
+    publisher = None
+    if runtime.config.telemetry_interval > 0:
+        from dynamo_tpu.runtime.telemetry import TelemetryPublisher
+
+        publisher = TelemetryPublisher(
+            runtime.events, runtime.metrics,
+            component=f"{card.namespace}/{card.component}",
+            instance=f"{served.instance.instance_id:x}", role="worker",
+            interval=runtime.config.telemetry_interval)
+        publisher.start()
+    return WorkerHandle(runtime, card, served, served_clear, served_ctl,
+                        publisher)
 
 
 def wire_engine_events(runtime: DistributedRuntime,

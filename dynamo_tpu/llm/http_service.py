@@ -115,6 +115,7 @@ class HttpService:
             web.get("/health", self._health),
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
+            web.get("/fleet/status", self._fleet_status),
             web.get("/debug/requests", self._debug_requests),
             web.get("/openapi.json", self._openapi),
         ])
@@ -146,6 +147,19 @@ class HttpService:
         self._osl = m.histogram(
             "request_output_tokens", "completion tokens per request",
             buckets=(1, 4, 16, 64, 128, 256, 512, 1024, 2048, 4096))
+        # Fleet telemetry plane (docs/observability.md "Fleet view"):
+        # start_frontend injects the TelemetryCollector's fleet_status
+        # callable and, when SLO objectives are configured, the
+        # SloMonitor that the TTFT/ITL observation points feed.
+        self.fleet_status_provider = None  # Callable[[], dict] | None
+        self.slo = None                    # SloMonitor | None
+
+    def _observe_latency(self, kind: str, seconds: float) -> None:
+        """One TTFT/ITL sample into both the histogram and (when
+        configured) the SLO monitor's rolling windows."""
+        (self._ttft if kind == "ttft" else self._itl).observe(seconds)
+        if self.slo is not None:
+            self.slo.observe(kind, seconds)
 
     def _observe_usage(self, usage: Optional[dict]) -> None:
         if not usage:
@@ -296,9 +310,9 @@ class HttpService:
                         now = time.perf_counter()
                         if first_token_at is None:
                             first_token_at = now
-                            self._ttft.observe(now - start)
+                            self._observe_latency("ttft", now - start)
                         elif last_token_at is not None:
-                            self._itl.observe(now - last_token_at)
+                            self._observe_latency("itl", now - last_token_at)
                         last_token_at = now
                     elif ev.get("type") == "response.completed":
                         self._observe_usage_responses(
@@ -492,10 +506,11 @@ class HttpService:
             async for chunk in chunks:
                 if first_token_at is None and self._has_content(chunk):
                     first_token_at = time.perf_counter()
-                    self._ttft.observe(first_token_at - start)
+                    self._observe_latency("ttft", first_token_at - start)
                     rec["first_token_s"] = round(first_token_at - start, 6)
                 elif self._has_content(chunk) and last_token_at is not None:
-                    self._itl.observe(time.perf_counter() - last_token_at)
+                    self._observe_latency(
+                        "itl", time.perf_counter() - last_token_at)
                 if self._has_content(chunk):
                     last_token_at = time.perf_counter()
                     rec["last_token_s"] = round(last_token_at - start, 6)
@@ -578,6 +593,33 @@ class HttpService:
 
     async def _live(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "live"})
+
+    async def _fleet_status(self, request: web.Request) -> web.Response:
+        """Fleet-merged telemetry view (docs/observability.md "Fleet
+        view"): per-component and merged TTFT/ITL percentiles from the
+        event-plane MetricsSnapshots, plus live SLO burn rates when a
+        monitor is configured. 503 until a collector is wired (frontend
+        started without the telemetry plane)."""
+        if self.fleet_status_provider is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "telemetry collector not running"}, status=503)
+        status = self.fleet_status_provider()
+        # histogram edges can be +Inf; standard JSON has no literal for
+        # it, so stringify non-finite floats instead of emitting the
+        # python-only Infinity token
+        import math
+
+        def _clean(o):
+            if isinstance(o, float) and not math.isfinite(o):
+                return str(o)
+            if isinstance(o, dict):
+                return {k: _clean(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return [_clean(v) for v in o]
+            return o
+
+        return web.json_response(_clean(status))
 
     async def _openapi(self, request: web.Request) -> web.Response:
         """OpenAPI 3.1 description of the served surface (openapi_docs.rs

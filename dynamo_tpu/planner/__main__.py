@@ -23,8 +23,12 @@ def parse_args(argv=None):
         prog="python -m dynamo_tpu.planner",
         description="SLA-based autoscaling planner")
     add_runtime_args(p)
-    p.add_argument("--metrics-url", required=True,
-                   help="frontend /metrics URL to scrape")
+    p.add_argument("--metrics-url",
+                   help="frontend /metrics URL to scrape (HTTP source)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="consume event-plane MetricsSnapshots instead "
+                        "of scraping /metrics (runtime/telemetry.py; "
+                        "requires a shared tcp:// store)")
     p.add_argument("--profile-results", required=True,
                    help="JSON written by planner.profile_sla")
     p.add_argument("--adjustment-interval", type=float, default=60.0)
@@ -52,6 +56,8 @@ def parse_args(argv=None):
 def main(argv=None) -> None:
     args = parse_args(argv)
     setup_logging(args.log_level)
+    if not args.telemetry and not args.metrics_url:
+        raise SystemExit("planner: need --metrics-url or --telemetry")
 
     async def start():
         from dynamo_tpu.planner import (
@@ -67,6 +73,16 @@ def main(argv=None) -> None:
         from dynamo_tpu.runtime.distributed import DistributedRuntime
 
         rt = await DistributedRuntime.create(runtime_config_from_args(args))
+        collector = None
+        if args.telemetry:
+            from dynamo_tpu.planner.telemetry_source import TelemetrySource
+            from dynamo_tpu.runtime.telemetry import TelemetryCollector
+
+            collector = TelemetryCollector(rt.events)
+            await collector.start()
+            source = TelemetrySource(collector)
+        else:
+            source = PrometheusScrapeSource(args.metrics_url)
         cfg = SlaPlannerConfig(
             namespace=args.namespace,
             prefill_component=args.prefill_component,
@@ -85,15 +101,17 @@ def main(argv=None) -> None:
             cfg,
             PrefillInterpolator(profile_path=args.profile_results),
             DecodeInterpolator(profile_path=args.profile_results),
-            PrometheusScrapeSource(args.metrics_url),
+            source,
             connector=connector)
         planner.start()
         print("PLANNER_READY", flush=True)
-        return rt, planner
+        return rt, planner, collector
 
     async def stop(objs):
-        rt, planner = objs
+        rt, planner, collector = objs
         planner.stop()
+        if collector is not None:
+            await collector.stop()
         await rt.close()
 
     run_until_signal(start, shutdown=stop)

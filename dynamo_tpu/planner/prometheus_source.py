@@ -28,11 +28,18 @@ NAMES = {
 }
 
 
+_warned_nonfinite = False
+
+
 def parse_prom_text(text: str) -> dict[str, float]:
     """name{labels} value lines → {bare_name_suffix: summed value}.
 
     Histogram _sum/_count series are summed across label sets.
+    NaN/Inf samples (a scraped target can legally expose them) are
+    skipped — folded into a sum they would poison every interval delta
+    the planner computes — and logged once per process.
     """
+    global _warned_nonfinite
     out: dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -41,10 +48,48 @@ def parse_prom_text(text: str) -> dict[str, float]:
         try:
             key, val = line.rsplit(" ", 1)
             name = key.split("{", 1)[0]
-            out[name] = out.get(name, 0.0) + float(val)
+            v = float(val)
         except ValueError:
             continue
+        if not math.isfinite(v):
+            if not _warned_nonfinite:
+                _warned_nonfinite = True
+                logger.warning(
+                    "parse_prom_text: skipping non-finite sample for %s "
+                    "(logged once)", name)
+            continue
+        out[name] = out.get(name, 0.0) + v
     return out
+
+
+def interval_from_totals(prev: dict[str, float],
+                         cur: dict[str, float]) -> IntervalMetrics:
+    """Per-interval averages from two cumulative-total dicts (the shape
+    `parse_prom_text` and `telemetry.flatten` both produce) — shared by
+    the HTTP-scrape and event-plane metrics sources so the planner's
+    math cannot drift between them."""
+
+    def delta(name: str) -> float:
+        return cur.get(name, 0.0) - prev.get(name, 0.0)
+
+    def avg(metric: str) -> float:
+        s = delta(NAMES[metric] + "_sum")
+        c = delta(NAMES[metric] + "_count")
+        return s / c if c > 0 else float("nan")
+
+    n_req = delta(NAMES["isl"] + "_count")
+    if n_req <= 0:
+        return IntervalMetrics()
+    m = IntervalMetrics(
+        num_req=n_req, isl=avg("isl"), osl=avg("osl"),
+        ttft=avg("ttft"), itl=avg("itl"),
+        request_duration=avg("duration"))
+    if math.isnan(m.itl):
+        # unary-only traffic has no per-token gaps; approximate from
+        # duration spread over the output tokens
+        if not math.isnan(m.request_duration) and m.osl > 1:
+            m.itl = m.request_duration / m.osl
+    return m
 
 
 class PrometheusScrapeSource:
@@ -64,25 +109,4 @@ class PrometheusScrapeSource:
         prev, self._prev = self._prev, cur
         if prev is None:
             return IntervalMetrics()
-
-        def delta(name: str) -> float:
-            return cur.get(name, 0.0) - prev.get(name, 0.0)
-
-        def avg(metric: str) -> float:
-            s = delta(NAMES[metric] + "_sum")
-            c = delta(NAMES[metric] + "_count")
-            return s / c if c > 0 else float("nan")
-
-        n_req = delta(NAMES["isl"] + "_count")
-        if n_req <= 0:
-            return IntervalMetrics()
-        m = IntervalMetrics(
-            num_req=n_req, isl=avg("isl"), osl=avg("osl"),
-            ttft=avg("ttft"), itl=avg("itl"),
-            request_duration=avg("duration"))
-        if math.isnan(m.itl):
-            # unary-only traffic has no per-token gaps; approximate from
-            # duration spread over the output tokens
-            if not math.isnan(m.request_duration) and m.osl > 1:
-                m.itl = m.request_duration / m.osl
-        return m
+        return interval_from_totals(prev, cur)

@@ -1,0 +1,35 @@
+"""Event-plane metrics source for the SLA planner: zero HTTP scrapes.
+
+Reference analog: Dynamo's planner consuming worker-published metrics
+streams off the message plane (PAPER.md §planner) instead of a
+Prometheus fan-in. A `TelemetryCollector` (runtime/telemetry.py) merges
+the fleet's MetricsSnapshots; this source flattens the merged snapshot
+into the same cumulative-totals dict `parse_prom_text` yields and runs
+it through the shared `interval_from_totals` delta math — the planner
+cannot tell the two sources apart, which is the point: one
+`MetricsSource` protocol, two transports.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.planner.planner_core import IntervalMetrics
+from dynamo_tpu.planner.prometheus_source import interval_from_totals
+from dynamo_tpu.runtime.telemetry import TelemetryCollector, flatten
+
+
+class TelemetrySource:
+    """Implements the planner's MetricsSource protocol over a running
+    TelemetryCollector (event-plane snapshots, no HTTP)."""
+
+    def __init__(self, collector: TelemetryCollector) -> None:
+        self.collector = collector
+        self._prev: Optional[dict[str, float]] = None
+
+    async def interval_metrics(self) -> IntervalMetrics:
+        cur = flatten(self.collector.merged())
+        prev, self._prev = self._prev, cur
+        if prev is None:
+            return IntervalMetrics()
+        return interval_from_totals(prev, cur)

@@ -63,6 +63,21 @@ class RuntimeConfig:
     kvbm_offload_queue: int = 0
     kvbm_offload_workers: int = 0
     kvbm_prefetch_blocks: int = 0
+    # Fleet telemetry plane (runtime/telemetry.py; docs/observability.md
+    # "Fleet view"). Seconds between MetricsSnapshot publishes on the
+    # `telemetry` event subject; 0 = off (no publisher task).
+    telemetry_interval: float = 0.0
+    # SLO burn-rate monitor (runtime/slo.py; docs/observability.md
+    # "SLOs"). Objective thresholds in seconds; 0 = objective disabled
+    # (no monitor when both are 0).
+    slo_ttft: float = 0.0
+    slo_itl: float = 0.0
+    slo_target_ratio: float = 0.99
+    slo_fast_window: float = 60.0
+    slo_slow_window: float = 600.0
+    slo_fast_burn: float = 14.4
+    slo_slow_burn: float = 6.0
+    slo_check_interval: float = 5.0
     # Graceful shutdown drain timeout.
     shutdown_timeout: float = 30.0
     # Arbitrary extra engine/component settings.

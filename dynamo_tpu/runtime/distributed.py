@@ -59,6 +59,11 @@ class DistributedRuntime:
         )
         self.breaker.on_transition = self._on_breaker_transition
         self.metrics = MetricsRegistry("dynamo")
+        # the process tracer's export/drop counters render on /metrics
+        # like everything else (they are plain registry Counters)
+        from dynamo_tpu.runtime.tracing import tracer
+
+        tracer().register_metrics(self.metrics)
         # surface retry/timeout/breaker counters on both observability
         # planes: the `_sys.stats` scrape and the Prometheus registry
         transport_server.extra_stats = self._robustness_stats

@@ -40,6 +40,11 @@ class Counter:
     def get(self, **labels: str) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def items(self) -> list[tuple[dict[str, str], float]]:
+        """[(labels, value)] under the lock — snapshot-consistent."""
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -70,6 +75,11 @@ class Gauge:
     def get(self, **labels: str) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def items(self) -> list[tuple[dict[str, str], float]]:
+        """[(labels, value)] under the lock — snapshot-consistent."""
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -83,6 +93,23 @@ class Gauge:
 
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def hist_quantile(edges: Sequence[float], counts: Sequence[int],
+                  q: float) -> float:
+    """Approximate quantile (bucket upper bound) from a histogram's
+    (edges, counts-incl-+Inf) pair — shared by live Histograms and
+    merged telemetry snapshots so fleet math matches per-process math."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, ub in enumerate(edges):
+        acc += counts[i]
+        if acc >= target:
+            return ub
+    return float("inf")
 
 
 class Histogram:
@@ -124,16 +151,8 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         """Approximate quantile from bucket upper bounds."""
-        counts, _, total = self.snapshot()
-        if total == 0:
-            return 0.0
-        target = q * total
-        acc = 0
-        for i, ub in enumerate(self.buckets):
-            acc += counts[i]
-            if acc >= target:
-                return ub
-        return float("inf")
+        counts, _, _total = self.snapshot()
+        return hist_quantile(self.buckets, counts, q)
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -199,7 +218,10 @@ class MetricsRegistry:
         """Register a pre-scrape update callback (reference `lib.rs:137-160`)."""
         self._root._callbacks.append(fn)
 
-    def render(self) -> str:
+    def collect(self) -> dict[str, object]:
+        """Run pre-scrape callbacks, then hand back the live metric map
+        (full name → Counter/Gauge/Histogram). Snapshot consumers (the
+        telemetry publisher) use this instead of re-parsing render()."""
         for fn in self._root._callbacks:
             try:
                 fn()
@@ -210,7 +232,10 @@ class MetricsRegistry:
                         "metrics scrape callback %s failed (logged once)",
                         getattr(fn, "__qualname__", None)
                         or getattr(fn, "__name__", repr(fn)))
+        return self._root._metrics
+
+    def render(self) -> str:
         lines: list[str] = []
-        for m in self._root._metrics.values():
+        for m in self.collect().values():
             lines.extend(m.render())  # type: ignore[attr-defined]
         return "\n".join(lines) + "\n"

@@ -46,15 +46,21 @@ class BackgroundDrain:
         self.count = 0
         self.dropped = 0
 
-    def put(self, item: Any) -> None:
+    def put(self, item: Any) -> bool:
+        """Enqueue without blocking. Returns False when the item was
+        dropped (queue full / drain closed or failed) so producers that
+        must account for loss — the tracer's `dynamo_trace_dropped_total`
+        — can count exactly the queue-bound drops."""
         if self._closed or self.failed:
             self.dropped += 1
-            return
+            return False
         self._ensure_thread()
         try:
             self._queue.put_nowait(item)
+            return True
         except _queue.Full:
             self.dropped += 1
+            return False
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -119,28 +125,60 @@ class Recorder:
     """Append-only JSONL recorder on a BackgroundDrain."""
 
     def __init__(self, path: str | Path, flush_interval: float = 0.5,
-                 max_queue: int = 4096) -> None:
+                 max_queue: int = 4096, max_bytes: int = 0,
+                 keep: int = 3) -> None:
         self.path = Path(path)
         self._file = None
+        # size-based rotation (`trace.jsonl` -> `trace.jsonl.1` ...):
+        # 0 = unbounded (legacy). All rotation work happens on the drain
+        # thread inside _write, never on the serving loop.
+        self.max_bytes = int(max_bytes)
+        self.keep = max(1, int(keep))
+        self._bytes = 0
+        self.rotations = 0
         self._drain = BackgroundDrain(
             self._write, max_queue=max_queue,
             name=f"recorder:{self.path.name}",
             flush=self._do_flush, flush_interval=flush_interval)
 
+    def _open(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._file = self.path.open("a", encoding="utf-8")
+        try:
+            self._bytes = self.path.stat().st_size
+        except OSError:
+            self._bytes = 0
+
+    def _rotate(self) -> None:
+        self._file.close()
+        self._file = None
+        for i in range(self.keep - 1, 0, -1):
+            src = Path(f"{self.path}.{i}")
+            if src.exists():
+                src.replace(Path(f"{self.path}.{i + 1}"))
+        self.path.replace(Path(f"{self.path}.1"))
+        self.rotations += 1
+
     def _write(self, item: dict) -> None:
         if self._file is None:
-            self.path.parent.mkdir(parents=True, exist_ok=True)
-            self._file = self.path.open("a", encoding="utf-8")
-        self._file.write(json.dumps(item, separators=(",", ":")) + "\n")
+            self._open()
+        line = json.dumps(item, separators=(",", ":")) + "\n"
+        if (self.max_bytes > 0 and self._bytes > 0
+                and self._bytes + len(line) > self.max_bytes):
+            self._rotate()
+            self._open()
+        self._file.write(line)
+        self._bytes += len(line)
 
     def _do_flush(self) -> None:
         if self._file is not None:
             self._file.flush()
 
-    def record(self, event: Any) -> None:
+    def record(self, event: Any) -> bool:
         """Non-blocking; drops (and counts) when the writer can't keep
-        up or has failed — recording must never stall serving."""
-        self._drain.put({"timestamp": time.time(), "event": event})
+        up or has failed — recording must never stall serving. Returns
+        False when the event was dropped."""
+        return self._drain.put({"timestamp": time.time(), "event": event})
 
     @property
     def event_count(self) -> int:

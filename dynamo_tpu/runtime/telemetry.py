@@ -1,0 +1,294 @@
+"""Fleet telemetry plane: mergeable metrics snapshots on the event bus.
+
+Reference analog: Dynamo's worker-published `ForwardPassMetrics`/`KvStats`
+streams on NATS that the planner and frontends consume (PAPER.md §planner)
+— metrics ride the message plane, not an HTTP scrape fan-in. Each
+component periodically publishes a `MetricsSnapshot` of its registry
+(histogram buckets + counters, all mergeable) on the ``telemetry``
+subject; a `TelemetryCollector` (frontend, planner, doctor) merges the
+per-component snapshots into one fleet view.
+
+Merge math: counters/gauges sum per label set; histograms with identical
+bucket edges sum per bucket, so `hist_quantile` over the merged counts
+equals the quantile of the combined stream within bucket resolution —
+the property tests/test_telemetry.py asserts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    hist_quantile,
+)
+
+logger = logging.getLogger(__name__)
+
+# Event-plane subject carrying MetricsSnapshot payloads.
+TELEMETRY_SUBJECT = "telemetry"
+
+# Histogram preference order for fleet latency summaries: engine-owned
+# first (per-token truth at the worker), frontend HTTP view as fallback.
+TTFT_HISTOGRAMS = ("dynamo_engine_ttft_seconds",
+                   "dynamo_http_time_to_first_token_seconds")
+ITL_HISTOGRAMS = ("dynamo_engine_itl_ms",
+                  "dynamo_http_inter_token_latency_seconds")
+# value scale → seconds, keyed by metric name (engine ITL is in ms)
+_TO_SECONDS = {"dynamo_engine_itl_ms": 1e-3}
+
+_warned: set[str] = set()
+
+
+def _warn_once(name: str, why: str) -> None:
+    if name not in _warned:
+        _warned.add(name)
+        logger.warning("telemetry: skipping %s during merge: %s (logged "
+                       "once)", name, why)
+
+
+def snapshot_metrics(registry: MetricsRegistry) -> dict[str, dict]:
+    """Serialize a registry into a mergeable, JSON-able MetricsSnapshot:
+    ``{name: {"type": ..., ...}}`` with histogram buckets+counts and
+    per-label-set counter/gauge values."""
+    out: dict[str, dict] = {}
+    for name, m in registry.collect().items():
+        if isinstance(m, Histogram):
+            counts, total_sum, total = m.snapshot()
+            out[name] = {"type": "histogram",
+                         "buckets": list(m.buckets),
+                         "counts": counts,
+                         "sum": total_sum, "count": total}
+        elif isinstance(m, Counter):
+            out[name] = {"type": "counter",
+                         "values": [[lbl, v] for lbl, v in m.items()]}
+        elif isinstance(m, Gauge):
+            out[name] = {"type": "gauge",
+                         "values": [[lbl, v] for lbl, v in m.items()]}
+    return out
+
+
+def _merge_values(into: dict, frm: dict) -> None:
+    acc: dict[tuple, list] = {}
+    for lbl, v in list(into["values"]) + list(frm["values"]):
+        key = tuple(sorted(dict(lbl).items()))
+        if key in acc:
+            acc[key][1] += v
+        else:
+            acc[key] = [dict(lbl), v]
+    into["values"] = [[lbl, v] for lbl, v in acc.values()]
+
+
+def merge_snapshots(snaps: list[dict[str, dict]]) -> dict[str, dict]:
+    """Merge per-component MetricsSnapshots into one fleet snapshot.
+    Counters/gauges sum per label set; histograms require identical
+    bucket edges (mismatches are skipped and logged once)."""
+    merged: dict[str, dict] = {}
+    for snap in snaps:
+        for name, m in (snap or {}).items():
+            cur = merged.get(name)
+            if cur is None:
+                if m.get("type") == "histogram":
+                    merged[name] = {"type": "histogram",
+                                    "buckets": list(m["buckets"]),
+                                    "counts": list(m["counts"]),
+                                    "sum": m["sum"], "count": m["count"]}
+                else:
+                    merged[name] = {"type": m.get("type", "counter"),
+                                    "values": [[dict(l), v]
+                                               for l, v in m["values"]]}
+                continue
+            if cur["type"] != m.get("type"):
+                _warn_once(name, "type mismatch")
+                continue
+            if cur["type"] == "histogram":
+                if (list(cur["buckets"]) != list(m["buckets"])
+                        or len(cur["counts"]) != len(m["counts"])):
+                    _warn_once(name, "bucket-edge mismatch")
+                    continue
+                cur["counts"] = [a + b for a, b in
+                                 zip(cur["counts"], m["counts"])]
+                cur["sum"] += m["sum"]
+                cur["count"] += m["count"]
+            else:
+                _merge_values(cur, m)
+    return merged
+
+
+def flatten(snapshot: dict[str, dict]) -> dict[str, float]:
+    """MetricsSnapshot → the flat ``{name: value}`` shape that
+    `parse_prom_text` produces (histograms become name_sum/name_count,
+    counters/gauges sum across label sets) — so the planner's interval
+    delta math is shared between HTTP scrape and event-plane sources."""
+    out: dict[str, float] = {}
+    for name, m in snapshot.items():
+        if m.get("type") == "histogram":
+            out[name + "_sum"] = float(m["sum"])
+            out[name + "_count"] = float(m["count"])
+        else:
+            out[name] = float(sum(v for _lbl, v in m["values"]))
+    return out
+
+
+def latency_summary(snapshot: dict[str, dict],
+                    quantiles: tuple[float, ...] = (0.5, 0.9, 0.99)
+                    ) -> dict[str, dict]:
+    """TTFT/ITL percentile summary (seconds) from a MetricsSnapshot,
+    preferring engine histograms over the frontend HTTP view."""
+    out: dict[str, dict] = {}
+    for key, names in (("ttft", TTFT_HISTOGRAMS), ("itl", ITL_HISTOGRAMS)):
+        for name in names:
+            m = snapshot.get(name)
+            if not m or m.get("type") != "histogram" or not m.get("count"):
+                continue
+            scale = _TO_SECONDS.get(name, 1.0)
+            summary = {"source": name, "count": m["count"],
+                       "mean": scale * m["sum"] / m["count"]}
+            for q in quantiles:
+                summary[f"p{int(q * 100)}"] = scale * hist_quantile(
+                    m["buckets"], m["counts"], q)
+            out[key] = summary
+            break
+    return out
+
+
+def _publish_best_effort(bus, subject: str, payload: dict) -> None:
+    """Never block, never raise: local buses take publish_nowait; remote
+    buses get a fire-and-forget task (same contract as breaker events)."""
+    try:
+        if hasattr(bus, "publish_nowait"):
+            bus.publish_nowait(subject, payload)
+        else:
+            asyncio.get_running_loop().create_task(
+                bus.publish(subject, payload))
+    except Exception:
+        logger.exception("telemetry publish failed")
+
+
+class TelemetryPublisher:
+    """Periodically publishes this process's MetricsSnapshot on the
+    telemetry subject. One per served component (worker) or frontend."""
+
+    def __init__(self, bus, registry: MetricsRegistry, component: str,
+                 instance: str, role: str = "worker",
+                 interval: float = 5.0) -> None:
+        self._bus = bus
+        self._registry = registry
+        self.component = component
+        self.instance = instance
+        self.role = role
+        self.interval = interval
+        self.published = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def publish_once(self) -> dict:
+        payload = {"component": self.component, "instance": self.instance,
+                   "role": self.role, "at": time.time(),
+                   "metrics": snapshot_metrics(self._registry)}
+        _publish_best_effort(self._bus, TELEMETRY_SUBJECT, payload)
+        self.published += 1
+        return payload
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                self.publish_once()
+            except Exception:
+                logger.exception("telemetry snapshot failed")
+            await asyncio.sleep(self.interval)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        # parting snapshot so the collector sees final totals
+        try:
+            self.publish_once()
+        except Exception:
+            pass
+
+
+class TelemetryCollector:
+    """Subscribes to the telemetry subject and keeps the latest snapshot
+    per (component, instance); `fleet_status()` is the merged view served
+    at /fleet/status and rendered by `doctor fleet`."""
+
+    def __init__(self, bus, stale_after: float = 120.0) -> None:
+        self._bus = bus
+        self.stale_after = stale_after
+        self._latest: dict[tuple[str, str], dict] = {}
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self.received = 0
+
+    async def start(self) -> None:
+        self._sub = await self._bus.subscribe(TELEMETRY_SUBJECT,
+                                              from_start=True)
+        self._task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        async for msg in self._sub:
+            self.ingest(msg.get("payload") or {})
+
+    def ingest(self, payload: dict) -> None:
+        key = (str(payload.get("component", "?")),
+               str(payload.get("instance", "?")))
+        self._latest[key] = payload
+        self.received += 1
+
+    def live(self) -> dict[tuple[str, str], dict]:
+        now = time.time()
+        return {k: p for k, p in self._latest.items()
+                if now - float(p.get("at", now)) <= self.stale_after}
+
+    def merged(self) -> dict[str, dict]:
+        return merge_snapshots([p.get("metrics") or {}
+                                for p in self.live().values()])
+
+    def fleet_status(self, slo=None) -> dict[str, Any]:
+        now = time.time()
+        components = []
+        for (comp, inst), p in sorted(self.live().items()):
+            metrics = p.get("metrics") or {}
+            components.append({
+                "component": comp, "instance": inst,
+                "role": p.get("role", "?"),
+                "age_s": round(now - float(p.get("at", now)), 3),
+                "latency": latency_summary(metrics),
+            })
+        merged = self.merged()
+        out: dict[str, Any] = {
+            "at": now,
+            "components": components,
+            "fleet": {"latency": latency_summary(merged),
+                      "metrics": flatten(merged)},
+        }
+        if slo is not None:
+            out["slo"] = slo.status()
+        return out
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._sub is not None:
+            self._sub.cancel()
+            self._sub = None

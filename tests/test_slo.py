@@ -1,0 +1,210 @@
+"""SLO burn-rate monitor: multi-window burn math, state transitions on
+the event plane, and the full-stack breach driven by a MockEngine whose
+latency blows the TTFT objective (docs/observability.md "SLOs").
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.slo import (
+    SLO_EVENTS_SUBJECT,
+    SloMonitor,
+    SloObjective,
+)
+
+pytestmark = pytest.mark.tier0
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _monitor(clock, **kw):
+    defaults = dict(fast_window=10.0, slow_window=100.0,
+                    fast_burn=4.0, slow_burn=2.0)
+    defaults.update(kw)
+    return SloMonitor([SloObjective("ttft", threshold=0.1,
+                                    target_ratio=0.9)],
+                      clock=clock, **defaults)
+
+
+def test_burn_rate_math_and_state_machine():
+    clock = _Clock()
+    mon = _monitor(clock)
+    # healthy traffic: burns stay 0, no transitions
+    clock.now = 1.0
+    for _ in range(8):
+        mon.observe("ttft", 0.05)
+    clock.now = 2.0
+    assert mon.evaluate() == []
+    assert mon.burn_gauge.get(objective="ttft", window="fast") == 0.0
+    # half the window goes bad: bad_ratio 0.5 / budget 0.1 = burn 5,
+    # over both thresholds → breach (fast AND slow hot)
+    clock.now = 3.0
+    for _ in range(8):
+        mon.observe("ttft", 0.5)
+    clock.now = 4.0
+    events = mon.evaluate()
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["objective"] == "ttft"
+    assert ev["from"] == "ok" and ev["to"] == "breach"
+    assert ev["fast_burn"] == pytest.approx(5.0)
+    assert ev["slow_burn"] == pytest.approx(5.0)
+    assert mon.transitions_total.get(objective="ttft", to="breach") == 1
+    # re-evaluating without change emits nothing (edge-triggered)
+    assert mon.evaluate() == []
+    # the bad burst ages out of the fast window but not the slow one
+    clock.now = 50.0
+    events = mon.evaluate()
+    assert [e["to"] for e in events] == ["slow_burn"]
+    assert mon.burn_gauge.get(objective="ttft", window="fast") == 0.0
+    assert mon.burn_gauge.get(objective="ttft", window="slow") \
+        == pytest.approx(5.0)
+    # everything past the slow window: samples trimmed, back to ok
+    clock.now = 200.0
+    events = mon.evaluate()
+    assert [e["to"] for e in events] == ["ok"]
+    st = mon.status()["ttft"]
+    assert st["state"] == "ok" and st["samples"] == 0
+
+
+def test_fast_only_burn_flags_emerging_burn():
+    clock = _Clock()
+    # slow threshold set high so only the fast window can go hot
+    mon = _monitor(clock, slow_burn=6.0)
+    clock.now = 1.0
+    for _ in range(20):
+        mon.observe("ttft", 0.05)   # old good traffic
+    clock.now = 95.0
+    for _ in range(10):
+        mon.observe("ttft", 0.5)    # fresh bad burst
+    clock.now = 96.0
+    events = mon.evaluate()
+    # fast window: all bad → burn 10 ≥ 4; slow: 10/30 / 0.1 ≈ 3.3 < 6
+    assert [e["to"] for e in events] == ["fast_burn"]
+
+
+def test_zero_error_budget_burns_infinite():
+    clock = _Clock()
+    mon = SloMonitor([SloObjective("itl", threshold=0.01,
+                                   target_ratio=1.0)],
+                     fast_window=10.0, slow_window=10.0, clock=clock)
+    clock.now = 1.0
+    mon.observe("itl", 0.5)
+    clock.now = 2.0
+    mon.evaluate()
+    assert mon.status()["itl"]["fast_burn"] == float("inf")
+
+
+def test_observe_unknown_objective_is_ignored():
+    mon = _monitor(_Clock())
+    mon.observe("nope", 1.0)        # no configured objective: no-op
+    assert mon.status().keys() == {"ttft"}
+
+
+def test_status_window_percentiles():
+    clock = _Clock()
+    mon = _monitor(clock)
+    clock.now = 1.0
+    for v in (0.01, 0.02, 0.03, 0.04, 0.5):
+        mon.observe("ttft", v)
+    st = mon.status()["ttft"]
+    assert st["samples"] == 5
+    assert st["window"]["p50"] == pytest.approx(0.03)
+    assert st["window"]["p99"] == pytest.approx(0.5)
+    assert st["threshold_s"] == 0.1 and st["target_ratio"] == 0.9
+
+
+def test_gauges_join_registry():
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    clock = _Clock()
+    mon = _monitor(clock)
+    reg = MetricsRegistry("dynamo")
+    mon.register(reg)
+    clock.now = 1.0
+    mon.observe("ttft", 0.5)
+    clock.now = 2.0
+    mon.evaluate()
+    text = reg.render()
+    assert 'dynamo_slo_burn_rate{objective="ttft",window="fast"} 10.0' \
+        in text
+    assert "dynamo_slo_transitions_total" in text
+
+
+async def test_slo_breach_from_engine_latency_fault():
+    """Full stack: a MockEngine whose per-token latency sails past a
+    microscopic TTFT objective drives the monitor ok → breach; the
+    transition is published on `slo_events`, the burn gauges go hot, and
+    /fleet/status carries the live SLO block."""
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(
+        store_url="memory",
+        slo_ttft=1e-6,              # any real TTFT is a violation
+        slo_check_interval=0.05,
+        slo_fast_window=10.0, slo_slow_window=10.0,
+        slo_fast_burn=1.0, slo_slow_burn=1.0))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin")
+    ev_sink, m_sink = wire_engine_events(rt, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=50.0, default_max_tokens=8),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    handle = await serve_engine(rt, eng, card, instance_id=1)
+    fe = await start_frontend(rt)
+    try:
+        assert fe.slo is not None
+        sub = await rt.events.subscribe(SLO_EVENTS_SUBJECT)
+        for _ in range(200):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{fe.url}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 6,
+                          "stream": True,
+                          "messages": [{"role": "user",
+                                        "content": "hello"}]}) as r:
+                assert r.status == 200
+                await r.read()
+            msg = await asyncio.wait_for(sub.__anext__(), 5)
+            ev = msg["payload"]
+            assert ev["objective"] == "ttft"
+            assert ev["from"] == "ok" and ev["to"] == "breach"
+            assert ev["fast_burn"] >= 1.0
+            sub.cancel()
+            # burn gauges are live on the frontend registry
+            assert fe.slo.burn_gauge.get(objective="ttft",
+                                         window="fast") >= 1.0
+            assert "dynamo_slo_burn_rate" in rt.metrics.render()
+            # /fleet/status carries the live SLO block
+            async with s.get(f"{fe.url}/fleet/status") as r:
+                assert r.status == 200
+                status = await r.json()
+            assert status["slo"]["ttft"]["state"] == "breach"
+            assert status["slo"]["ttft"]["samples"] >= 1
+    finally:
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt.close()

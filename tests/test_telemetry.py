@@ -1,0 +1,384 @@
+"""Fleet telemetry plane: mergeable MetricsSnapshots on the event bus,
+the collector's merged /fleet/status view, `doctor fleet`, and the
+planner's zero-HTTP TelemetrySource (docs/observability.md "Fleet
+view"). `make fleet-smoke` runs the full-stack test here.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.runtime.events import LocalEventBus
+from dynamo_tpu.runtime.metrics import (
+    Histogram,
+    MetricsRegistry,
+    hist_quantile,
+)
+from dynamo_tpu.runtime.telemetry import (
+    TELEMETRY_SUBJECT,
+    TelemetryCollector,
+    TelemetryPublisher,
+    flatten,
+    latency_summary,
+    merge_snapshots,
+    snapshot_metrics,
+)
+
+pytestmark = pytest.mark.tier0
+
+_EDGES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+
+def _hist_registry(name: str) -> tuple[MetricsRegistry, Histogram]:
+    reg = MetricsRegistry("dynamo")
+    h = Histogram(name, buckets=_EDGES)
+    reg.register(h)
+    return reg, h
+
+
+# -- merge math --------------------------------------------------------------
+
+
+def test_histogram_merge_matches_combined_stream():
+    """The fleet property: quantiles of merge(a, b) equal quantiles of
+    the combined observation stream — exactly, since identical bucket
+    edges sum count-for-count (error is bucket resolution, shared by
+    both sides)."""
+    name = "dynamo_test_latency_seconds"
+    reg_a, ha = _hist_registry(name)
+    reg_b, hb = _hist_registry(name)
+    combined = Histogram(name, buckets=_EDGES)
+    rng = random.Random(7)
+    for _ in range(500):
+        v = rng.uniform(0.0005, 2.0)
+        ha.observe(v)
+        combined.observe(v)
+    for _ in range(300):
+        v = rng.uniform(0.0005, 0.05)
+        hb.observe(v)
+        combined.observe(v)
+    merged = merge_snapshots([snapshot_metrics(reg_a),
+                              snapshot_metrics(reg_b)])[name]
+    assert merged["count"] == combined.count == 800
+    assert merged["sum"] == pytest.approx(combined.sum)
+    for q in (0.5, 0.9, 0.99):
+        assert hist_quantile(merged["buckets"], merged["counts"], q) \
+            == combined.quantile(q)
+
+
+def test_merge_skips_mismatched_bucket_edges():
+    name = "dynamo_test_latency_seconds"
+    reg_a, ha = _hist_registry(name)
+    reg_b = MetricsRegistry("dynamo")
+    hb = Histogram(name, buckets=(0.1, 1.0))
+    reg_b.register(hb)
+    ha.observe(0.01)
+    hb.observe(0.01)
+    merged = merge_snapshots([snapshot_metrics(reg_a),
+                              snapshot_metrics(reg_b)])
+    # the mismatched snapshot is skipped, not mis-summed
+    assert merged[name]["count"] == 1
+    assert list(merged[name]["buckets"]) == list(_EDGES)
+
+
+def test_counter_gauge_merge_sums_per_label_set():
+    reg_a = MetricsRegistry("dynamo")
+    reg_b = MetricsRegistry("dynamo")
+    ca = reg_a.counter("requests_total")
+    cb = reg_b.counter("requests_total")
+    ca.inc(3, endpoint="chat")
+    ca.inc(1, endpoint="completions")
+    cb.inc(4, endpoint="chat")
+    ga = reg_a.gauge("inflight")
+    gb = reg_b.gauge("inflight")
+    ga.set(2)
+    gb.set(5)
+    merged = merge_snapshots([snapshot_metrics(reg_a),
+                              snapshot_metrics(reg_b)])
+    values = {tuple(sorted(lbl.items())): v
+              for lbl, v in merged["dynamo_requests_total"]["values"]}
+    assert values[(("endpoint", "chat"),)] == 7
+    assert values[(("endpoint", "completions"),)] == 1
+    assert flatten(merged)["dynamo_inflight"] == 7
+
+
+def test_flatten_matches_parse_prom_text():
+    """Event-plane totals and HTTP-scrape totals are the same numbers:
+    the planner's shared delta math can't drift between transports."""
+    from dynamo_tpu.planner.prometheus_source import parse_prom_text
+
+    reg, h = _hist_registry("dynamo_http_request_duration_seconds")
+    c = reg.counter("requests_total")
+    c.inc(2, endpoint="chat")
+    c.inc(5, endpoint="completions")
+    h.observe(0.25)
+    h.observe(0.75)
+    flat = flatten(snapshot_metrics(reg))
+    parsed = parse_prom_text(reg.render())
+    for key in ("dynamo_http_request_duration_seconds_sum",
+                "dynamo_http_request_duration_seconds_count",
+                "dynamo_requests_total"):
+        assert flat[key] == parsed[key]
+
+
+def test_parse_prom_text_skips_non_finite_samples():
+    from dynamo_tpu.planner.prometheus_source import parse_prom_text
+
+    text = ("a_total 3\n"
+            "b_seconds_sum NaN\n"
+            "b_seconds_count 2\n"
+            "c_bucket{le=\"+Inf\"} +Inf\n")
+    out = parse_prom_text(text)
+    assert out == {"a_total": 3.0, "b_seconds_count": 2.0}
+
+
+def test_latency_summary_prefers_engine_and_scales_ms():
+    reg = MetricsRegistry("dynamo")
+    itl_ms = Histogram("dynamo_engine_itl_ms", buckets=(1.0, 5.0, 10.0,
+                                                        50.0))
+    reg.register(itl_ms)
+    for _ in range(10):
+        itl_ms.observe(8.0)               # engine ITL is milliseconds
+    summary = latency_summary(snapshot_metrics(reg))
+    assert summary["itl"]["source"] == "dynamo_engine_itl_ms"
+    assert summary["itl"]["p50"] == pytest.approx(0.010)   # seconds
+    assert summary["itl"]["mean"] == pytest.approx(0.008)
+    assert "ttft" not in summary          # no ttft histogram present
+
+
+# -- publisher → collector over the event bus --------------------------------
+
+
+async def test_publisher_collector_roundtrip():
+    bus = LocalEventBus()
+    reg, h = _hist_registry("dynamo_engine_ttft_seconds")
+    h.observe(0.02)
+    pub = TelemetryPublisher(bus, reg, component="ns/mock", instance="1",
+                             role="worker", interval=60.0)
+    pub.publish_once()
+    collector = TelemetryCollector(bus)
+    await collector.start()
+    try:
+        for _ in range(100):
+            if collector.received:
+                break
+            await asyncio.sleep(0.01)
+        status = collector.fleet_status()
+        assert [c["component"] for c in status["components"]] == ["ns/mock"]
+        assert status["components"][0]["role"] == "worker"
+        assert status["fleet"]["latency"]["ttft"]["count"] == 1
+    finally:
+        await collector.stop()
+    # a second publish supersedes, never duplicates, the instance
+    h.observe(0.04)
+    pub.publish_once()
+    sub = await bus.subscribe(TELEMETRY_SUBJECT, from_start=True)
+    c2 = TelemetryCollector(bus)
+    async for msg in sub:
+        c2.ingest(msg["payload"])
+        if c2.received == 2:
+            break
+    sub.cancel()
+    assert len(c2.live()) == 1
+    assert c2.merged()["dynamo_engine_ttft_seconds"]["count"] == 2
+
+
+async def test_collector_prunes_stale_components():
+    collector = TelemetryCollector(LocalEventBus(), stale_after=30.0)
+    collector.ingest({"component": "dead", "instance": "0",
+                      "at": time.time() - 1000, "metrics": {}})
+    collector.ingest({"component": "live", "instance": "1",
+                      "at": time.time(), "metrics": {}})
+    status = collector.fleet_status()
+    assert [c["component"] for c in status["components"]] == ["live"]
+
+
+# -- planner TelemetrySource: zero HTTP scrapes ------------------------------
+
+
+def _http_metrics_registry():
+    reg = MetricsRegistry("dynamo")
+    http = reg.child("http")
+    return reg, {
+        "ttft": http.histogram("time_to_first_token_seconds",
+                               buckets=(0.01, 0.1, 1.0)),
+        "itl": http.histogram("inter_token_latency_seconds",
+                              buckets=(0.001, 0.01, 0.1)),
+        "duration": http.histogram("request_duration_seconds",
+                                   buckets=(0.1, 1.0, 10.0)),
+        "isl": http.histogram("request_input_tokens",
+                              buckets=(16, 64, 256, 1024)),
+        "osl": http.histogram("request_output_tokens",
+                              buckets=(16, 64, 256, 1024)),
+    }
+
+
+def _observe_requests(hists, n, isl=256.0, osl=64.0, ttft=0.03, itl=0.02,
+                      duration=1.3):
+    for _ in range(n):
+        hists["ttft"].observe(ttft)
+        hists["itl"].observe(itl)
+        hists["duration"].observe(duration)
+        hists["isl"].observe(isl)
+        hists["osl"].observe(osl)
+
+
+async def test_telemetry_source_interval_metrics():
+    from dynamo_tpu.planner.telemetry_source import TelemetrySource
+
+    reg, hists = _http_metrics_registry()
+    collector = TelemetryCollector(LocalEventBus())
+    source = TelemetrySource(collector)
+
+    def ingest():
+        collector.ingest({"component": "frontend", "instance": "a",
+                          "at": time.time(),
+                          "metrics": snapshot_metrics(reg)})
+
+    _observe_requests(hists, 3)
+    ingest()
+    first = await source.interval_metrics()
+    assert not first.is_valid()           # no prior totals yet
+    _observe_requests(hists, 5)
+    ingest()
+    m = await source.interval_metrics()
+    assert m.is_valid() and m.num_req == 5
+    assert m.isl == pytest.approx(256.0)
+    assert m.osl == pytest.approx(64.0)
+    assert m.ttft == pytest.approx(0.03)
+    assert m.itl == pytest.approx(0.02)
+    assert m.request_duration == pytest.approx(1.3)
+
+
+async def test_planner_smoke_over_telemetry_source():
+    """The SLA planner runs observe+adjust cycles entirely off the
+    event-plane source — zero HTTP scrapes anywhere in the loop."""
+    from dynamo_tpu.planner import (
+        DecodeInterpolator,
+        Planner,
+        PrefillInterpolator,
+        SlaPlannerConfig,
+    )
+    from dynamo_tpu.planner.telemetry_source import TelemetrySource
+    from tests.test_planner import DECODE_RAW, PREFILL_RAW
+
+    reg, hists = _http_metrics_registry()
+    collector = TelemetryCollector(LocalEventBus())
+    source = TelemetrySource(collector)
+    cfg = SlaPlannerConfig(adjustment_interval=10.0, ttft_sla=0.5,
+                           itl_sla=0.05, max_chip_budget=16)
+    planner = Planner(cfg, PrefillInterpolator(raw_data=PREFILL_RAW),
+                      DecodeInterpolator(raw_data=DECODE_RAW), source)
+
+    def ingest():
+        collector.ingest({"component": "frontend", "instance": "a",
+                          "at": time.time(),
+                          "metrics": snapshot_metrics(reg)})
+
+    _observe_requests(hists, 4)
+    ingest()
+    await planner.step()                  # priming interval
+    _observe_requests(hists, 20, ttft=0.05, itl=0.02)
+    ingest()
+    scaled = await planner.step()
+    assert planner.last_metrics.is_valid()
+    assert planner.last_metrics.num_req == 20
+    assert planner.last_metrics.ttft == pytest.approx(0.05)
+    assert scaled is not None
+    num_p, num_d = scaled
+    assert num_p >= 1 and num_d >= 1
+
+
+# -- full-stack fleet smoke (`make fleet-smoke`) -----------------------------
+
+
+async def test_fleet_smoke(tmp_path, capsys):
+    """Worker + frontend publish MetricsSnapshots over a real TCP-store
+    event plane; GET /fleet/status reports both components and the
+    merged TTFT/ITL percentiles; `doctor fleet` renders a capture."""
+    from dynamo_tpu.doctor.__main__ import main as doctor_main
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.store_net import StoreServer
+
+    store_server = StoreServer()
+    host, port = await store_server.start()
+    store_url = f"tcp://{host}:{port}"
+    rt_w = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url, telemetry_interval=0.05))
+    rt_f = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url, telemetry_interval=0.05))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin")
+    ev_sink, m_sink = wire_engine_events(rt_w, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=8),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    handle = await serve_engine(rt_w, eng, card, instance_id=1)
+    fe = await start_frontend(rt_f)
+    status = None
+    try:
+        for _ in range(200):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"{fe.url}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 6,
+                          "stream": True,
+                          "messages": [{"role": "user",
+                                        "content": "hello there"}]}) as r:
+                assert r.status == 200
+                await r.read()
+            # wait for both publishers' post-traffic snapshots to land
+            for _ in range(300):
+                async with s.get(f"{fe.url}/fleet/status") as r:
+                    assert r.status == 200
+                    status = await r.json()
+                roles = {c["role"] for c in status["components"]}
+                if roles >= {"worker", "frontend"} \
+                        and status["fleet"]["latency"].get("ttft"):
+                    break
+                await asyncio.sleep(0.02)
+    finally:
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt_f.close()
+        await rt_w.close()
+        await store_server.stop()
+
+    roles = {c["role"]: c for c in status["components"]}
+    assert set(roles) == {"worker", "frontend"}
+    assert roles["worker"]["component"] == "ns/mock"
+    # worker latency comes from the engine histograms, merged fleet view
+    # reports per-request percentiles in seconds
+    fleet = status["fleet"]["latency"]
+    assert fleet["ttft"]["count"] >= 1 and fleet["ttft"]["p50"] > 0
+    assert fleet["itl"]["count"] >= 1
+    assert status["fleet"]["metrics"].get(
+        "dynamo_http_requests_total", 0) >= 1
+
+    # `doctor fleet` renders the same payload from an offline capture
+    capture = tmp_path / "fleet.json"
+    capture.write_text(json.dumps(status))
+    rc = doctor_main(["fleet", str(capture)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "component(s) reporting" in out
+    assert "ns/mock" in out and "[merged  ]" in out

@@ -1,0 +1,256 @@
+"""Trace sampling under load: trace-id-ratio head sampling, tail-based
+keep (ERROR / slow traces survive even when head-sampled out), exact
+queue-bound drop accounting, and size-based trace-file rotation
+(docs/observability.md "Sampling").
+"""
+
+import random
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.recorder import Recorder
+from dynamo_tpu.runtime.tracing import (
+    Tracer,
+    head_sampled,
+    parse_traceparent,
+    parse_traceparent_ex,
+    set_tracer,
+    tracer,
+)
+from dynamo_tpu.runtime import tracing as tracing_mod
+
+pytestmark = pytest.mark.tier0
+
+
+class _FakeSecrets:
+    """Deterministic stand-in for the secrets module: seeded trace/span
+    ids make the sampling soak exactly reproducible."""
+
+    def __init__(self, seed: int) -> None:
+        self._rng = random.Random(seed)
+
+    def token_hex(self, n: int) -> str:
+        return f"{self._rng.getrandbits(8 * n):0{2 * n}x}"
+
+
+# -- head sampling: pure function of the trace id ---------------------------
+
+
+def test_head_sampled_bounds_and_determinism():
+    tid = "ab" * 16
+    assert head_sampled(tid, 1.0) is True
+    assert head_sampled(tid, 0.0) is False
+    # decision is a pure function: every process agrees, every time
+    assert head_sampled(tid, 0.37) == head_sampled(tid, 0.37)
+    # extremes of the low-64-bit keyspace
+    assert head_sampled("0" * 32, 1e-9) is True
+    assert head_sampled("f" * 32, 0.999) is False
+    # unparseable ids fail open (trace rather than lose data)
+    assert head_sampled("zz" * 16, 0.5) is True
+
+
+def test_head_sampled_ratio_is_unbiased():
+    fake = _FakeSecrets(42)
+    ids = [fake.token_hex(16) for _ in range(10_000)]
+    kept = sum(head_sampled(t, 0.3) for t in ids)
+    assert 0.28 <= kept / len(ids) <= 0.32
+
+
+# -- W3C flags byte: the decision rides the wire ----------------------------
+
+
+def test_traceparent_flags_roundtrip_and_back_compat():
+    t = Tracer(enabled=False, sample=0.0)
+    s = t.start_span("unsampled root")
+    assert s.sampled is False
+    assert s.traceparent().endswith("-00")
+    # parse_traceparent keeps its historical 2-tuple contract
+    assert parse_traceparent(s.traceparent()) == (s.trace_id, s.span_id)
+    assert parse_traceparent_ex(s.traceparent()) == (
+        s.trace_id, s.span_id, False)
+    # flags default to sampled when the byte is garbage (old senders)
+    tp = "00-" + "a" * 32 + "-" + "b" * 16 + "-xx"
+    assert parse_traceparent_ex(tp) == ("a" * 32, "b" * 16, True)
+
+
+def test_explicit_flags_override_local_head_decision():
+    # upstream said sampled: a sample=0 tracer still keeps the trace
+    t0 = Tracer(enabled=False, sample=0.0)
+    s = t0.start_span("x", traceparent="00-" + "a" * 32 + "-"
+                      + "b" * 16 + "-01")
+    assert s.sampled is True and s.trace_id == "a" * 32
+    # upstream said not sampled: a sample=1 tracer honors the drop
+    t1 = Tracer(enabled=False, sample=1.0)
+    s2 = t1.start_span("y", traceparent="00-" + "a" * 32 + "-"
+                       + "b" * 16 + "-00")
+    assert s2.sampled is False
+
+
+def test_child_inherits_parent_sampling():
+    t = Tracer(enabled=False, sample=0.0)
+    with t.start_span("root") as root:
+        child = t.start_span("child")
+        assert child.trace_id == root.trace_id
+        assert child.sampled is root.sampled is False
+        child.end()
+
+
+# -- tail-based keep --------------------------------------------------------
+
+
+async def test_tail_keep_error_trace_at_sample_zero(tmp_path):
+    """DYN_TRACE_SAMPLE=0 drops everything EXCEPT traces that went bad:
+    an ERROR anywhere in the trace exports the whole buffered trace."""
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path), sample=0.0)
+    with t.start_span("bad request") as bad_root:
+        child = t.start_span("engine.request")
+        child.record_error(RuntimeError("kaboom"))
+        child.end()
+    with t.start_span("fine request"):
+        pass
+    await t.close()
+    rows = [e for _, e in Recorder.iter_events(path)]
+    assert {r["name"] for r in rows} == {"bad request", "engine.request"}
+    assert all(r["traceId"] == bad_root.trace_id for r in rows)
+    err = next(r for r in rows if r["name"] == "engine.request")
+    assert err["status"]["code"] == "ERROR"
+    assert t.exported == 2
+    assert t.sampled_out_total.get() == 1   # the fine request's only span
+    assert t.dropped == 0
+
+
+async def test_tail_keep_slow_trace(tmp_path):
+    """A trace whose any span ran past DYN_TRACE_SLOW_MS exports even
+    when head-sampled out."""
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path), sample=0.0, slow_ms=50.0)
+    slow = t.start_span("slow op")
+    slow.start_ns = time.time_ns() - int(80e6)   # 80 ms ago
+    slow.end()
+    fast = t.start_span("fast op")
+    fast.end()
+    await t.close()
+    rows = [e for _, e in Recorder.iter_events(path)]
+    assert [r["name"] for r in rows] == ["slow op"]
+    assert t.exported == 1 and t.sampled_out_total.get() == 1
+
+
+# -- the 1k-request sampling soak -------------------------------------------
+
+
+async def test_sampling_soak_ratio_and_error_keep(tmp_path, monkeypatch):
+    """1000 two-span traces at DYN_TRACE_SAMPLE=0.1 with seeded trace
+    ids: exported roots match the head function exactly (within ±3% of
+    10% by construction), every ERROR trace is present regardless of its
+    head decision, and the drop counter stays at zero."""
+    monkeypatch.setattr(tracing_mod, "secrets", _FakeSecrets(1234))
+    path = tmp_path / "soak.jsonl"
+    t = Tracer(enabled=True, path=str(path), sample=0.1)
+    n = 1000
+    tids, err_tids = [], []
+    for i in range(n):
+        is_err = i % 50 == 7
+        with t.start_span("http request") as root:
+            child = t.start_span("engine.request")
+            if is_err:
+                child.record_error(RuntimeError("injected"))
+            child.end()
+        tids.append(root.trace_id)
+        if is_err:
+            err_tids.append(root.trace_id)
+    await t.close()
+
+    expected = {tid for tid, is_err in
+                ((tid, tid in set(err_tids)) for tid in tids)
+                if head_sampled(tid, 0.1) or is_err}
+    head_kept = sum(head_sampled(tid, 0.1) for tid in tids)
+    # ±3% of the request count around the 10% target
+    assert n * 0.07 <= head_kept <= n * 0.13
+
+    rows = [e for _, e in Recorder.iter_events(path)]
+    roots = [r for r in rows if not r["parentSpanId"]]
+    assert {r["traceId"] for r in rows} == expected
+    assert len(roots) == len(expected)
+    # every ERROR trace survived, head-sampled out or not
+    assert set(err_tids) <= {r["traceId"] for r in rows}
+    # exact span accounting: 2 spans per trace, nothing dropped
+    assert t.exported == 2 * len(expected)
+    assert t.sampled_out_total.get() == 2 * n - t.exported
+    assert t.dropped == 0
+
+
+# -- exact drop accounting ---------------------------------------------------
+
+
+async def test_dropped_total_counts_exactly_queue_drops(tmp_path):
+    path = tmp_path / "t.jsonl"
+    t = Tracer(enabled=True, path=str(path), sample=1.0)
+    real_record = t._recorder.record
+    calls = {"n": 0}
+
+    def flaky(event):
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            return False        # queue full: Recorder.record contract
+        return real_record(event)
+
+    t._recorder.record = flaky
+    for i in range(5):
+        t.start_span(f"s{i}").end()
+    await t.close()
+    assert t.dropped == 3
+    assert t.exported == 2
+    rows = [e for _, e in Recorder.iter_events(path)]
+    assert len(rows) == 2
+
+
+# -- trace file rotation -----------------------------------------------------
+
+
+async def test_recorder_size_rotation(tmp_path):
+    """DYN_TRACE_MAX_MB analog: the drain rotates trace.jsonl →
+    trace.jsonl.1 … keeping the newest `keep` generations."""
+    path = tmp_path / "trace.jsonl"
+    rec = Recorder(path, max_bytes=1000, keep=2)
+    for i in range(60):
+        assert rec.record({"i": i, "pad": "x" * 100})
+    await rec.close()
+    assert rec.rotations >= 2
+    assert path.exists() and path.stat().st_size <= 1000
+    assert (tmp_path / "trace.jsonl.1").exists()
+    assert (tmp_path / "trace.jsonl.2").exists()
+    assert not (tmp_path / "trace.jsonl.3").exists()   # keep=2 generations
+    # rotated-out generations still parse as JSONL
+    rows = [e for _, e in Recorder.iter_events(tmp_path / "trace.jsonl.1")]
+    assert rows and all("pad" in r for r in rows)
+
+
+def test_tracer_env_knobs(monkeypatch, tmp_path):
+    monkeypatch.setenv("DYN_TRACE", "1")
+    monkeypatch.setenv("DYN_TRACE_PATH", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("DYN_TRACE_SAMPLE", "0.25")
+    monkeypatch.setenv("DYN_TRACE_SLOW_MS", "150")
+    monkeypatch.setenv("DYN_TRACE_MAX_MB", "2")
+    monkeypatch.setenv("DYN_TRACE_KEEP", "5")
+    set_tracer(None)
+    try:
+        t = tracer()
+        assert t.enabled and t.sample == 0.25 and t.slow_ms == 150.0
+        assert t._recorder.max_bytes == 2 * 1024 * 1024
+        assert t._recorder.keep == 5
+    finally:
+        set_tracer(None)
+
+
+def test_tracer_counters_join_registry():
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    t = Tracer(enabled=False)
+    reg = MetricsRegistry("dynamo")
+    t.register_metrics(reg)
+    text = reg.render()
+    assert "dynamo_trace_exported_total" in text
+    assert "dynamo_trace_dropped_total" in text
+    assert "dynamo_trace_sampled_out_total" in text

@@ -116,6 +116,89 @@ async def test_mocker_trace_smoke(tmp_path):
     assert req["status"]["code"] == "OK"
 
 
+async def test_cross_hop_sampling_determinism(tmp_path):
+    """DYN_TRACE_SAMPLE=0.5: the head decision is a pure function of the
+    trace_id AND rides the W3C flags byte, so the frontend and the
+    worker — separated by a real TCP transport hop — make the SAME
+    keep/drop call. A head-in trace lands spans from both processes; a
+    head-out trace leaves nothing from either."""
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.tracing import head_sampled
+
+    path = tmp_path / "trace.jsonl"
+    t = Tracer(enabled=True, path=str(path), sample=0.5)
+    set_tracer(t)
+    # two trace ids on opposite sides of the 0.5 cut; the flags we send
+    # match what a fresh root would decide, so every hop agrees
+    tid_keep = "0" * 31 + "1"
+    tid_drop = "f" * 32
+    assert head_sampled(tid_keep, 0.5) is True
+    assert head_sampled(tid_drop, 0.5) is False
+    store_server, store_url = await _start_shared_store()
+    rt_w = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url))
+    rt_f = await DistributedRuntime.create(RuntimeConfig(
+        store_url=store_url))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin")
+    ev_sink, m_sink = wire_engine_events(rt_w, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=8),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    handle = await serve_engine(rt_w, eng, card, instance_id=1)
+    fe = await start_frontend(rt_f)
+    try:
+        for _ in range(200):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            for tid in (tid_keep, tid_drop):
+                flags = "01" if head_sampled(tid, t.sample) else "00"
+                tp = f"00-{tid}-{'b' * 16}-{flags}"
+                async with s.post(
+                        f"{fe.url}/v1/chat/completions",
+                        headers={TRACEPARENT: tp},
+                        json={"model": "mock-model", "max_tokens": 6,
+                              "messages": [{"role": "user",
+                                            "content": "hi"}]}) as r:
+                    assert r.status == 200, await r.text()
+    finally:
+        set_tracer(None)
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt_f.close()
+        await rt_w.close()
+        await store_server.stop()
+    await t.close()
+
+    rows = [e for _, e in Recorder.iter_events(path)]
+    kept = [r for r in rows if r["traceId"] == tid_keep]
+    names = {r["name"] for r in kept}
+    # frontend http span AND the worker-side transport/engine spans all
+    # exported — both processes kept the trace
+    assert any(n.startswith("http ") for n in names)
+    assert any(n.startswith("serve ") for n in names)
+    assert "engine.request" in names
+    # the head-sampled-out trace left nothing from either side, and its
+    # spans were accounted as sampled-out, not dropped
+    assert not any(r["traceId"] == tid_drop for r in rows)
+    assert t.sampled_out_total.get() >= 3
+    assert t.dropped == 0
+
+
 async def test_traceparent_through_push_router_retries(tmp_path):
     """A dial failure on the first candidate retries the next one; the
     request that finally lands still carries the ORIGINAL traceparent —

@@ -3,7 +3,7 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke
+.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -36,6 +36,16 @@ kvbm-soak:
 trace-smoke:
 	$(PYTEST) tests/test_trace_smoke.py tests/test_tracing.py \
 		tests/test_trace_sampling.py
+
+# autoscaling gate (docs/autoscaling.md): the CLOSED loop — frontend +
+# fleet supervisor + SLA planner on live event-plane telemetry, driven
+# by the deterministic trafficgen replaying a diurnal day over real
+# HTTP. Passes only if the planner scales the mock fleet up on the ramp
+# AND back down after, the TTFT/ITL SLOs never fast-burn after warmup,
+# and every non-abandoned stream completes token-identical to an
+# unscaled reference replay. Includes the slow-marked soak.
+autoscale-smoke:
+	$(PYTEST) tests/test_autoscale_loop.py
 
 # fleet telemetry gate (docs/observability.md "Fleet view"/"SLOs"):
 # event-plane MetricsSnapshot merge math, worker+frontend publishing

@@ -967,9 +967,67 @@ async def phase_quant():
     return out
 
 
+async def phase_traffic():
+    """Serving-path latency under a seeded open-loop workload: a mock
+    fleet (2 decode workers) + the real OpenAI frontend, driven by the
+    trafficgen replayer over real HTTP. Chip-free — the number is the
+    frontend/router/SSE overhead envelope (client-observed TTFT/ITL),
+    measured under the same bursty arrivals + mid-stream abandons the
+    autoscale gate uses, so serving-path regressions show up here even
+    when device tok/s is flat."""
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.trafficgen.runner import replay, summarize_results
+    from dynamo_tpu.trafficgen.schedule import TrafficConfig, build_schedule
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="bench", component="backend",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin")
+    engines, handles = [], []
+    for wid in (1, 2):
+        ev, ms = wire_engine_events(rt, card)
+        eng = MockEngine(MockEngineConfig(
+            block_size=card.kv_block_size, worker_id=wid, speedup=20.0),
+            event_sink=ev, metrics_sink=ms)
+        engines.append(eng)
+        handles.append(await serve_engine(rt, eng, card, instance_id=wid))
+    fe = await start_frontend(rt, port=0)
+    for _ in range(200):
+        if fe.manager.model_names():
+            break
+        await asyncio.sleep(0.05)
+    cfg = TrafficConfig(pattern="bursty", duration_s=8.0, base_rps=4.0,
+                        burst_rps=20.0, seed=11, isl_mean=24, osl_mean=12,
+                        prefix_fraction=0.3, abandon_fraction=0.1)
+    schedule = build_schedule(cfg)
+    results = await replay(fe.url, "mock-model", schedule, cfg)
+    summary = summarize_results(results)
+    await fe.stop()
+    for h in handles:
+        await h.stop()
+    for e in engines:
+        await e.close()
+    await rt.close()
+    out = {"workload": "bursty seed=11 8s", "replicas": 2}
+    out.update(summary)
+    if summary["errors"]:
+        out["error"] = f"{summary['errors']} replay errors: " \
+                       f"{summary['error_samples']}"
+    return out
+
+
 PHASES = {"short": phase_short, "wide": phase_wide, "long": phase_long,
           "ckpt": phase_ckpt, "kv": phase_kv, "disagg": phase_disagg,
-          "quant": phase_quant}
+          "quant": phase_quant, "traffic": phase_traffic}
 
 _MARK = "BENCH_PHASE_JSON: "
 
@@ -1079,7 +1137,8 @@ def main():
                       os.environ.get("DYN_BENCH_SKIP", "").split(",")))
     out = {"metric": "engine_output_tokens_per_sec_per_chip",
            "unit": "tok/s/chip"}
-    if set(PHASES) - skip:          # all-skipped runs never touch the chip
+    # traffic is chip-free; a traffic-only run needs no device preflight
+    if set(PHASES) - skip - {"traffic"}:
         pf = _device_preflight()
         if pf is not None:
             # distinct SKIPPED record: a wedged relay is an outage, not a
@@ -1115,6 +1174,7 @@ def main():
                else {"kv_error": kv.get("error", "skipped")})
     out["disagg"] = run("disagg")
     out["quant"] = run("quant")
+    out["traffic"] = run("traffic")
     print(json.dumps(out), flush=True)
 
 

@@ -36,6 +36,13 @@ def add_runtime_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--stream-idle-timeout", type=float, default=None,
                    help="max silence between response frames before the "
                         "stream is declared dead and migrated")
+    p.add_argument("--stream-idle-adaptive-margin", type=float,
+                   default=None,
+                   help="derive the idle timeout from observed "
+                        "inter-token gaps (p99.9 x this margin) once "
+                        "enough samples exist; the static timeout stays "
+                        "the floor (0 = off; "
+                        "DYN_STREAM_IDLE_ADAPTIVE_MARGIN)")
     p.add_argument("--faults", default=None, metavar="SPEC",
                    help="deterministic fault-injection spec "
                         "(runtime/faults.py grammar); exported as "
@@ -71,6 +78,8 @@ def runtime_config_from_args(args: argparse.Namespace) -> RuntimeConfig:
         cfg.request_deadline = args.request_deadline
     if getattr(args, "stream_idle_timeout", None) is not None:
         cfg.stream_idle_timeout = args.stream_idle_timeout
+    if getattr(args, "stream_idle_adaptive_margin", None) is not None:
+        cfg.stream_idle_adaptive_margin = args.stream_idle_adaptive_margin
     if getattr(args, "telemetry_interval", None) is not None:
         cfg.telemetry_interval = args.telemetry_interval
     for slo_flag in ("slo_ttft", "slo_itl", "slo_target_ratio",

@@ -174,11 +174,29 @@ def chosen_logprob(logits: jax.Array, sampled: jax.Array) -> jax.Array:
     return jnp.take_along_axis(logp, sampled[:, None], axis=-1)[:, 0]
 
 
+def stable_topk_logprobs(logp: jax.Array, k: int) -> tuple[jax.Array,
+                                                           jax.Array]:
+    """((..., k) ids f32, (..., k) logprobs) with an index-stable
+    tie-break: the selection key is logp quantized to bf16, which
+    collapses sub-bf16 numeric noise (the spread two separately-compiled
+    bursts can legitimately disagree by) into EXACT ties, and XLA's
+    top_k breaks exact ties by lowest index. So two near-tied
+    ALTERNATIVES can never swap order across compilations, while the
+    reported logprobs stay the exact f32 values."""
+    key = logp.astype(jnp.bfloat16).astype(jnp.float32)
+    _, ids = jax.lax.top_k(key, k)
+    vals = jnp.take_along_axis(logp, ids, axis=-1)
+    return ids.astype(jnp.float32), vals
+
+
 def topk_logprobs(logits: jax.Array, k: int) -> tuple[jax.Array,
                                                       jax.Array]:
     """((B, k) ids f32, (B, k) logprobs) of the k most likely tokens —
     same log_softmax semantics as chosen_logprob (pre-sampling-filter
-    logits, matching OpenAI's 'model distribution' contract)."""
+    logits, matching OpenAI's 'model distribution' contract). Exact-f32
+    ordering: the OpenAI response promises values sorted descending, so
+    this path must NOT quantize its selection key (see
+    stable_topk_logprobs for the spec lane's index-stable variant)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     vals, ids = jax.lax.top_k(logp, k)
     return ids.astype(jnp.float32), vals

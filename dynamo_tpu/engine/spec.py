@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dynamo_tpu.engine.quant import qm
+from dynamo_tpu.engine.sampling import stable_topk_logprobs
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     _decode_once,
@@ -319,11 +320,13 @@ def spec_decode_multi_step(
             # same (possibly DFA-masked) target distribution the chosen
             # logprob uses; the engine slices the emitted prefix. Two
             # row-block writes, not 2*k scatters (trace size matters in
-            # this already-large fused kernel).
-            tk_vals, tk_ids = jax.lax.top_k(logp_all, topk_lp)
+            # this already-large fused kernel). stable_topk_logprobs
+            # keeps near-tie ordering identical across separately
+            # compiled bursts.
+            tk_ids, tk_vals = stable_topk_logprobs(logp_all, topk_lp)
             out = lax.dynamic_update_slice(
-                out, jnp.transpose(tk_ids, (2, 1, 0))[:, None]
-                .astype(jnp.float32), (3, it, 0, 0))
+                out, jnp.transpose(tk_ids, (2, 1, 0))[:, None],
+                (3, it, 0, 0))
             out = lax.dynamic_update_slice(
                 out, jnp.transpose(tk_vals, (2, 1, 0))[:, None],
                 (3 + topk_lp, it, 0, 0))

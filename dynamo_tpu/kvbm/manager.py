@@ -64,6 +64,13 @@ class KvbmConfig:
     # Blocks prefetched into the staged host buffer per waiting
     # request. 0 = no prefetch (admission reads the tiers directly).
     prefetch_blocks: int = 0
+    # Byte bound on evictions staged for background offload — block
+    # counts lie under long-context spikes (every block of a big model
+    # is megabytes), so this caps the HBM actually pinned against the
+    # queue. Tightens offload_queue_depth when both are set; 0 = block
+    # count only. Has no effect while offload_queue_depth is 0 (the
+    # pipeline itself is off).
+    offload_queue_bytes: int = 0
 
 
 @dataclass
@@ -108,6 +115,7 @@ class KvbmManager:
         # in blocks, not batches
         self._offload_q: deque = deque()
         self._offload_q_blocks = 0
+        self._block_nbytes_cached: Optional[int] = None
         self._offload_task: Optional[asyncio.Task] = None
         self._offload_wake: Optional[asyncio.Event] = None
         self._io_pool = None
@@ -159,6 +167,8 @@ class KvbmManager:
             "onboarded": self.stats.onboarded,
             "remote_onboarded": self.stats.remote_onboarded,
             "offload_queue_depth": self._offload_q_blocks,
+            "offload_queue_bytes":
+                self._offload_q_blocks * self._block_nbytes(),
             "offload_inline": self.stats.offload_inline,
             "prefetched": self.stats.prefetched,
             "prefetch_hits": self.stats.prefetch_hits,
@@ -200,7 +210,7 @@ class KvbmManager:
         batch = [(pid, h) for pid, h in batch if not self.store.contains(h)]
         if not batch:
             return
-        depth = self.config.offload_queue_depth
+        depth = self._effective_queue_depth()
         if depth > 0 and not self._closed:
             try:
                 asyncio.get_running_loop()
@@ -220,6 +230,35 @@ class KvbmManager:
                 # against a queue that isn't draining
                 self.stats.offload_inline += len(batch)
         self._offload_inline(batch)
+
+    def _block_nbytes(self) -> int:
+        """Bytes one tier block occupies — constant per model, so the
+        byte cap reduces to a derived block bound. Dtype comes from the
+        live device cache when present (quantized caches shrink blocks),
+        else bf16's 2 bytes."""
+        if self._block_nbytes_cached is None:
+            itemsize = 2
+            cache = getattr(self.engine, "k_cache", None)
+            try:
+                if cache:
+                    itemsize = cache[0].dtype.itemsize
+            except Exception:
+                pass
+            n = itemsize
+            for dim in self.block_shape():
+                n *= dim
+            self._block_nbytes_cached = n
+        return self._block_nbytes_cached
+
+    def _effective_queue_depth(self) -> int:
+        """Staging bound in blocks after applying the byte cap. The
+        byte cap only ever tightens an enabled queue: depth=0 keeps the
+        pipeline off regardless (knobs-off stays byte-for-byte)."""
+        depth = self.config.offload_queue_depth
+        cap_bytes = self.config.offload_queue_bytes
+        if depth <= 0 or cap_bytes <= 0:
+            return depth
+        return min(depth, cap_bytes // self._block_nbytes())
 
     def _offload_inline(self, batch: list[tuple[int, int]]) -> None:
         page_ids = [pid for pid, _ in batch]

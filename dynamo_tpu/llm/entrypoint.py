@@ -312,6 +312,7 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
                      kvbm_offload_queue: int = 0,
                      kvbm_offload_workers: int = 0,
                      kvbm_prefetch_blocks: int = 0,
+                     kvbm_offload_queue_bytes: int = 0,
                      quantize: Optional[str] = None,
                      draft_model: Optional[str] = None, spec_gamma: int = 4,
                      spec_iters_per_sync: int = 8, sp_degree: int = 0,
@@ -438,7 +439,8 @@ def build_tpu_engine(model: str, served_name: Optional[str] = None, *,
             host_blocks=kvbm_host_blocks,
             offload_queue_depth=kvbm_offload_queue,
             offload_workers=kvbm_offload_workers,
-            prefetch_blocks=kvbm_prefetch_blocks))
+            prefetch_blocks=kvbm_prefetch_blocks,
+            offload_queue_bytes=kvbm_offload_queue_bytes))
     # a checkpoint without tokenizer files (weight-only export, random-
     # init benchmarking) must not publish a card the frontend can't build
     has_tok = any(os.path.exists(os.path.join(path, f)) for f in

@@ -530,12 +530,20 @@ class HttpService:
             if not resp.prepared:
                 return self._error(endpoint, e)
             await resp.write(sse_encode(e.body()))
-        except (ConnectionResetError, asyncio.CancelledError):
+        except asyncio.CancelledError:
             # client went away: cancel downstream work (disconnect.rs)
             ctx.cancel()
             rec["status"] = "disconnect"
             self._req_counter.inc(endpoint=endpoint, status="disconnect")
             raise
+        except ConnectionResetError:
+            # same, but via a write on the dead transport; a disconnect
+            # is normal client behavior (abandon waves), not a server
+            # error — don't re-raise into aiohttp's error logger
+            ctx.cancel()
+            rec["status"] = "disconnect"
+            self._req_counter.inc(endpoint=endpoint, status="disconnect")
+            return resp
         finally:
             self._duration.observe(time.perf_counter() - start)
         await resp.write_eof()

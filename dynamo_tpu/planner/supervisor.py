@@ -1,0 +1,393 @@
+"""FleetSupervisor: the consumer of `VirtualConnector` targets.
+
+Reference: `components/src/dynamo/planner/utils/virtual_connector.py`
+writes desired replica counts into the store for "an external process
+responsible for scaling" — this is that process. It closes the
+autoscaling loop (docs/autoscaling.md):
+
+    observe (TelemetrySource) → predict → size (planner_core)
+      → publish (VirtualConnector revision++) → **apply (here)**
+      → verify (SLO monitor + trafficgen gate)
+
+The supervisor watches `v1/planner/<ns>/target_replicas` via the store
+watch helper (`runtime/store.py watch_key`), de-dupes on the connector's
+monotonic revision (a restarted planner resumes, never resets — so a
+revision LOWER than the last applied one is stale noise, not a new
+target), and reconciles per-pool worker sets:
+
+- scale up: start workers — in-process MockEngine tasks by default
+  (`spawn_mode="task"`), or `python -m dynamo_tpu.worker` subprocesses
+  (`spawn_mode="subprocess"`, requires a TCP store); a custom
+  `engine_factory` serves anything with the engine contract, TpuEngine
+  included, config permitting.
+- scale down: drain gracefully — deregister the endpoint first (routers
+  stop picking the instance), wait for in-flight work to finish up to
+  `drain_grace_s`, then close the engine; anything still streaming is
+  replayed by Migration on a surviving instance, so scale-downs drop
+  zero streams.
+
+Fleet state rides both observability planes: gauges/counters in
+`runtime.metrics` (published to `/fleet/status` by a TelemetryPublisher
+when `telemetry_interval` > 0) and a `supervisor` block merged into the
+`_sys.stats` scrape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from dynamo_tpu.planner.connector import target_key
+from dynamo_tpu.runtime.store import DELETE, PUT, RESET, watch_key
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SupervisorConfig:
+    namespace: str = "dynamo"
+    model_name: str = "mock-model"
+    router_mode: str = "round_robin"
+    spawn_mode: str = "task"          # task | subprocess
+    max_replicas_per_pool: int = 16   # runaway-planner backstop
+    drain_grace_s: float = 2.0        # deregister → close wait
+    poll_interval: float = 0.0        # >0: bounded-poll watch fallback
+    # mock engine shape for task-mode workers
+    mock_speedup: float = 50.0
+    mock_total_blocks: int = 1024
+    mock_decode_ms: float = 4.0
+    mock_default_max_tokens: int = 16
+    # subprocess mode: extra args appended to every worker CLI
+    worker_extra_args: list = field(default_factory=list)
+
+
+@dataclass
+class _Worker:
+    instance_id: int
+    component: str
+    engine: object = None
+    handle: object = None
+    proc: object = None     # asyncio subprocess in subprocess mode
+    started_at: float = 0.0
+
+
+class FleetSupervisor:
+    """Watches planner targets and reconciles worker pools to match."""
+
+    def __init__(self, runtime, config: Optional[SupervisorConfig] = None,
+                 engine_factory: Optional[Callable] = None) -> None:
+        self.runtime = runtime
+        self.config = config or SupervisorConfig()
+        # (engine, card) factory for task-mode workers:
+        # f(supervisor, component, sub_component_type, instance_id)
+        self.engine_factory = engine_factory or self._mock_engine_factory
+        # pool key: (component, sub_component_type) from TargetReplica
+        self.pools: dict[tuple[str, str], list[_Worker]] = {}
+        self.applied_revision = 0
+        self.scale_events: list[dict] = []
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+        self._ids = itertools.count(1)
+        self._lock = asyncio.Lock()
+        self._closed = False
+        self.publisher = None
+        # fleet gauges on the process registry (→ /metrics and, via the
+        # telemetry publisher, /fleet/status)
+        m = runtime.metrics
+        self._g_replicas = m.gauge(
+            "supervisor_replicas",
+            "workers currently running per supervised pool")
+        self._g_revision = m.gauge(
+            "supervisor_applied_revision",
+            "last planner target revision applied")
+        self._c_events = m.counter(
+            "supervisor_scale_events_total",
+            "applied scale events by direction")
+        # merge fleet state into the `_sys.stats` scrape alongside the
+        # runtime's robustness counters
+        prev = runtime.transport_server.extra_stats
+
+        def _stats() -> dict:
+            out = prev() if prev is not None else {}
+            out["supervisor"] = self.fleet_state()
+            return out
+
+        runtime.transport_server.extra_stats = _stats
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "FleetSupervisor":
+        key = target_key(self.config.namespace)
+        self._watch = await watch_key(
+            self.runtime.store, key, replay=True,
+            poll_interval=self.config.poll_interval)
+        self._task = asyncio.get_running_loop().create_task(
+            self._watch_loop())
+        if self.runtime.config.telemetry_interval > 0:
+            from dynamo_tpu.runtime.telemetry import TelemetryPublisher
+
+            self.publisher = TelemetryPublisher(
+                self.runtime.events, self.runtime.metrics,
+                component="supervisor", instance=str(os.getpid()),
+                role="supervisor",
+                interval=self.runtime.config.telemetry_interval)
+            self.publisher.start()
+        return self
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._watch is not None:
+            self._watch.cancel()
+        if self._task is not None:
+            self._task.cancel()
+        if self.publisher is not None:
+            await self.publisher.stop()
+        async with self._lock:
+            for pool, workers in list(self.pools.items()):
+                while workers:
+                    await self._drain(pool, workers.pop())
+
+    # -- watch → reconcile --------------------------------------------------
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watch:
+                if ev.kind == RESET:
+                    # coordinator restarted: targets will replay; our
+                    # applied revision stays (connector revisions resume
+                    # from the replayed payload, not from zero)
+                    continue
+                if ev.kind == DELETE or ev.kind != PUT:
+                    continue
+                try:
+                    payload = json.loads(ev.value)
+                except ValueError:
+                    logger.warning("unparseable target payload at %s",
+                                   ev.key)
+                    continue
+                await self.apply(payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("supervisor watch loop died")
+
+    async def apply(self, payload: dict) -> bool:
+        """Reconcile pools to one target payload. Returns True if the
+        revision was new (applied), False if stale/duplicate."""
+        revision = int(payload.get("revision", 0))
+        if revision <= self.applied_revision:
+            return False
+        async with self._lock:
+            if self._closed or revision <= self.applied_revision:
+                return False
+            for t in payload.get("targets", []):
+                comp = t["component"]
+                sub = t.get("sub_component_type", "decode")
+                desired = max(0, min(int(t["desired_replicas"]),
+                                     self.config.max_replicas_per_pool))
+                await self._scale_pool((comp, sub), desired, revision)
+            self.applied_revision = revision
+            self._g_revision.set(revision)
+        return True
+
+    async def _scale_pool(self, pool: tuple[str, str], desired: int,
+                          revision: int) -> None:
+        workers = self.pools.setdefault(pool, [])
+        have = len(workers)
+        if desired == have:
+            return
+        comp, sub = pool
+        direction = "up" if desired > have else "down"
+        logger.info("supervisor: scaling %s/%s %d -> %d (revision %d)",
+                    comp, sub, have, desired, revision)
+        while len(workers) < desired:
+            workers.append(await self._spawn(comp, sub))
+        while len(workers) > desired:
+            # newest-first teardown keeps the longest-lived (warmest
+            # prefix caches) instances serving
+            await self._drain(pool, workers.pop())
+        self._g_replicas.set(len(workers), pool=f"{comp}/{sub}")
+        self._c_events.inc(direction=direction)
+        self.scale_events.append({
+            "at": time.time(), "pool": f"{comp}/{sub}",
+            "from": have, "to": desired, "revision": revision,
+            "direction": direction,
+        })
+
+    # -- worker spawn/drain -------------------------------------------------
+
+    def _mock_engine_factory(self, supervisor, component: str, sub: str,
+                             instance_id: int):
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+
+        cfg = self.config
+        card = ModelDeploymentCard(
+            name=cfg.model_name, namespace=cfg.namespace,
+            component=component, tokenizer_kind="word",
+            tokenizer_path=cfg.model_name, router_mode=cfg.router_mode)
+        from dynamo_tpu.llm.entrypoint import wire_engine_events
+
+        ev_sink, m_sink = wire_engine_events(self.runtime, card)
+        engine = MockEngine(
+            MockEngineConfig(
+                block_size=card.kv_block_size,
+                total_kv_blocks=cfg.mock_total_blocks,
+                speedup=cfg.mock_speedup,
+                decode_ms_per_iter=cfg.mock_decode_ms,
+                default_max_tokens=cfg.mock_default_max_tokens,
+                worker_id=instance_id),
+            event_sink=ev_sink, metrics_sink=m_sink)
+        return engine, card
+
+    async def _spawn(self, component: str, sub: str) -> _Worker:
+        instance_id = (os.getpid() << 16) | next(self._ids)
+        if self.config.spawn_mode == "subprocess":
+            return await self._spawn_subprocess(component, sub,
+                                                instance_id)
+        from dynamo_tpu.llm.entrypoint import serve_engine
+
+        engine, card = self.engine_factory(self, component, sub,
+                                           instance_id)
+        handle = await serve_engine(self.runtime, engine, card,
+                                    instance_id=instance_id)
+        return _Worker(instance_id=instance_id, component=component,
+                       engine=engine, handle=handle,
+                       started_at=time.time())
+
+    async def _spawn_subprocess(self, component: str, sub: str,
+                                instance_id: int) -> _Worker:
+        store_url = self.runtime.config.store_url
+        if not store_url.startswith("tcp://"):
+            raise RuntimeError(
+                "spawn_mode=subprocess needs a tcp:// store so child "
+                "workers can join the control plane")
+        import sys
+
+        comp_flag = component
+        args = [sys.executable, "-m", "dynamo_tpu.worker", "--mock",
+                "--store", store_url,
+                "--namespace", self.config.namespace,
+                "--served-model-name", self.config.model_name,
+                "--router-mode", self.config.router_mode,
+                "--instance-id", str(instance_id),
+                "--mock-speedup", str(self.config.mock_speedup),
+                "--mock-decode-ms", str(self.config.mock_decode_ms),
+                "--mock-total-blocks", str(self.config.mock_total_blocks)]
+        if sub == "prefill" and component.endswith("_prefill"):
+            comp_flag = component[:-len("_prefill")]
+            args += ["--is-prefill-worker"]
+        args += ["--component", comp_flag]
+        args += list(self.config.worker_extra_args)
+        proc = await asyncio.create_subprocess_exec(
+            *args, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT)
+        # wait for the worker's ready line so the pool count means
+        # "serving", not "forked"
+        while True:
+            line = await proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"worker subprocess exited before WORKER_READY "
+                    f"(rc={proc.returncode})")
+            if line.startswith(b"WORKER_READY"):
+                break
+        return _Worker(instance_id=instance_id, component=component,
+                       proc=proc, started_at=time.time())
+
+    async def _drain(self, pool: tuple[str, str], worker: _Worker) -> None:
+        """Graceful scale-down: deregister → drain → stop. A stream the
+        grace period cuts off raises the transport's stream-error on the
+        client side, which Migration replays on a surviving instance."""
+        if worker.proc is not None:
+            worker.proc.terminate()   # SIGTERM → run_until_signal drain
+            try:
+                await asyncio.wait_for(worker.proc.wait(),
+                                       self.config.drain_grace_s + 10.0)
+            except asyncio.TimeoutError:
+                worker.proc.kill()
+                await worker.proc.wait()
+            return
+        if worker.handle is not None:
+            await worker.handle.stop()   # deregister: routers move on
+        engine = worker.engine
+        deadline = time.monotonic() + self.config.drain_grace_s
+        while time.monotonic() < deadline:
+            running = getattr(engine, "_running", None)
+            waiting = getattr(engine, "_waiting", None)
+            if not running and not waiting:
+                break
+            await asyncio.sleep(0.01)
+        close = getattr(engine, "close", None)
+        if close is not None:
+            await close()
+
+    # -- state --------------------------------------------------------------
+
+    def fleet_state(self) -> dict:
+        return {
+            "applied_revision": self.applied_revision,
+            "pools": {f"{c}/{s}": [w.instance_id for w in ws]
+                      for (c, s), ws in self.pools.items()},
+            "scale_events": list(self.scale_events[-32:]),
+        }
+
+    def replicas(self, component: str, sub: str) -> int:
+        return len(self.pools.get((component, sub), []))
+
+
+def main(argv=None) -> None:
+    """`python -m dynamo_tpu.planner.supervisor` — run standalone."""
+    import argparse
+
+    from dynamo_tpu.cli_util import (
+        add_runtime_args,
+        run_until_signal,
+        runtime_config_from_args,
+        setup_logging,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.planner.supervisor",
+        description="fleet supervisor: applies planner replica targets")
+    add_runtime_args(p)
+    p.add_argument("--model-name", default="mock-model")
+    p.add_argument("--router-mode", default="round_robin",
+                   choices=["kv", "round_robin", "random"])
+    p.add_argument("--spawn-mode", default="task",
+                   choices=["task", "subprocess"])
+    p.add_argument("--max-replicas", type=int, default=16)
+    p.add_argument("--drain-grace", type=float, default=2.0)
+    p.add_argument("--mock-speedup", type=float, default=50.0)
+    args = p.parse_args(argv)
+    setup_logging(args.log_level)
+
+    async def start():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        rt = await DistributedRuntime.create(runtime_config_from_args(args))
+        sup = await FleetSupervisor(rt, SupervisorConfig(
+            namespace=args.namespace, model_name=args.model_name,
+            router_mode=args.router_mode, spawn_mode=args.spawn_mode,
+            max_replicas_per_pool=args.max_replicas,
+            drain_grace_s=args.drain_grace,
+            mock_speedup=args.mock_speedup)).start()
+        print("SUPERVISOR_READY", flush=True)
+        return rt, sup
+
+    async def stop(objs):
+        rt, sup = objs
+        await sup.stop()
+        await rt.close()
+
+    run_until_signal(start, shutdown=stop)
+
+
+if __name__ == "__main__":
+    main()

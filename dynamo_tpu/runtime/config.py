@@ -43,6 +43,13 @@ class RuntimeConfig:
     # Max silence between response frames before the stream is declared
     # dead (raises the Migration-retryable error); 0 = wait forever.
     stream_idle_timeout: float = 0.0
+    # Adaptive idle timeout (docs/robustness.md): > 0 derives the
+    # effective idle timeout from this process's observed inter-token
+    # gaps — p99.9 of the ITL histograms × this margin — once enough
+    # samples exist, replacing the hand-picked constant. The static
+    # stream_idle_timeout stays as the floor (and sole value before
+    # warmup). 0 = current behavior, byte-for-byte.
+    stream_idle_adaptive_margin: float = 0.0
     # Extra dial attempts on connection setup (jittered exp backoff).
     connect_retries: int = 2
     connect_backoff_base: float = 0.05
@@ -63,6 +70,10 @@ class RuntimeConfig:
     kvbm_offload_queue: int = 0
     kvbm_offload_workers: int = 0
     kvbm_prefetch_blocks: int = 0
+    # Byte bound on the staged offload queue (tightens the block bound
+    # when both are set; 0 = block count only). Block counts understate
+    # pinned HBM under long-context spikes.
+    kvbm_offload_queue_bytes: int = 0
     # Fleet telemetry plane (runtime/telemetry.py; docs/observability.md
     # "Fleet view"). Seconds between MetricsSnapshot publishes on the
     # `telemetry` event subject; 0 = off (no publisher task).

@@ -42,7 +42,10 @@ class DistributedRuntime:
             connect_retries=config.connect_retries,
             connect_backoff_base=config.connect_backoff_base,
             connect_backoff_max=config.connect_backoff_max,
-            connect_neg_cache=config.connect_neg_cache)
+            connect_neg_cache=config.connect_neg_cache,
+            idle_timeout_provider=(
+                self._adaptive_idle_timeout
+                if config.stream_idle_adaptive_margin > 0 else None))
         # process-wide per-instance circuit breaker: every PushRouter in
         # this process shares it, so one router's failures steer them all
         from dynamo_tpu.runtime.breaker import CircuitBreaker
@@ -99,6 +102,37 @@ class DistributedRuntime:
                     bus.publish(BREAKER_EVENTS_SUBJECT, payload))
         except Exception:
             logger.exception("breaker event publish failed")
+
+    # minimum inter-token-gap samples before the adaptive idle timeout
+    # engages — below this the percentile is noise and the hand-set
+    # static value (or "wait forever") stays in force
+    ADAPTIVE_IDLE_MIN_SAMPLES = 100
+
+    def _adaptive_idle_timeout(self) -> float:
+        """Derive the per-stream idle timeout from this process's
+        observed inter-token gaps (docs/robustness.md): p99.9 of the ITL
+        histogram × stream_idle_adaptive_margin. Prefers the engine's
+        histogram (the model actually served here); falls back to the
+        frontend's HTTP inter-token histogram. Returns 0.0 (defer to the
+        static knob) until enough samples exist."""
+        margin = self.config.stream_idle_adaptive_margin
+        if margin <= 0:
+            return 0.0
+        metrics = self.metrics._root._metrics
+        # (name, multiplier into seconds) — engine ITL is milliseconds
+        from dynamo_tpu.engine.metrics import ITL_HISTOGRAM
+
+        for name, scale in ((ITL_HISTOGRAM, 1e-3),
+                            ("dynamo_http_inter_token_latency_seconds",
+                             1.0)):
+            h = metrics.get(name)
+            if h is None or getattr(h, "count", 0) \
+                    < self.ADAPTIVE_IDLE_MIN_SAMPLES:
+                continue
+            gap = h.quantile(0.999) * scale
+            if gap > 0 and gap != float("inf"):
+                return gap * margin
+        return 0.0
 
     def _robustness_stats(self) -> dict:
         """Process-level failure-handling counters, merged into the

@@ -251,6 +251,77 @@ class MemoryStore(KeyValueStore):
         self._watches.clear()
 
 
+class _KeyWatch(Watch):
+    """Watch on one exact key, pumped from a prefix watch or a poll loop."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inner: Optional[Watch] = None
+        self._task: Optional[asyncio.Task] = None
+
+    def cancel(self) -> None:
+        if self._inner is not None:
+            self._inner.cancel()
+        if self._task is not None:
+            self._task.cancel()
+        super().cancel()
+
+
+async def watch_key(store: KeyValueStore, key: str, *, replay: bool = True,
+                    poll_interval: float = 0.0) -> Watch:
+    """Watch a single key. Events for other keys sharing the prefix are
+    filtered out; RESET passes through so consumers can clear derived
+    state on coordinator restart.
+
+    With `poll_interval > 0` the store's watch machinery is bypassed for a
+    bounded poll loop: `get(key)` every interval, synthesizing a PUT
+    whenever the revision advances (and a DELETE when the key vanishes).
+    The fallback is for stores/deployments where long-lived watch streams
+    are unreliable; the event contract is identical, minus intermediate
+    states the poll missed.
+    """
+    watch = _KeyWatch()
+
+    if poll_interval > 0:
+        async def _poll() -> None:
+            last_rev = -1
+            existed = False
+            if not replay:
+                kv0 = await store.get(key)
+                if kv0 is not None:
+                    last_rev, existed = kv0.revision, True
+            while not watch._cancelled:
+                try:
+                    kv = await store.get(key)
+                except ConnectionError:
+                    await asyncio.sleep(poll_interval)
+                    continue
+                if kv is not None and kv.revision != last_rev:
+                    last_rev, existed = kv.revision, True
+                    watch.queue.put_nowait(
+                        StoreEvent(PUT, key, kv.value, kv.revision))
+                elif kv is None and existed:
+                    existed = False
+                    watch.queue.put_nowait(StoreEvent(DELETE, key))
+                await asyncio.sleep(poll_interval)
+
+        watch._task = asyncio.get_running_loop().create_task(_poll())
+        return watch
+
+    inner = await store.watch_prefix(key, replay=replay)
+    watch._inner = inner
+
+    async def _pump() -> None:
+        async for ev in inner:
+            if ev.kind == RESET or ev.key == key:
+                watch.queue.put_nowait(ev)
+        if not watch._cancelled:
+            watch.queue.put_nowait(None)
+
+    watch._task = asyncio.get_running_loop().create_task(_pump())
+    return watch
+
+
 async def connect_store(url: str) -> KeyValueStore:
     """Open a store from a config URL: "memory" or "tcp://host:port"."""
     if url == "memory":

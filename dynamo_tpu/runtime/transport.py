@@ -389,7 +389,8 @@ class TransportClient:
                  connect_backoff_base: float = 0.05,
                  connect_backoff_max: float = 2.0,
                  connect_neg_cache: float = 0.25,
-                 fault_injector: Optional[FaultInjector] = None) -> None:
+                 fault_injector: Optional[FaultInjector] = None,
+                 idle_timeout_provider=None) -> None:
         self._conns: dict[str, _Connection] = {}
         self._rids = itertools.count(1)
         # Per-address locks: a black-holed host must not head-of-line-block
@@ -399,6 +400,11 @@ class TransportClient:
         # dial cycle; entries expire after connect_neg_cache seconds
         self._neg_cache: dict[str, tuple[float, str]] = {}
         self.idle_timeout = idle_timeout
+        # optional () -> float consulted per request when no per-call
+        # idle_timeout is given: lets the runtime derive the effective
+        # idle timeout from observed inter-token gaps (docs/robustness.md
+        # adaptive idle). Returning 0.0 defers to the static value.
+        self.idle_timeout_provider = idle_timeout_provider
         self.deadline = deadline
         self.connect_retries = connect_retries
         self.connect_backoff_base = connect_backoff_base
@@ -503,6 +509,15 @@ class TransportClient:
 
         ctx = context or Context()
         idle = self.idle_timeout if idle_timeout is None else idle_timeout
+        if idle_timeout is None and self.idle_timeout_provider is not None:
+            # adaptive idle: observed-gap-derived timeout, never tighter
+            # than the configured static floor
+            try:
+                derived = float(self.idle_timeout_provider() or 0.0)
+            except Exception:
+                derived = 0.0
+            if derived > 0:
+                idle = max(idle, derived)
         total = self.deadline if deadline is None else deadline
         loop = asyncio.get_running_loop()
         # ONE budget per request, not per attempt: the first call stamps

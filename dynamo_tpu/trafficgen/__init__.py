@@ -1,0 +1,32 @@
+"""Deterministic traffic generation + trace replay (docs/autoscaling.md).
+
+Two halves, split so the schedule is a pure artifact:
+
+- `schedule.py` — seeded, pure generation of an open-loop request
+  schedule (arrival process, ISL/OSL length model, shared-prefix chat
+  sessions, abandon flags). Same seed + config ⇒ byte-identical JSONL.
+- `runner.py` — replays a schedule against the OpenAI frontend over
+  real HTTP (SSE streaming reads, mid-stream abandons), recording
+  per-request TTFT/ITL/status into a replayable JSONL trace.
+
+`python -m dynamo_tpu.trafficgen` is the CLI; bench.py's `traffic`
+phase and `tests/test_autoscale_loop.py` drive the same code.
+"""
+
+from dynamo_tpu.trafficgen.schedule import (
+    ScheduledRequest,
+    TrafficConfig,
+    build_schedule,
+    prompt_text,
+    schedule_from_jsonl,
+    schedule_to_jsonl,
+)
+
+__all__ = [
+    "TrafficConfig",
+    "ScheduledRequest",
+    "build_schedule",
+    "prompt_text",
+    "schedule_to_jsonl",
+    "schedule_from_jsonl",
+]

@@ -1,0 +1,129 @@
+"""`python -m dynamo_tpu.trafficgen` — generate and replay traffic.
+
+Subcommands:
+
+- ``gen``: build a deterministic schedule and write it as JSONL
+  (stdout or --out). Same seed + flags ⇒ byte-identical output.
+- ``replay``: replay a schedule (--schedule file, or generate one from
+  the same pattern flags) against a frontend URL; per-request results
+  stream to --out as JSONL and a summary JSON prints to stdout.
+
+Examples:
+
+    python -m dynamo_tpu.trafficgen gen --pattern diurnal \\
+        --duration 60 --rps 4 --seed 7 --out diurnal.jsonl
+    python -m dynamo_tpu.trafficgen replay --url http://127.0.0.1:8080 \\
+        --model mock-model --schedule diurnal.jsonl --out results.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dynamo_tpu.trafficgen.runner import replay, summarize_results
+from dynamo_tpu.trafficgen.schedule import (
+    PATTERNS,
+    TrafficConfig,
+    build_schedule,
+    schedule_from_jsonl,
+    schedule_to_jsonl,
+    summarize,
+)
+
+
+def _add_pattern_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--pattern", default="poisson", choices=PATTERNS)
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="schedule length, seconds")
+    p.add_argument("--rps", type=float, default=2.0,
+                   help="base arrival rate, requests/second")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--diurnal-amplitude", type=float, default=0.8)
+    p.add_argument("--diurnal-period", type=float, default=10.0)
+    p.add_argument("--burst-rps", type=float, default=10.0)
+    p.add_argument("--burst-start-rate", type=float, default=0.05)
+    p.add_argument("--burst-stop-rate", type=float, default=0.3)
+    p.add_argument("--isl-mean", type=int, default=32)
+    p.add_argument("--isl-sigma", type=float, default=0.6)
+    p.add_argument("--isl-max", type=int, default=512)
+    p.add_argument("--osl-mean", type=int, default=16)
+    p.add_argument("--osl-sigma", type=float, default=0.5)
+    p.add_argument("--osl-max", type=int, default=128)
+    p.add_argument("--prefix-fraction", type=float, default=0.0)
+    p.add_argument("--num-prefixes", type=int, default=4)
+    p.add_argument("--prefix-len", type=int, default=64)
+    p.add_argument("--abandon-fraction", type=float, default=0.0)
+
+
+def _config_from_args(args: argparse.Namespace) -> TrafficConfig:
+    return TrafficConfig(
+        pattern=args.pattern, duration_s=args.duration,
+        base_rps=args.rps, seed=args.seed,
+        diurnal_amplitude=args.diurnal_amplitude,
+        diurnal_period_s=args.diurnal_period,
+        burst_rps=args.burst_rps,
+        burst_start_rate=args.burst_start_rate,
+        burst_stop_rate=args.burst_stop_rate,
+        isl_mean=args.isl_mean, isl_sigma=args.isl_sigma,
+        isl_max=args.isl_max,
+        osl_mean=args.osl_mean, osl_sigma=args.osl_sigma,
+        osl_max=args.osl_max,
+        prefix_fraction=args.prefix_fraction,
+        num_prefixes=args.num_prefixes, prefix_len=args.prefix_len,
+        abandon_fraction=args.abandon_fraction)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.trafficgen",
+        description="deterministic traffic generator + trace replayer")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("gen", help="generate a schedule JSONL")
+    _add_pattern_args(g)
+    g.add_argument("--out", default="", help="file (default stdout)")
+    r = sub.add_parser("replay", help="replay a schedule over HTTP")
+    _add_pattern_args(r)
+    r.add_argument("--url", required=True,
+                   help="frontend base url, e.g. http://127.0.0.1:8080")
+    r.add_argument("--model", required=True)
+    r.add_argument("--schedule", default="",
+                   help="schedule JSONL from `gen` (default: generate "
+                        "from the pattern flags)")
+    r.add_argument("--time-scale", type=float, default=1.0,
+                   help="compress the schedule clock (0.5 = 2x faster)")
+    r.add_argument("--out", default="",
+                   help="append per-request result JSONL here")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.cmd == "gen":
+        cfg = _config_from_args(args)
+        text = schedule_to_jsonl(cfg, build_schedule(cfg))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            print(json.dumps(summarize(build_schedule(cfg))))
+        else:
+            sys.stdout.write(text)
+        return 0
+    if args.schedule:
+        with open(args.schedule) as f:
+            cfg, schedule = schedule_from_jsonl(f.read())
+    else:
+        cfg = _config_from_args(args)
+        schedule = build_schedule(cfg)
+    results = asyncio.run(replay(
+        args.url, args.model, schedule, cfg,
+        time_scale=args.time_scale, out_path=args.out))
+    summary = summarize_results(results)
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if summary["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Open-loop schedule replay against the OpenAI frontend over real HTTP.
+
+Open-loop means arrivals follow the schedule's clock, never the
+server's: a slow fleet doesn't throttle the generator (that feedback is
+exactly what hides SLO violations in closed-loop load tests). Each
+request streams `/v1/chat/completions` over SSE, measures client-side
+TTFT/ITL, honors its abandon flag by closing the connection mid-stream,
+and lands in a replayable JSONL trace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import asdict, dataclass
+
+from dynamo_tpu.trafficgen.schedule import (
+    ScheduledRequest,
+    TrafficConfig,
+    prompt_text,
+)
+
+logger = logging.getLogger(__name__)
+
+STATUS_OK = "ok"
+STATUS_ABANDONED = "abandoned"
+
+
+@dataclass
+class RequestResult:
+    index: int
+    status: str              # ok | abandoned | error:<detail>
+    tokens: int = 0
+    ttft_s: float = 0.0
+    itl_mean_s: float = 0.0
+    itl_max_s: float = 0.0
+    duration_s: float = 0.0
+    sent_at: float = 0.0     # offset from replay start (schedule clock)
+    text: str = ""           # concatenated deltas (token-identity gate)
+    finish_reason: str = ""
+
+    @property
+    def completed(self) -> bool:
+        return self.status == STATUS_OK
+
+
+async def _replay_one(session, url: str, model: str,
+                      req: ScheduledRequest, cfg: TrafficConfig,
+                      t0: float) -> RequestResult:
+    res = RequestResult(index=req.index, status="error:unsent",
+                        sent_at=round(time.monotonic() - t0, 6))
+    body = {
+        "model": model,
+        "stream": True,
+        "max_tokens": req.osl,
+        "messages": [{"role": "user",
+                      "content": prompt_text(req, cfg)}],
+    }
+    start = time.monotonic()
+    last_token_at = None
+    itls: list[float] = []
+    parts: list[str] = []
+    try:
+        async with session.post(f"{url}/v1/chat/completions",
+                                json=body) as resp:
+            if resp.status != 200:
+                detail = (await resp.text())[:200]
+                res.status = f"error:http_{resp.status}:{detail}"
+                return res
+            async for raw in resp.content:
+                line = raw.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[len(b"data:"):].strip()
+                if data == b"[DONE]":
+                    break
+                try:
+                    chunk = json.loads(data)
+                except ValueError:
+                    continue
+                got_content = False
+                for choice in chunk.get("choices", ()):
+                    delta = choice.get("delta") or {}
+                    content = delta.get("content") or choice.get("text")
+                    if content:
+                        parts.append(content)
+                        got_content = True
+                    if choice.get("finish_reason"):
+                        res.finish_reason = choice["finish_reason"]
+                if got_content:
+                    now = time.monotonic()
+                    if res.tokens == 0:
+                        res.ttft_s = round(now - start, 6)
+                    elif last_token_at is not None:
+                        itls.append(now - last_token_at)
+                    last_token_at = now
+                    res.tokens += 1
+                    if req.abandon_after and \
+                            res.tokens >= req.abandon_after:
+                        # mid-stream client cancel: drop the connection
+                        # the way an impatient user closes the tab
+                        res.status = STATUS_ABANDONED
+                        return res
+            res.status = STATUS_OK
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:
+        res.status = f"error:{type(e).__name__}:{e}"[:200]
+    finally:
+        res.duration_s = round(time.monotonic() - start, 6)
+        res.text = "".join(parts)
+        if itls:
+            res.itl_mean_s = round(sum(itls) / len(itls), 6)
+            res.itl_max_s = round(max(itls), 6)
+    return res
+
+
+async def replay(url: str, model: str, schedule: list[ScheduledRequest],
+                 cfg: TrafficConfig, *, time_scale: float = 1.0,
+                 out_path: str = "") -> list[RequestResult]:
+    """Replay `schedule` against a frontend; returns per-request results
+    in schedule order. `time_scale` compresses the schedule clock (0.5 =
+    twice as fast) so tests replay long diurnal shapes in seconds.
+    `out_path` appends one JSON line per result (a replayable trace)."""
+    import aiohttp
+
+    results: list[RequestResult] = [None] * len(schedule)  # type: ignore
+    t0 = time.monotonic()
+    tasks: list[asyncio.Task] = []
+    conn = aiohttp.TCPConnector(limit=0)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        for req in schedule:
+            delay = req.at * time_scale - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+
+            async def _run(req=req):
+                results[req.index] = await _replay_one(
+                    session, url, model, req, cfg, t0)
+
+            tasks.append(asyncio.get_running_loop().create_task(_run()))
+        if tasks:
+            await asyncio.gather(*tasks)
+    if out_path:
+        with open(out_path, "a") as f:
+            for r in results:
+                f.write(json.dumps(asdict(r), sort_keys=True) + "\n")
+    return results
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_results(results: list[RequestResult]) -> dict:
+    """Aggregate view of one replay (CLI output + bench record)."""
+    done = [r for r in results if r is not None]
+    ok = [r for r in done if r.status == STATUS_OK]
+    abandoned = [r for r in done if r.status == STATUS_ABANDONED]
+    errors = [r for r in done if r.status.startswith("error")]
+    ttfts = sorted(r.ttft_s for r in ok if r.ttft_s > 0)
+    itls = sorted(r.itl_mean_s for r in ok if r.itl_mean_s > 0)
+    return {
+        "requests": len(done),
+        "ok": len(ok),
+        "abandoned": len(abandoned),
+        "errors": len(errors),
+        "error_samples": [r.status for r in errors[:5]],
+        "tokens": sum(r.tokens for r in done),
+        "ttft_p50_s": round(_percentile(ttfts, 0.50), 6),
+        "ttft_p99_s": round(_percentile(ttfts, 0.99), 6),
+        "itl_mean_p50_s": round(_percentile(itls, 0.50), 6),
+        "itl_mean_p99_s": round(_percentile(itls, 0.99), 6),
+    }

@@ -1,0 +1,212 @@
+"""Seeded open-loop traffic schedules (docs/autoscaling.md).
+
+Everything here is PURE: `build_schedule(cfg)` touches no clock, no
+network, no global RNG — one `random.Random(cfg.seed)` drives every
+draw in a fixed order, so the same (seed, config) always yields the
+same schedule, and `schedule_to_jsonl` rounds floats before writing so
+the serialized artifact is byte-identical across runs and platforms
+(the determinism gate in tests/test_autoscale_loop.py pins this).
+
+Arrival processes (millions-of-users shapes, ROADMAP autoscaling item):
+
+- ``constant``  — fixed inter-arrival 1/rps.
+- ``poisson``   — homogeneous Poisson at base_rps.
+- ``diurnal``   — nonhomogeneous Poisson, sinusoidal rate
+  base·(1 + amp·sin(2πt/period)), sampled by thinning.
+- ``bursty``    — two-state Markov-modulated Poisson: calm at base_rps,
+  storms at burst_rps, exponential state holding times.
+
+Length model: lognormal ISL/OSL (heavy tail — a few huge prompts amid
+many small ones, which is what makes block-count KVBM bounds lie).
+Prefix-heavy chat sessions share one of `num_prefixes` long system
+prompts; abandon flags mark requests the client will cancel mid-stream.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from dataclasses import asdict, dataclass, fields
+
+PATTERNS = ("constant", "poisson", "diurnal", "bursty")
+
+SCHEDULE_VERSION = 1
+
+
+@dataclass
+class TrafficConfig:
+    pattern: str = "poisson"
+    duration_s: float = 10.0
+    base_rps: float = 2.0
+    seed: int = 0
+    # diurnal sinusoid
+    diurnal_amplitude: float = 0.8
+    diurnal_period_s: float = 10.0
+    # bursty MMPP (per-second transition rates between calm and storm)
+    burst_rps: float = 10.0
+    burst_start_rate: float = 0.05
+    burst_stop_rate: float = 0.3
+    # lognormal length models, in word-tokenizer tokens
+    isl_mean: int = 32
+    isl_sigma: float = 0.6
+    isl_max: int = 512
+    osl_mean: int = 16
+    osl_sigma: float = 0.5
+    osl_max: int = 128
+    # prefix-heavy chat sessions sharing long system prompts
+    prefix_fraction: float = 0.0
+    num_prefixes: int = 4
+    prefix_len: int = 64
+    # client behaviors
+    abandon_fraction: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.pattern not in PATTERNS:
+            raise ValueError(
+                f"unknown pattern {self.pattern!r}; one of {PATTERNS}")
+
+
+@dataclass
+class ScheduledRequest:
+    index: int
+    at: float            # arrival offset from replay start, seconds
+    isl: int             # unique prompt tokens (prefix tokens extra)
+    osl: int             # max_tokens the client asks for
+    prefix_id: int = -1  # shared system-prompt id; -1 = none
+    abandon_after: int = 0  # cancel after this many tokens; 0 = read all
+
+    @property
+    def prompt_tokens(self) -> int:
+        return self.isl
+
+
+def _lognormal_int(rng: random.Random, mean: int, sigma: float,
+                   hi: int) -> int:
+    # parameterize so the MEDIAN is `mean` — the tail then stretches
+    # upward of it, which is the shape we want from "heavy-tail"
+    v = rng.lognormvariate(math.log(max(mean, 1)), sigma)
+    return max(1, min(int(v), hi))
+
+
+def _arrival_times(cfg: TrafficConfig, rng: random.Random) -> list[float]:
+    out: list[float] = []
+    t = 0.0
+    if cfg.pattern == "constant":
+        step = 1.0 / cfg.base_rps
+        t = step
+        while t <= cfg.duration_s:
+            out.append(t)
+            t += step
+        return out
+    if cfg.pattern == "poisson":
+        while True:
+            t += rng.expovariate(cfg.base_rps)
+            if t > cfg.duration_s:
+                return out
+            out.append(t)
+    if cfg.pattern == "diurnal":
+        # thinning against the rate ceiling; negative sinusoid troughs
+        # clamp to zero (dead-of-night silence)
+        lam_max = cfg.base_rps * (1.0 + abs(cfg.diurnal_amplitude))
+        while True:
+            t += rng.expovariate(lam_max)
+            if t > cfg.duration_s:
+                return out
+            lam = cfg.base_rps * (1.0 + cfg.diurnal_amplitude * math.sin(
+                2.0 * math.pi * t / cfg.diurnal_period_s))
+            if rng.random() < max(lam, 0.0) / lam_max:
+                out.append(t)
+    # bursty: race the next arrival against the next state flip
+    storm = False
+    while True:
+        rate = cfg.burst_rps if storm else cfg.base_rps
+        flip_rate = (cfg.burst_stop_rate if storm
+                     else cfg.burst_start_rate)
+        dt_arrival = rng.expovariate(rate)
+        dt_flip = (rng.expovariate(flip_rate) if flip_rate > 0
+                   else float("inf"))
+        if dt_flip < dt_arrival:
+            t += dt_flip
+            storm = not storm
+            if t > cfg.duration_s:
+                return out
+            continue
+        t += dt_arrival
+        if t > cfg.duration_s:
+            return out
+        out.append(t)
+
+
+def build_schedule(cfg: TrafficConfig) -> list[ScheduledRequest]:
+    """The full deterministic schedule for one replay run."""
+    rng = random.Random(cfg.seed)
+    reqs: list[ScheduledRequest] = []
+    for i, t in enumerate(_arrival_times(cfg, rng)):
+        isl = _lognormal_int(rng, cfg.isl_mean, cfg.isl_sigma, cfg.isl_max)
+        osl = _lognormal_int(rng, cfg.osl_mean, cfg.osl_sigma, cfg.osl_max)
+        prefix_id = -1
+        if cfg.prefix_fraction > 0 and rng.random() < cfg.prefix_fraction:
+            prefix_id = rng.randrange(max(cfg.num_prefixes, 1))
+        abandon_after = 0
+        if cfg.abandon_fraction > 0 and rng.random() < cfg.abandon_fraction:
+            abandon_after = rng.randint(1, max(osl // 2, 1))
+        reqs.append(ScheduledRequest(
+            index=i, at=round(t, 6), isl=isl, osl=osl,
+            prefix_id=prefix_id, abandon_after=abandon_after))
+    return reqs
+
+
+def prompt_text(req: ScheduledRequest, cfg: TrafficConfig) -> str:
+    """Deterministic prompt for a scheduled request under the "word"
+    tokenizer (one whitespace-separated word per token): the shared
+    system prefix (identical byte-for-byte across a session's requests,
+    so prefix caching engages) followed by `isl` request-unique words."""
+    words: list[str] = []
+    if req.prefix_id >= 0:
+        words.extend(f"sys{req.prefix_id}tok{j}"
+                     for j in range(cfg.prefix_len))
+    words.extend(f"u{req.index}w{j}" for j in range(req.isl))
+    return " ".join(words)
+
+
+def schedule_to_jsonl(cfg: TrafficConfig,
+                      reqs: list[ScheduledRequest]) -> str:
+    """Header line (version + config) then one line per request. Keys
+    are sorted and floats pre-rounded, so equal schedules serialize to
+    equal bytes — the replayable artifact IS the determinism witness."""
+    lines = [json.dumps({"version": SCHEDULE_VERSION,
+                         "config": asdict(cfg)}, sort_keys=True)]
+    lines.extend(json.dumps(asdict(r), sort_keys=True) for r in reqs)
+    return "\n".join(lines) + "\n"
+
+
+def schedule_from_jsonl(text: str) -> tuple[TrafficConfig,
+                                            list[ScheduledRequest]]:
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty schedule")
+    header = json.loads(lines[0])
+    if header.get("version") != SCHEDULE_VERSION:
+        raise ValueError(f"unsupported schedule version "
+                         f"{header.get('version')!r}")
+    known = {f.name for f in fields(TrafficConfig)}
+    cfg = TrafficConfig(**{k: v for k, v in header["config"].items()
+                           if k in known})
+    reqs = [ScheduledRequest(**json.loads(ln)) for ln in lines[1:]]
+    return cfg, reqs
+
+
+def summarize(reqs: list[ScheduledRequest]) -> dict:
+    """Shape summary for logs/CLI output (not part of the artifact)."""
+    if not reqs:
+        return {"requests": 0}
+    return {
+        "requests": len(reqs),
+        "duration_s": round(reqs[-1].at, 3),
+        "mean_rps": round(len(reqs) / max(reqs[-1].at, 1e-9), 3),
+        "isl_max": max(r.isl for r in reqs),
+        "osl_max": max(r.osl for r in reqs),
+        "with_prefix": sum(1 for r in reqs if r.prefix_id >= 0),
+        "abandons": sum(1 for r in reqs if r.abandon_after > 0),
+    }

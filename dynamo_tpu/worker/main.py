@@ -136,6 +136,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="blocks prefetched per waiting request into the "
                         "staged host buffer (default: "
                         "DYN_KVBM_PREFETCH_BLOCKS or 0 = off)")
+    p.add_argument("--kvbm-offload-queue-bytes", type=int, default=None,
+                   help="byte bound on the staged offload queue — "
+                        "tightens --kvbm-offload-queue when both are set "
+                        "(default: DYN_KVBM_OFFLOAD_QUEUE_BYTES or 0 = "
+                        "block count only)")
     # mocker knobs
     p.add_argument("--mock-speedup", type=float, default=1.0)
     p.add_argument("--mock-decode-ms", type=float, default=4.0)
@@ -227,6 +232,7 @@ def build_engine_and_card(args: argparse.Namespace, event_sink, metrics_sink,
         kvbm_offload_queue=args.kvbm_offload_queue or 0,
         kvbm_offload_workers=args.kvbm_offload_workers or 0,
         kvbm_prefetch_blocks=args.kvbm_prefetch_blocks or 0,
+        kvbm_offload_queue_bytes=args.kvbm_offload_queue_bytes or 0,
         quantize=args.quantize, draft_model=args.draft_model,
         spec_gamma=args.spec_gamma,
         spec_iters_per_sync=args.spec_iters_per_sync,
@@ -370,6 +376,8 @@ def main(argv=None) -> None:
             args.kvbm_offload_workers = cfg.kvbm_offload_workers
         if args.kvbm_prefetch_blocks is None:
             args.kvbm_prefetch_blocks = cfg.kvbm_prefetch_blocks
+        if args.kvbm_offload_queue_bytes is None:
+            args.kvbm_offload_queue_bytes = cfg.kvbm_offload_queue_bytes
         rt = await DistributedRuntime.create(cfg)
         if args.encode_worker:
             from dynamo_tpu.multimodal import (

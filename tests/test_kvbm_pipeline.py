@@ -252,3 +252,52 @@ async def test_soak_slow_offload_under_churn():
     finally:
         await eng.close()
     assert eng.pool.pending_offload_pages == 0
+
+
+async def test_offload_queue_byte_cap_tightens_depth():
+    """`kvbm_offload_queue_bytes` bounds staged-buffer MEMORY, not block
+    count: the effective queue depth is min(depth, bytes/block_nbytes),
+    so a byte budget sized for 2 blocks backpressures exactly like
+    depth=2 — pins bounded, overflow evictions go inline — while a
+    generous budget leaves the configured depth alone and 0 keeps
+    today's behavior byte-for-byte."""
+    inj = FaultInjector.from_spec("kind=offload_stall,times=1")
+    eng, mgr = make_engine(offload_queue_depth=16, injector=inj)
+    nbytes = mgr._block_nbytes()
+    assert nbytes > 0
+    await eng.close()
+
+    # budget for exactly 2 blocks tightens the 16-deep queue to 2
+    inj = FaultInjector.from_spec("kind=offload_stall,times=1")
+    eng, mgr = make_engine(offload_queue_depth=16,
+                           offload_queue_bytes=2 * nbytes + 1,
+                           injector=inj)
+    try:
+        assert mgr._effective_queue_depth() == 2
+        out1 = await collect(eng, req(list(range(1, 13))))
+        await churn(eng, bases=(50, 80, 110, 140, 170))
+        assert eng.pool.pending_offload_pages <= 2
+        assert mgr.stats.offload_inline > 0
+        assert mgr.pipeline_stats()["offload_queue_bytes"] <= 2 * nbytes
+        out2 = await collect(eng, req(list(range(1, 13))))
+        assert out2 == out1
+    finally:
+        await eng.close()
+
+    # generous budget: depth wins; zero budget: cap disengaged
+    eng, mgr = make_engine(offload_queue_depth=4,
+                           offload_queue_bytes=1000 * nbytes)
+    assert mgr._effective_queue_depth() == 4
+    await eng.close()
+    eng, mgr = make_engine(offload_queue_depth=4)
+    assert mgr._effective_queue_depth() == 4
+    await eng.close()
+    # bytes alone never switch the pipeline ON (depth=0 stays sync)
+    eng, mgr = make_engine(offload_queue_bytes=64 * nbytes)
+    try:
+        await collect(eng, req(list(range(1, 13))))
+        await churn(eng)
+        assert mgr._offload_task is None
+        assert not mgr._staged
+    finally:
+        await eng.close()

@@ -485,3 +485,58 @@ def test_holtwinters_short_series_falls_back():
         hw.add_data_point(v)
     # < 2 periods: linear-trend fallback, not a crash
     assert 50 <= hw.predict_next() <= 70
+
+
+# -- sizing math at the clamp edges (autoscale-loop satellite) ---------------
+
+
+def test_budget_exhausted_sizes_prefill_first():
+    """When demand overruns the chip budget, the clamp scales prefill
+    first and decode gets whatever chips REMAIN — never a proportional
+    share that would overshoot the budget."""
+    pl = make_planner(max_chip_budget=6)
+    # unclamped: prefill 1000*1000/10 / 10000 = 10 chips; decode
+    # 1000*20/10 = 2000 tok/s / 1000 per chip = 2 chips; total 12 > 6
+    num_p, num_d = pl.compute_replica_requirements(1000, 1000, 20)
+    assert num_p == 5                  # round(10 * 6/12)
+    assert num_d == 1                  # budget - prefill, floored
+    assert num_p + num_d <= 6
+
+
+def test_budget_exhausted_min_endpoint_floor_wins():
+    """min_endpoint outranks the budget clamp on BOTH pools (reference
+    semantics: a pool is never scaled to zero by the clamp)."""
+    pl = make_planner(max_chip_budget=3, min_endpoint=2)
+    num_p, num_d = pl.compute_replica_requirements(1000, 1000, 100)
+    assert num_p == 2 and num_d == 2   # floor holds even over budget
+
+
+async def test_invalid_interval_skips_adjustment():
+    """An interval with no (or NaN) traffic must produce NO adjustment:
+    make_adjustments returns None, targets stay untouched, and the
+    connector sees no new revision — the supervisor keeps the current
+    fleet instead of collapsing it on a telemetry gap."""
+
+    class Recorder:
+        def __init__(self):
+            self.calls = 0
+
+        async def set_component_replicas(self, targets):
+            self.calls += 1
+
+    rec = Recorder()
+    pl = make_planner(connector=rec)
+    # no observe yet: last_metrics is all-NaN
+    assert await pl.make_adjustments() is None
+    # zero-request interval is invalid too (is_valid needs num_req > 0)
+    pl.last_metrics = IntervalMetrics(0, 100, 10, 0.1, 0.01, 1.0)
+    assert await pl.make_adjustments() is None
+    assert rec.calls == 0
+    assert pl.last_targets == (0, 0)
+    # a valid interval immediately resumes publishing
+    pl.last_metrics = IntervalMetrics(100, 1000, 100, 0.1, 0.01, 1.0)
+    pl.num_req_predictor.add_data_point(100)
+    pl.isl_predictor.add_data_point(1000)
+    pl.osl_predictor.add_data_point(100)
+    assert await pl.make_adjustments() == (1, 1)
+    assert rec.calls == 1

@@ -170,3 +170,50 @@ async def test_client_close_does_not_reconnect():
     await asyncio.sleep(0.3)
     assert c._reconnect_task is None
     await server.stop()
+
+
+# -- watch_key: single-key watch helper (the fleet supervisor's feed) --------
+
+
+async def test_watch_key_filters_to_exact_key():
+    from dynamo_tpu.runtime.store import watch_key
+
+    s = MemoryStore()
+    await s.put("v1/planner/ns/target_replicas", b"r1")
+    await s.put("v1/planner/ns/target_replicas_shadow", b"nope")
+    w = await watch_key(s, "v1/planner/ns/target_replicas")
+    ev = await asyncio.wait_for(w.__anext__(), 1)   # replayed current
+    assert (ev.kind, ev.key, ev.value) == (
+        PUT, "v1/planner/ns/target_replicas", b"r1")
+    # sibling keys sharing the prefix never leak through
+    await s.put("v1/planner/ns/target_replicas_shadow", b"still nope")
+    await s.put("v1/planner/ns/target_replicas", b"r2")
+    ev = await asyncio.wait_for(w.__anext__(), 1)
+    assert ev.value == b"r2"
+    await s.delete("v1/planner/ns/target_replicas")
+    ev = await asyncio.wait_for(w.__anext__(), 1)
+    assert ev.kind == DELETE
+    w.cancel()
+
+
+async def test_watch_key_no_replay_and_poll_mode():
+    from dynamo_tpu.runtime.store import watch_key
+
+    s = MemoryStore()
+    await s.put("k", b"old")
+    w = await watch_key(s, "k", replay=False)
+    await s.put("k", b"new")
+    ev = await asyncio.wait_for(w.__anext__(), 1)
+    assert ev.value == b"new"        # pre-existing state suppressed
+    w.cancel()
+    # bounded-poll fallback observes the same put/delete sequence
+    wp = await watch_key(s, "k", replay=True, poll_interval=0.02)
+    ev = await asyncio.wait_for(wp.__anext__(), 1)
+    assert (ev.kind, ev.value) == (PUT, b"new")
+    await s.put("k", b"newer")
+    ev = await asyncio.wait_for(wp.__anext__(), 1)
+    assert (ev.kind, ev.value) == (PUT, b"newer")
+    await s.delete("k")
+    ev = await asyncio.wait_for(wp.__anext__(), 1)
+    assert ev.kind == DELETE
+    wp.cancel()

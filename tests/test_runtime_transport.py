@@ -152,3 +152,93 @@ async def test_server_death_surfaces_stream_err():
         await client.close()
     assert len(got) >= 3
     assert err == STREAM_ERR_MSG
+
+
+async def slow_then_fast(request, context):
+    yield {"i": 0}
+    await asyncio.sleep(request["stall_s"])
+    yield {"i": 1}
+
+
+async def test_adaptive_idle_provider_widens_static_timeout():
+    """An idle_timeout_provider derived from observed gaps must WIDEN a
+    too-tight static timeout (max of the two; the static knob stays the
+    floor), engage only when no per-call override is given, and a dead
+    provider must never break the request path."""
+    import pytest
+
+    server = TransportServer()
+    server.register("s.c.slow", FnEngine(slow_then_fast))
+    addr = await server.start()
+    # static 0.05s alone kills the 0.3s stall
+    tight = TransportClient(idle_timeout=0.05)
+    try:
+        with pytest.raises(ConnectionError):
+            _ = [x async for x in tight.request(
+                addr, "s.c.slow", {"stall_s": 0.3})]
+        assert tight.stats["idle_timeouts"] == 1
+    finally:
+        await tight.close()
+    # provider-derived 1.0s rescues it
+    adaptive = TransportClient(idle_timeout=0.05,
+                               idle_timeout_provider=lambda: 1.0)
+    try:
+        out = [x async for x in adaptive.request(
+            addr, "s.c.slow", {"stall_s": 0.3})]
+        assert [o["i"] for o in out] == [0, 1]
+        # an explicit per-call timeout outranks the provider
+        with pytest.raises(ConnectionError):
+            _ = [x async for x in adaptive.request(
+                addr, "s.c.slow", {"stall_s": 0.3}, idle_timeout=0.05)]
+    finally:
+        await adaptive.close()
+    # a provider that raises degrades to the static behavior
+    broken = TransportClient(
+        idle_timeout=0.0,
+        idle_timeout_provider=lambda: (_ for _ in ()).throw(ValueError()))
+    try:
+        out = [x async for x in broken.request(
+            addr, "s.c.slow", {"stall_s": 0.05})]
+        assert len(out) == 2
+    finally:
+        await broken.close()
+        await server.stop()
+
+
+async def test_runtime_adaptive_idle_from_observed_gaps():
+    """DistributedRuntime derives the adaptive idle timeout from the
+    engine ITL histogram's p99.9 x margin — but only once enough samples
+    exist (a cold histogram must not produce a garbage timeout), and
+    only when the margin knob is set (default stays today's behavior)."""
+    from dynamo_tpu.engine.metrics import ITL_HISTOGRAM
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(
+        store_url="memory", stream_idle_adaptive_margin=3.0))
+    try:
+        assert rt.transport_client.idle_timeout_provider is not None
+        assert rt._adaptive_idle_timeout() == 0.0   # no samples yet
+        # the engine pre-names its histograms and adopts them wholesale
+        # (EngineMetrics.register), so mirror that here
+        from dynamo_tpu.runtime.metrics import Histogram
+
+        h = Histogram(ITL_HISTOGRAM, "itl ms",
+                      buckets=[1.0, 4.0, 16.0, 64.0, 256.0])
+        rt.metrics.register(h)
+        for _ in range(rt.ADAPTIVE_IDLE_MIN_SAMPLES - 1):
+            h.observe(8.0)                          # milliseconds
+        assert rt._adaptive_idle_timeout() == 0.0   # below sample gate
+        h.observe(8.0)
+        derived = rt._adaptive_idle_timeout()
+        # p99.9 of ~8ms gaps, x3 margin, in SECONDS
+        assert 0.008 * 3 * 0.5 < derived < 0.2
+    finally:
+        await rt.close()
+    # margin unset (default): no provider is wired at all
+    rt0 = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    try:
+        assert rt0.transport_client.idle_timeout_provider is None
+        assert rt0._adaptive_idle_timeout() == 0.0
+    finally:
+        await rt0.close()

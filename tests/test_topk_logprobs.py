@@ -249,3 +249,54 @@ async def test_completion_pipeline_top_logprobs_dicts():
     for d in tops:
         assert isinstance(d, dict) and len(d) == 2
         assert all(isinstance(v, float) for v in d.values())
+
+
+# -- index-stable tie-break (engine/sampling.stable_topk_logprobs) ----------
+
+
+def test_stable_topk_breaks_bf16_ties_by_lowest_index():
+    """Regression: near-tied logits (equal after bf16 quantization but
+    differing by sub-bf16 float noise) must select deterministically by
+    LOWEST INDEX — the raw f32 jax.lax.top_k order flips between runs
+    and platforms when accumulation noise reorders such pairs — while
+    the REPORTED values stay the exact f32 logprobs, not the quantized
+    selection key."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import stable_topk_logprobs
+
+    eps = 1e-6                      # far below bf16 resolution at ~1.0
+    logp = jnp.zeros((1, 16), jnp.float32)
+    logp = logp.at[0, 10].set(1.0)          # tied pair, high index first
+    logp = logp.at[0, 3].set(1.0 + eps)     # ...but noisy f32 winner
+    logp = logp.at[0, 7].set(2.0)           # clear winner
+    ids, vals = stable_topk_logprobs(logp, 3)
+    assert ids[0].astype(int).tolist() == [7, 3, 10]
+    # exact f32 values survive (eps would vanish under bf16)
+    assert float(vals[0, 1]) == float(np.float32(1.0 + eps))
+    assert float(vals[0, 2]) == 1.0
+    # noise on the OTHER side must not flip the order either
+    logp2 = logp.at[0, 3].set(1.0 - eps)
+    ids2, _ = stable_topk_logprobs(logp2, 3)
+    assert ids2[0].astype(int).tolist() == [7, 3, 10]
+
+
+def test_stable_topk_matches_plain_topk_when_unambiguous():
+    """On well-separated logits the quantized key changes nothing: same
+    ids, same values as jax.lax.top_k — including on the spec lane's
+    (B, G, V) shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import stable_topk_logprobs
+
+    rng = np.random.default_rng(0)
+    # spread values far apart relative to bf16 resolution
+    logp = jnp.asarray(
+        rng.permuted(np.linspace(-20.0, 0.0, 2 * 3 * 32))
+        .reshape(2, 3, 32).astype(np.float32))
+    ids, vals = stable_topk_logprobs(logp, 4)
+    ref_vals, ref_ids = jax.lax.top_k(logp, 4)
+    assert np.array_equal(np.asarray(ids, dtype=np.int32),
+                          np.asarray(ref_ids))
+    assert np.array_equal(np.asarray(vals), np.asarray(ref_vals))

@@ -3,7 +3,8 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke
+.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke \
+	profile-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -54,3 +55,13 @@ autoscale-smoke:
 # transitions on the slo_events subject.
 fleet-smoke:
 	$(PYTEST) tests/test_telemetry.py tests/test_slo.py
+
+# step-profiler gate (docs/observability.md "Step profiler"): arm
+# DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the
+# ring back through GET /debug/profile + doctor profile, and assert
+# decode goodput equals tokens emitted and the padded share matches the
+# analytically-known _pow2 bucketing of the scripted batch mix; plus
+# the zero-cost off path (no recorder state, scheduler_stats unchanged)
+# and the Chrome trace-event round-trip.
+profile-smoke:
+	$(PYTEST) tests/test_step_profiler.py

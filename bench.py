@@ -482,6 +482,14 @@ async def _phase_long_body(cfg, eng):
             p1.get("admission_stall_ms", 0.0)
             - p0.get("admission_stall_ms", 0.0), 1),
     }
+    # attribution block (engine/profiler.py): present when the phase ran
+    # with DYN_STEP_PROFILE — the BENCH_*.json trajectory then carries
+    # goodput/padding/dispatch-gap alongside tok/s
+    from dynamo_tpu.engine.profiler import step_profile_summary
+
+    sp = step_profile_summary(eng)
+    if sp is not None:
+        out["step_profile"] = sp
     del params
     return out
 
@@ -1011,6 +1019,10 @@ async def phase_traffic():
     schedule = build_schedule(cfg)
     results = await replay(fe.url, "mock-model", schedule, cfg)
     summary = summarize_results(results)
+    from dynamo_tpu.engine.profiler import step_profile_summary
+
+    step_profiles = [sp for sp in (step_profile_summary(e)
+                                   for e in engines) if sp is not None]
     await fe.stop()
     for h in handles:
         await h.stop()
@@ -1019,6 +1031,21 @@ async def phase_traffic():
     await rt.close()
     out = {"workload": "bursty seed=11 8s", "replicas": 2}
     out.update(summary)
+    if step_profiles:
+        # fleet-level attribution: sum the per-engine token totals,
+        # average the gap (per-worker detail stays in /debug/profile)
+        good = sum(s["goodput_tokens"] for s in step_profiles)
+        padded = sum(s["padded_tokens"] for s in step_profiles)
+        work = good + padded
+        out["step_profile"] = {
+            "goodput_tokens": good,
+            "padded_tokens": padded,
+            "padded_pct": round(100.0 * padded / work, 3) if work
+            else 0.0,
+            "mean_dispatch_gap_s": round(
+                sum(s["mean_dispatch_gap_s"] for s in step_profiles)
+                / len(step_profiles), 6),
+        }
     if summary["errors"]:
         out["error"] = f"{summary['errors']} replay errors: " \
                        f"{summary['error_samples']}"
@@ -1043,6 +1070,12 @@ _DEFAULT_TIMEOUT_S = 1200.0
 def run_one_phase(name: str) -> None:
     """Child mode: run ONE phase against the chip, print its JSON."""
     _enable_compile_cache()
+    if name in ("long", "traffic"):
+        # arm the step flight recorder (engine/profiler.py) so these
+        # phases' records carry a step_profile attribution block
+        # (goodput, padded-token %, dispatch gap); the other phases keep
+        # the byte-identical unprofiled step loop
+        os.environ.setdefault("DYN_STEP_PROFILE", "1")
     try:
         result = asyncio.run(PHASES[name]())
     except Exception as e:
@@ -1087,43 +1120,14 @@ def _spawn_phase(name: str) -> dict:
 
 
 def _device_preflight(attempts: int = 2) -> Optional[str]:
-    """A cheap child that must init the backend and run a trivial op.
-    If the axon relay is wedged (`import jax` can hang at interpreter
-    start — observed after a client was SIGKILLed mid-device-op), every
-    phase child would hang to its full timeout; better to record the
-    outage once and fast. Retried once (same policy as the phases: one
-    transient tunnel drop must not record a broken round), and a hung
-    child gets SIGTERM + a grace period before SIGKILL — killing a
-    process mid-device-op is exactly what wedges the relay."""
-    import subprocess
-    import sys
+    """Shared with `python -m dynamo_tpu.doctor preflight`
+    (doctor/preflight.py owns the probe + wedge diagnosis); the bench
+    keeps its phase-timeout override."""
+    from dynamo_tpu.doctor.preflight import device_preflight
 
-    last = "device preflight never ran"
-    for _ in range(attempts):
-        proc = subprocess.Popen(
-            [sys.executable, "-c",
-             "import jax, numpy; "
-             "numpy.asarray(jax.numpy.ones(4) + 1); print('DEV_OK')"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        try:
-            out_s, err_s = proc.communicate(
-                timeout=_PHASE_TIMEOUT_S.get("preflight",
-                                             _DEFAULT_TIMEOUT_S))
-        except subprocess.TimeoutExpired:
-            proc.terminate()
-            try:
-                out_s, err_s = proc.communicate(timeout=30)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                out_s = err_s = ""
-            last = ("device preflight timed out (axon relay wedged? "
-                    "see docs/ROUND4_NOTES.md)")
-            continue
-        if "DEV_OK" in (out_s or ""):
-            return None
-        last = ("device preflight failed: "
-                f"{(err_s or out_s or '')[-200:]}")
-    return last
+    return device_preflight(
+        attempts,
+        _PHASE_TIMEOUT_S.get("preflight", _DEFAULT_TIMEOUT_S))
 
 
 def main():

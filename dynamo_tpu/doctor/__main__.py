@@ -88,6 +88,19 @@ def main(argv=None) -> int:
         from dynamo_tpu.doctor.fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "profile":
+        # `doctor profile <frontend-url|profile.json>` analyzes the
+        # step flight-recorder ring from /debug/profile
+        # (doctor/profile.py)
+        from dynamo_tpu.doctor.profile import main as profile_main
+
+        return profile_main(argv[1:])
+    if argv and argv[0] == "preflight":
+        # `doctor preflight` probes the device backend from a child
+        # process with wedge diagnosis (doctor/preflight.py)
+        from dynamo_tpu.doctor.preflight import main as preflight_main
+
+        return preflight_main(argv[1:])
     p = argparse.ArgumentParser(prog="python -m dynamo_tpu.doctor")
     p.add_argument("--store", default=None,
                    help="control-plane url to ping (tcp://host:port)")

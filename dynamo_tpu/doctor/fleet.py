@@ -55,6 +55,19 @@ def _ms(v) -> str:
         return str(v)
 
 
+def _fmt_goodput(gp: Optional[dict]) -> str:
+    """Step-profiler attribution (present only when a worker armed
+    DYN_STEP_PROFILE)."""
+    if not gp:
+        return ""
+    parts = [f"goodput={gp.get('goodput_tokens', 0):.0f}tok"]
+    rate = gp.get("goodput_tok_s")
+    if rate is not None:
+        parts.append(f"({rate:.1f}tok/s)")
+    parts.append(f"padded={gp.get('padded_pct', 0.0):.1f}%")
+    return "  " + " ".join(parts)
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -62,9 +75,11 @@ def render(status: dict) -> int:
         print(f"  [{c.get('role', '?'):<8}] {c.get('component', '?')}"
               f"/{c.get('instance', '?')} "
               f"(age {c.get('age_s', '?')}s): "
-              f"{_fmt_latency(c.get('latency') or {})}")
+              f"{_fmt_latency(c.get('latency') or {})}"
+              f"{_fmt_goodput(c.get('goodput'))}")
     fleet = status.get("fleet") or {}
-    print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}")
+    print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
+          f"{_fmt_goodput(fleet.get('goodput'))}")
     slo = status.get("slo")
     if slo:
         print("slo:")

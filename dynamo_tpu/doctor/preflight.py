@@ -1,0 +1,90 @@
+"""Device preflight: prove the accelerator backend can run a trivial op
+before anything expensive trusts it.
+
+Lifted from bench.py (which now imports it) so operators can run the
+same check standalone: `python -m dynamo_tpu.doctor preflight`. The
+failure mode it exists for: a wedged axon relay makes `import jax` hang
+at interpreter start (observed after a client was SIGKILLed
+mid-device-op — docs/ROUND4_NOTES.md), so every subsequent device
+process hangs to its full timeout. Better to diagnose the outage once,
+fast, with guidance.
+
+Discipline preserved from the bench version:
+  * the probe runs in a CHILD process — a wedged relay must not hang
+    the caller;
+  * retried (default twice): one transient tunnel drop must not record
+    a broken round;
+  * a hung child gets SIGTERM + a grace period before SIGKILL —
+    killing a process mid-device-op is exactly what wedges the relay.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional
+
+DEFAULT_TIMEOUT_S = 1200.0
+_GRACE_S = 30.0
+
+# the honest probe: backend init + one op + a host round-trip
+# (np.asarray, not block_until_ready — see docs/ROUND4_NOTES.md)
+_PROBE = ("import jax, numpy; "
+          "numpy.asarray(jax.numpy.ones(4) + 1); print('DEV_OK')")
+
+WEDGE_HINT = ("axon relay wedged? see docs/ROUND4_NOTES.md — a client "
+              "SIGKILLed mid-device-op leaves the relay unable to "
+              "serve new sessions; restart the relay/host before "
+              "retrying")
+
+
+def device_preflight(attempts: int = 2,
+                     timeout_s: float = DEFAULT_TIMEOUT_S
+                     ) -> Optional[str]:
+    """None when a child process can init the backend and round-trip a
+    trivial op; otherwise a diagnosis string (timeout → wedge guidance,
+    nonzero exit → the child's stderr tail)."""
+    last = "device preflight never ran"
+    for _ in range(max(1, attempts)):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            out_s, err_s = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                out_s, err_s = proc.communicate(timeout=_GRACE_S)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out_s = err_s = ""
+            last = f"device preflight timed out ({WEDGE_HINT})"
+            continue
+        if "DEV_OK" in (out_s or ""):
+            return None
+        last = ("device preflight failed: "
+                f"{(err_s or out_s or '')[-200:]}")
+    return last
+
+
+def main(argv: list[str]) -> int:
+    """`python -m dynamo_tpu.doctor preflight [--attempts N]
+    [--timeout S]` — exit 0 healthy, 1 wedged/broken."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor preflight",
+        description="probe the accelerator backend from a child process")
+    p.add_argument("--attempts", type=int, default=2)
+    p.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT_S,
+                   help="seconds before a probe child is declared hung")
+    args = p.parse_args(argv)
+    t0 = time.perf_counter()
+    verdict = device_preflight(args.attempts, args.timeout)
+    dt = time.perf_counter() - t0
+    if verdict is None:
+        print(f"device preflight OK ({dt:.1f}s)")
+        return 0
+    print(f"device preflight FAILED ({dt:.1f}s): {verdict}")
+    return 1

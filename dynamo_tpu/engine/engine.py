@@ -33,6 +33,7 @@ import numpy as np
 
 from dynamo_tpu.engine.metrics import EngineMetrics
 from dynamo_tpu.engine.pages import PagePool
+from dynamo_tpu.engine.profiler import recorder_from_env
 from dynamo_tpu.engine.sampling import sample_tokens_lp
 from dynamo_tpu.llm.perf import itl_percentile
 from dynamo_tpu.models.llama import (
@@ -650,6 +651,11 @@ class TpuEngine:
         # ForwardPassMetrics prefill/decode queues) — here the split is
         # measured at the source.
         self.metrics = EngineMetrics()
+        # Step flight recorder (engine/profiler.py): None unless
+        # DYN_STEP_PROFILE is set — every hot-loop touch below is gated
+        # on `is not None`, so off means zero allocation and a
+        # byte-identical step loop.
+        self.step_recorder = recorder_from_env(self.metrics)
         # raw ITL samples (ms), capped FIFO — bench reads these for
         # exact percentiles; the wire carries only the histogram
         self.itl_samples: list[float] = []
@@ -1205,7 +1211,8 @@ class TpuEngine:
                 guided_mask)
         tk = (self.TOPK_WIDTH
               if any(s.wants_topk for s in pending) else 0)
-        with self.metrics.compile.track("sample_first", (width, tk)):
+        trk = self.metrics.compile.track("sample_first", (width, tk))
+        with trk:
             sampled = sample_tokens_lp(
                 logits_stack,
                 arr(lambda s: s.seed, np.uint32),
@@ -1216,6 +1223,12 @@ class TpuEngine:
                 arr(lambda s: s.req.sampling.min_p, np.float32),
                 topk_lp=tk)
             out = np.asarray(sampled)                 # ONE host sync
+        rec = self.step_recorder
+        if rec is not None:
+            rec.record("sample_first", trk.shape, trk.elapsed_s,
+                       good_tokens=len(pending), work_tokens=width,
+                       lanes=len(pending), width=width,
+                       tokens=len(pending), compiled=trk.compiled)
         return out, tk
 
     def _emit_first_tokens(self, pending: list[_Seq], packed: np.ndarray,
@@ -1450,6 +1463,18 @@ class TpuEngine:
         self.metrics.prefill_chunk.observe(trk.elapsed_s)
         self.metrics.mixed_steps.inc()
         self.metrics.decode_steps_during_prefill.inc(k_steps)
+        rec = self.step_recorder
+        if rec is not None:
+            # one dispatch doing both kinds of work: goodput = real
+            # chunk tokens + real decode lane-steps; work = the padded
+            # (bp x t_bucket) chunk block + the fixed-width burst
+            rec.record("mixed_step", trk.shape, trk.elapsed_s,
+                       good_tokens=(sum(chunk_lens)
+                                    + len(batch) * k_steps),
+                       work_tokens=bp * t_bucket + b * k_steps,
+                       lanes=len(picks) + len(batch),
+                       width=bp + b, tokens=len(batch) * k_steps,
+                       compiled=trk.compiled)
         self._mark_decode_compile(batch, trk)
         self._trace_chunk(picks, chunk_lens, trk, mixed=True)
         done_logits: dict[int, Any] = {}
@@ -1524,6 +1549,13 @@ class TpuEngine:
                 jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
                 cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
         self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        rec = self.step_recorder
+        if rec is not None:
+            rec.record("pp_prefill", trk.shape, trk.elapsed_s,
+                       good_tokens=sum(takes),
+                       work_tokens=b_pad * t_pad, lanes=len(picks),
+                       width=b_pad, compiled=trk.compiled,
+                       synced=False)
         self._trace_chunk(picks, takes, trk)
         done: dict[int, Any] = {}
         for i, s in enumerate(picks):
@@ -1697,6 +1729,15 @@ class TpuEngine:
                     (packed, self.k_cache, self.v_cache, self.dk_cache,
                      self.dv_cache) = \
                         await asyncio.to_thread(run_spec_burst)
+            rec = self.step_recorder
+            if rec is not None:
+                # good = real lanes' draft+verify positions; rejected
+                # proposals still count as computed work, acceptance is
+                # tracked separately in SpecDecodeStats
+                rec.record("spec_decode", trk.shape, trk.elapsed_s,
+                           good_tokens=len(batch) * k_steps,
+                           work_tokens=b * k_steps, lanes=len(batch),
+                           width=b, compiled=trk.compiled)
             self._mark_decode_compile(batch, trk)
             toks_out = packed[0].astype(np.int32)   # (S, gamma+1, B)
             lps_out = packed[1]                     # (S, gamma+1, B)
@@ -1797,6 +1838,13 @@ class TpuEngine:
                 with trk:
                     packed, self.k_cache, self.v_cache = \
                         await asyncio.to_thread(run_pp_burst)
+            rec = self.step_recorder
+            if rec is not None:
+                rec.record("pp_decode", trk.shape, trk.elapsed_s,
+                           good_tokens=len(batch) * k_steps,
+                           work_tokens=b * k_steps, lanes=len(batch),
+                           width=b, tokens=len(batch) * k_steps,
+                           compiled=trk.compiled)
             self._mark_decode_compile(batch, trk)
             self._emit_burst(batch, packed, k_steps, tk)
             return True
@@ -1825,6 +1873,17 @@ class TpuEngine:
                 with trk:
                     packed_dev, self.k_cache, self.v_cache = \
                         await asyncio.to_thread(dispatch)
+            rec = self.step_recorder
+            if rec is not None:
+                # pipelined: the dispatch returns without a host sync,
+                # so this is dispatch-only time (synced=False); the
+                # honest device wait records as `burst_sync` when
+                # _pipeline_consume pulls the results
+                rec.record("decode_burst", trk.shape, trk.elapsed_s,
+                           good_tokens=len(batch) * k_steps,
+                           work_tokens=b * k_steps, lanes=len(batch),
+                           width=b, tokens=len(batch) * k_steps,
+                           compiled=trk.compiled, synced=False)
             self._mark_decode_compile(batch, trk)
             self._inflight = {
                 "k": k_steps, "batch": batch, "packed": packed_dev,
@@ -1870,6 +1929,13 @@ class TpuEngine:
             with trk:
                 packed, self.k_cache, self.v_cache = \
                     await asyncio.to_thread(run_burst)
+        rec = self.step_recorder
+        if rec is not None:
+            rec.record(trk.entry, trk.shape, trk.elapsed_s,
+                       good_tokens=len(batch) * k_steps,
+                       work_tokens=b * k_steps, lanes=len(batch),
+                       width=b, tokens=len(batch) * k_steps,
+                       compiled=trk.compiled)
         self._mark_decode_compile(batch, trk)
         self._emit_burst(batch, packed, k_steps, tk)
         return True
@@ -2105,6 +2171,14 @@ class TpuEngine:
                 jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
                 model_cfg, aligned)
         self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        rec = self.step_recorder
+        if rec is not None:
+            # logits stay on device for the first-token sampler — no
+            # host sync here, so this is dispatch wall time only
+            rec.record(trk.entry, trk.shape, trk.elapsed_s,
+                       good_tokens=sum(chunk_lens),
+                       work_tokens=bp * t_bucket, lanes=len(active),
+                       width=bp, compiled=trk.compiled, synced=False)
         self._trace_chunk(active, chunk_lens, trk)
         done: dict[int, Any] = {}
         for i, s in enumerate(active):
@@ -2441,9 +2515,19 @@ class TpuEngine:
                         jax.numpy.asarray(inf["top_ks"]),
                         mcfg, k, topk_lp=inf.get("tk", 0))
 
+                rec = self.step_recorder
+                t_d2 = time.perf_counter() if rec is not None else 0.0
                 async with self._device_lock:
                     packed2, self.k_cache, self.v_cache = \
                         await asyncio.to_thread(dispatch2)
+                if rec is not None:
+                    rec.record("decode_burst",
+                               (b, k, inf.get("tk", 0)),
+                               time.perf_counter() - t_d2,
+                               good_tokens=len(batch) * k,
+                               work_tokens=b * k, lanes=len(batch),
+                               width=b, tokens=len(batch) * k,
+                               synced=False)
                 self.metrics.pipelined_bursts.inc()
                 nxt = {"k": k, "batch": batch, "packed": packed2,
                        "positions": inf["positions"] + k,
@@ -2452,7 +2536,16 @@ class TpuEngine:
                        "top_ps": inf["top_ps"],
                        "top_ks": inf["top_ks"],
                        "tk": inf.get("tk", 0), "deferred": []}
+        rec = self.step_recorder
+        t_sync = time.perf_counter() if rec is not None else 0.0
         packed = await asyncio.to_thread(np.asarray, inf["packed"])
+        if rec is not None:
+            # the honest device wait for a pipelined burst: np.asarray
+            # round-trip (block_until_ready lies — docs/ROUND4_NOTES.md);
+            # goodput was attributed at dispatch, this is pure timing
+            rec.record("burst_sync", (len(batch), k),
+                       time.perf_counter() - t_sync,
+                       lanes=len(batch), width=cfg.max_batch_size)
         # while the speculative burst runs, finished lanes' pages must
         # not return to the pool (the burst still writes to them)
         self._defer_releases = nxt["deferred"] if nxt is not None else None
@@ -2631,10 +2724,19 @@ class TpuEngine:
         lengths)."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
         with self._kv_buffer_lock:
-            with self.metrics.compile.track("gather_kv",
-                                            (len(page_ids),)):
+            trk = self.metrics.compile.track("gather_kv",
+                                             (len(page_ids),))
+            with trk:
                 out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
                 out.block_until_ready()
+        rec = self.step_recorder
+        if rec is not None:
+            # timing/gap attribution only (no token work); the gather
+            # stays device-resident, so block_until_ready is a lower
+            # bound here, not the honest round-trip
+            rec.record("gather_kv", trk.shape, trk.elapsed_s,
+                       lanes=len(page_ids), compiled=trk.compiled,
+                       synced=False)
         return out
 
     def _read_kv_pages_sync(self, page_ids: list[int]) -> np.ndarray:
@@ -2672,11 +2774,17 @@ class TpuEngine:
         see _write_kv_pages_jit."""
         ids = jax.numpy.asarray(np.asarray(page_ids, dtype=np.int32))
         with self._kv_buffer_lock:
-            with self.metrics.compile.track("write_kv",
-                                            (len(page_ids),)):
+            trk = self.metrics.compile.track("write_kv",
+                                             (len(page_ids),))
+            with trk:
                 self.k_cache, self.v_cache = _write_kv_pages_jit(
                     self.k_cache, self.v_cache, ids,
                     jax.numpy.asarray(data))
+        rec = self.step_recorder
+        if rec is not None:
+            rec.record("write_kv", trk.shape, trk.elapsed_s,
+                       lanes=len(page_ids), compiled=trk.compiled,
+                       synced=False)
 
     def take_transfer(self, transfer_id: str) -> tuple[list[int], int]:
         """(pages, prefill_len) for a pinned transfer; KeyError if unknown
@@ -2731,6 +2839,28 @@ class TpuEngine:
         if self.metrics_sink is None:
             return
         perf = self.perf     # ONE derived snapshot of self.metrics
+        sched_stats = {
+            "prefill_chunks": perf["prefill_chunks"],
+            "decode_steps_during_prefill":
+                perf["decode_steps_during_prefill"],
+            "mixed_steps": perf["mixed_steps"],
+            "itl_p50_ms": itl_percentile(perf["itl_hist"], 0.5),
+            "itl_p99_ms": itl_percentile(perf["itl_hist"], 0.99),
+            "admission_stall_ms":
+                round(perf["admission_stall_ms"], 3),
+            "compiles": self.metrics.compile.total,
+        }
+        rec = self.step_recorder
+        if rec is not None:
+            # extra keys ONLY when the recorder is armed — the unset-
+            # DYN_STEP_PROFILE payload stays byte-identical
+            s = rec.summary()
+            sched_stats["goodput_tokens"] = s["totals"]["good_tokens"]
+            sched_stats["padded_tokens"] = s["totals"]["padded_tokens"]
+            sched_stats["padded_pct"] = round(
+                s["totals"]["padded_pct"], 3)
+            sched_stats["dispatch_gap_mean_ms"] = round(
+                s["dispatch_gap"]["mean_s"] * 1e3, 4)
         self.metrics_sink(ForwardPassMetrics(
             worker_id=self.config.worker_id, dp_rank=self.config.dp_rank,
             worker_stats=WorkerStats(
@@ -2742,15 +2872,5 @@ class TpuEngine:
                 kv_total_blocks=self.pool.capacity,
                 hbm_cache_usage=self.pool.usage()),
             spec_decode_stats=self._spec_stats,
-            scheduler_stats={
-                "prefill_chunks": perf["prefill_chunks"],
-                "decode_steps_during_prefill":
-                    perf["decode_steps_during_prefill"],
-                "mixed_steps": perf["mixed_steps"],
-                "itl_p50_ms": itl_percentile(perf["itl_hist"], 0.5),
-                "itl_p99_ms": itl_percentile(perf["itl_hist"], 0.99),
-                "admission_stall_ms":
-                    round(perf["admission_stall_ms"], 3),
-                "compiles": self.metrics.compile.total,
-            },
+            scheduler_stats=sched_stats,
         ))

@@ -36,6 +36,12 @@ _STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 # agree on bucket meaning. The +Inf edge is implicit in Histogram.
 _ITL_BUCKETS_MS = tuple(e for e in ITL_BUCKET_EDGES_MS
                         if e != float("inf"))
+# Dispatch gaps are the host overhead BETWEEN jitted steps — almost
+# always sub-ms when the loop is healthy, so the buckets reach an order
+# of magnitude finer than the stage buckets.
+_GAP_BUCKETS = (0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+                0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                1.0)
 
 # Canonical histogram names, importable by telemetry consumers
 # (runtime/telemetry.py latency summaries, doctor fleet) so renames
@@ -99,6 +105,20 @@ class EngineMetrics:
         self.decode_steps_during_prefill = c(
             "dynamo_engine_decode_steps_during_prefill_total",
             "decode steps interleaved while requests were prefilling")
+        # Step-profiler attribution (engine/profiler.py). Constructed
+        # unconditionally so names are stable in /metrics and telemetry
+        # snapshots; they only move when DYN_STEP_PROFILE arms the
+        # StepRecorder, so the off path stays write-free.
+        self.goodput_tokens = c(
+            "dynamo_engine_goodput_tokens_total",
+            "real token-positions computed per jitted entry (no padding)")
+        self.padded_tokens = c(
+            "dynamo_engine_padded_tokens_total",
+            "padded token-positions wasted per jitted entry")
+        self.dispatch_gap = h(
+            "dynamo_engine_dispatch_gap_seconds",
+            "host gap between consecutive jitted dispatches",
+            _GAP_BUCKETS)
         self.compile = CompileTracker()
 
     def register(self, registry: MetricsRegistry) -> None:
@@ -110,7 +130,9 @@ class EngineMetrics:
                   self.decode_seconds, self.tokens_emitted,
                   self.prefill_emitted, self.prefill_new_tokens,
                   self.pipelined_bursts, self.mixed_steps,
-                  self.decode_steps_during_prefill):
+                  self.decode_steps_during_prefill,
+                  self.goodput_tokens, self.padded_tokens,
+                  self.dispatch_gap):
             registry.register(m)
         self.compile.register(registry)
 

@@ -160,6 +160,10 @@ async def start_frontend(runtime: DistributedRuntime,
         slo_task = _asyncio.get_running_loop().create_task(_slo_loop())
     http.fleet_status_provider = \
         lambda: collector.fleet_status(slo=slo)
+    # /debug/profile reads whatever engines serve_engine registered on
+    # this runtime (late-bound: workers may start after the frontend)
+    http.profile_engines = \
+        lambda: list(getattr(runtime, "profile_engines", []))
     publisher = None
     if cfg.telemetry_interval > 0:
         publisher = TelemetryPublisher(
@@ -207,10 +211,19 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
     # same EngineMetrics objects — no second bookkeeping path). Disagg
     # workers serve a handler wrapping the engine — unwrap one level.
     em = getattr(engine, "metrics", None)
+    core = engine
     if em is None:
-        em = getattr(getattr(engine, "engine", None), "metrics", None)
+        core = getattr(engine, "engine", None)
+        em = getattr(core, "metrics", None)
     if em is not None and hasattr(em, "register"):
         em.register(runtime.metrics)
+    # step-profiler surface: in-proc deployments (run/main.py, bench,
+    # tests) share ONE runtime between workers and frontend, so listing
+    # served engines here lets /debug/profile reach their StepRecorders
+    if core is not None and hasattr(core, "step_recorder"):
+        if not hasattr(runtime, "profile_engines"):
+            runtime.profile_engines = []
+        runtime.profile_engines.append(core)
     # one-token greedy canary (vllm health_check.py builds the same shape);
     # only probed when the runtime's health manager is enabled + idle.
     # The extra.canary marker lets sinks/metrics tell probes from traffic.

@@ -117,6 +117,7 @@ class HttpService:
             web.get("/metrics", self._metrics),
             web.get("/fleet/status", self._fleet_status),
             web.get("/debug/requests", self._debug_requests),
+            web.get("/debug/profile", self._debug_profile),
             web.get("/openapi.json", self._openapi),
         ])
         # request-lifecycle debug view: in-flight dicts keyed by request
@@ -153,6 +154,11 @@ class HttpService:
         # SloMonitor that the TTFT/ITL observation points feed.
         self.fleet_status_provider = None  # Callable[[], dict] | None
         self.slo = None                    # SloMonitor | None
+        # Step-profiler surface (engine/profiler.py): in-proc
+        # deployments (run/main.py, bench, tests) wire a callable
+        # returning the local engine objects so /debug/profile can read
+        # their StepRecorder rings. None on frontend-only processes.
+        self.profile_engines = None        # Callable[[], list] | None
 
     def _observe_latency(self, kind: str, seconds: float) -> None:
         """One TTFT/ITL sample into both the histogram and (when
@@ -566,6 +572,54 @@ class HttpService:
             "recent": recent,
         })
 
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """Step flight-recorder view (docs/observability.md "Step
+        profiler"): per-engine ring snapshot + goodput/padding summary.
+        `?limit=N` bounds each ring dump, `?format=chrome` returns a
+        Perfetto-loadable Chrome trace-event JSON instead, and
+        `?capture_s=N` additionally arms a windowed on-demand
+        `jax.profiler.trace()` capture (blocks this request for N
+        seconds, serving continues). 503 when no in-proc engine is
+        wired (frontend-only process — hit the worker's surface)."""
+        if self.profile_engines is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no in-proc engine wired for profiling"},
+                status=503)
+        from dynamo_tpu.engine.profiler import (capture_device_profile,
+                                                profile_payload)
+
+        engines = list(self.profile_engines() or [])
+        if request.query.get("format") == "chrome":
+            events: list = []
+            for eng in engines:
+                rec = getattr(eng, "step_recorder", None)
+                if rec is not None:
+                    events.extend(rec.chrome_trace()["traceEvents"])
+            return web.json_response({"traceEvents": events,
+                                      "displayTimeUnit": "ms"})
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            limit = 256
+        payloads = [profile_payload(e, limit) for e in engines]
+        body = {
+            "enabled": any(p.get("enabled") for p in payloads),
+            "engines": payloads,
+        }
+        cap = request.query.get("capture_s")
+        if cap is not None:
+            try:
+                secs = float(cap)
+            except ValueError:
+                return web.json_response(
+                    {"error": "capture_s must be a number"}, status=400)
+            # device capture blocks for the window; run it off-loop so
+            # serving (and the engines being profiled) keep moving
+            body["capture"] = await asyncio.to_thread(
+                capture_device_profile, secs)
+        return web.json_response(body)
+
     @staticmethod
     def _has_content(chunk: dict) -> bool:
         """True for any token-bearing delta. reasoning_content and
@@ -654,6 +708,9 @@ class HttpService:
             "/metrics": ("Prometheus metrics", False),
             "/debug/requests": ("In-flight + recent request lifecycle "
                                 "timings", False),
+            "/debug/profile": ("Step flight-recorder ring + goodput/"
+                               "padding summary (?format=chrome, "
+                               "?capture_s=N)", False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.engine.metrics import EngineMetrics
+from dynamo_tpu.engine.profiler import recorder_from_env
 from dynamo_tpu.mocker.kv_manager import MockKvManager
 from dynamo_tpu.protocols import (
     FINISH_CANCELLED,
@@ -35,6 +36,18 @@ from dynamo_tpu.runtime.tracing import RequestTrace
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the mocker's stand-in for
+    the real engine's shape bucketing, so padded-lane/padded-token math
+    is analytically checkable chip-free (tests/test_step_profiler.py
+    recomputes it from the scripted batch mix)."""
+    p = 1
+    n = max(n, 1)
+    while p < n:
+        p <<= 1
+    return p
 
 
 @dataclass
@@ -90,6 +103,11 @@ class MockEngine:
         # same one-source-of-truth metrics surface as TpuEngine, so a
         # mocker deployment's /metrics matches the real worker's
         self.metrics = EngineMetrics()
+        # step flight recorder parity with TpuEngine (engine/profiler.py):
+        # None unless DYN_STEP_PROFILE — the simulated prefill/decode
+        # steps record the same goodput/padding attribution the real
+        # dispatch sites do, with _pow2 as the bucketing model
+        self.step_recorder = recorder_from_env(self.metrics)
         self._waiting: list[_MockRequest] = []
         self._running: list[_MockRequest] = []
         self._arrivals = 0
@@ -236,6 +254,13 @@ class MockEngine:
             progressed = True
             end_ns = time.time_ns()
             self.metrics.prefill_chunk.observe((end_ns - t0_ns) / 1e9)
+            rec = self.step_recorder
+            if rec is not None:
+                good = max(uncached_tokens, 0)
+                bucket = _pow2(good)
+                rec.record("prefill", (1, bucket),
+                           (end_ns - t0_ns) / 1e9, good_tokens=good,
+                           work_tokens=bucket, lanes=1, width=1)
             if r.trace is not None:
                 r.trace.stage("engine.prefill.chunk", t0_ns, end_ns,
                               tokens=max(uncached_tokens, 0),
@@ -251,7 +276,10 @@ class MockEngine:
         runnable = [r for r in self._running if r.prefilled]
         if not runnable:
             return False
+        t0_ns = time.time_ns()
         await self._sleep(cfg.decode_ms_per_iter / 1e3)
+        step_ns = time.time_ns() - t0_ns
+        emitted = 0
         for r in list(runnable):
             if r not in self._running or not r.prefilled:
                 continue  # preempted earlier in this same iteration
@@ -288,6 +316,7 @@ class MockEngine:
                 self.metrics.itl.observe((now_ns - r.t_last_ns) / 1e6)
             r.t_last_ns = now_ns
             self.metrics.tokens_emitted.inc()
+            emitted += 1
             finish = None
             if r.req.stop.stop_token_ids and token in r.req.stop.stop_token_ids:
                 finish = FINISH_STOP
@@ -297,6 +326,16 @@ class MockEngine:
                 token_ids=[token], finish_reason=finish).to_dict())
             if finish is not None:
                 self._finish(r, finish, emit=False)
+        rec = self.step_recorder
+        if rec is not None:
+            # decode goodput == emitted tokens (make profile-smoke
+            # asserts the two counters agree); width is the pow2 lane
+            # bucket the real engine would have dispatched
+            width = min(_pow2(len(runnable)), cfg.max_batch_size)
+            rec.record("decode_burst", (width, 1), step_ns / 1e9,
+                       good_tokens=emitted, work_tokens=width,
+                       lanes=len(runnable), width=width,
+                       tokens=emitted)
         return True
 
     def _next_token(self, r: _MockRequest) -> int:
@@ -355,6 +394,18 @@ class MockEngine:
                 hbm_cache_usage=self.kv.usage(),
             ),
         )
+        rec = self.step_recorder
+        if rec is not None:
+            # same gated attribution block TpuEngine publishes; absent
+            # (not zeroed) when the recorder is off
+            s = rec.summary()
+            m.scheduler_stats = {
+                "goodput_tokens": s["totals"]["good_tokens"],
+                "padded_tokens": s["totals"]["padded_tokens"],
+                "padded_pct": round(s["totals"]["padded_pct"], 3),
+                "dispatch_gap_mean_ms": round(
+                    s["dispatch_gap"]["mean_s"] * 1e3, 4),
+            }
         self.metrics_sink(m)
 
     def progress_token(self) -> int:

@@ -158,6 +158,27 @@ def latency_summary(snapshot: dict[str, dict],
     return out
 
 
+def _counter_total(snapshot: dict[str, dict], name: str) -> float:
+    m = snapshot.get(name)
+    if not m or m.get("type") != "counter":
+        return 0.0
+    return float(sum(v for _lbl, v in m.get("values", [])))
+
+
+def goodput_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """Goodput/padding attribution from the step flight recorder's
+    counters (engine/profiler.py). None when the component never armed
+    `DYN_STEP_PROFILE` — the fleet view stays unchanged for unprofiled
+    workers."""
+    good = _counter_total(snapshot, "dynamo_engine_goodput_tokens_total")
+    padded = _counter_total(snapshot, "dynamo_engine_padded_tokens_total")
+    if not good and not padded:
+        return None
+    work = good + padded
+    return {"goodput_tokens": good, "padded_tokens": padded,
+            "padded_pct": round(100.0 * padded / work, 3) if work else 0.0}
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -231,6 +252,9 @@ class TelemetryCollector:
         self._bus = bus
         self.stale_after = stale_after
         self._latest: dict[tuple[str, str], dict] = {}
+        # (component, instance) -> goodput tok/s from the delta between
+        # the last two snapshots (counters are cumulative)
+        self._goodput_rate: dict[tuple[str, str], float] = {}
         self._sub = None
         self._task: Optional[asyncio.Task] = None
         self.received = 0
@@ -247,6 +271,18 @@ class TelemetryCollector:
     def ingest(self, payload: dict) -> None:
         key = (str(payload.get("component", "?")),
                str(payload.get("instance", "?")))
+        prev = self._latest.get(key)
+        if prev is not None:
+            dt = float(payload.get("at", 0.0)) - float(prev.get("at", 0.0))
+            if dt > 0:
+                good_now = _counter_total(
+                    payload.get("metrics") or {},
+                    "dynamo_engine_goodput_tokens_total")
+                good_prev = _counter_total(
+                    prev.get("metrics") or {},
+                    "dynamo_engine_goodput_tokens_total")
+                if good_now >= good_prev:
+                    self._goodput_rate[key] = (good_now - good_prev) / dt
         self._latest[key] = payload
         self.received += 1
 
@@ -262,14 +298,23 @@ class TelemetryCollector:
     def fleet_status(self, slo=None) -> dict[str, Any]:
         now = time.time()
         components = []
+        fleet_tok_s = 0.0
         for (comp, inst), p in sorted(self.live().items()):
             metrics = p.get("metrics") or {}
-            components.append({
+            entry = {
                 "component": comp, "instance": inst,
                 "role": p.get("role", "?"),
                 "age_s": round(now - float(p.get("at", now)), 3),
                 "latency": latency_summary(metrics),
-            })
+            }
+            gp = goodput_summary(metrics)
+            if gp is not None:
+                rate = self._goodput_rate.get((comp, inst))
+                if rate is not None:
+                    gp["goodput_tok_s"] = round(rate, 2)
+                    fleet_tok_s += rate
+                entry["goodput"] = gp
+            components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
             "at": now,
@@ -277,6 +322,11 @@ class TelemetryCollector:
             "fleet": {"latency": latency_summary(merged),
                       "metrics": flatten(merged)},
         }
+        fleet_gp = goodput_summary(merged)
+        if fleet_gp is not None:
+            if fleet_tok_s:
+                fleet_gp["goodput_tok_s"] = round(fleet_tok_s, 2)
+            out["fleet"]["goodput"] = fleet_gp
         if slo is not None:
             out["slo"] = slo.status()
         return out

@@ -4,7 +4,7 @@
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke \
-	profile-smoke
+	profile-smoke router-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -55,6 +55,16 @@ autoscale-smoke:
 # transitions on the slo_events subject.
 fleet-smoke:
 	$(PYTEST) tests/test_telemetry.py tests/test_slo.py
+
+# router-observability gate (docs/observability.md "Router
+# observability"): decision-ring gating (DYN_ROUTER_LOG off ⇒
+# byte-identical SelectionResults, no record allocation), prefix-reuse
+# accounting parity (tokens saved == overlap × block_size), consumer
+# crash-proofing, GET /debug/router + doctor router end to end, KV-event
+# capture/replay, and disagg KV-pull bytes/bandwidth accounting — plus
+# the existing KV-router e2e suite. Chip-free (mock engines only).
+router-smoke:
+	$(PYTEST) tests/test_router_decisions.py tests/test_kv_router.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

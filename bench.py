@@ -819,6 +819,18 @@ async def phase_disagg():
         def pct_of(xs, p):
             return xs[min(len(xs) - 1, int(p * len(xs)))]
 
+        # measured pull accounting from the decode engine's metrics
+        # (disagg/handlers.py _record_pull): bytes by transfer path +
+        # per-transfer bandwidth percentiles — the observed counterpart
+        # of the microbench rates below
+        em = de.metrics
+        kv_pull = {
+            "transfers": em.kv_pull.count,
+            "bytes_by_path": {lbl.get("path", "?"): int(v)
+                              for lbl, v in em.kv_pull_bytes.items()},
+            "bw_gbps_p50": round(em.kv_pull_bw.quantile(0.5) / 1e9, 3),
+            "bw_gbps_p90": round(em.kv_pull_bw.quantile(0.9) / 1e9, 3),
+        }
         return {
             "tok_s": round(tok_s, 1),
             "ttft_ms_p50": round(pct_of(ttfts, 0.5), 1),
@@ -827,6 +839,7 @@ async def phase_disagg():
             "prefill_batch": 8, "decode_batch": 16,
             "quantize": QUANTIZE,
             "pull_path": handler.last_pull_path,
+            "kv_pull": kv_pull,
             "handoff_mb_per_seq": round(nbytes / 1e6, 2),
             "handoff_gather_gbps": round(gather_gbps, 2),
             "handoff_pure_copy_gbps": round(copy_gbps, 2),

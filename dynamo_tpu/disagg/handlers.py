@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from typing import Any, AsyncIterator, Optional
 
 import numpy as np
@@ -212,6 +213,10 @@ class DecodeWorkerHandler:
         # "device" (same-process) | "plane" (cross-process
         # device-to-device) | "wire" (chunked host frames)
         self.last_pull_path: Optional[str] = None
+        # bounded per-transfer records (bytes, seconds, bandwidth by
+        # path) — the raw inputs for a future network cost model; cheap
+        # enough to keep always-on
+        self.transfer_log: deque = deque(maxlen=256)
 
     def _can_prefill_remote(self) -> bool:
         if self.kv_pull_router is None:
@@ -403,6 +408,27 @@ class DecodeWorkerHandler:
             return None
         return None  # stream ended short
 
+    def _record_pull(self, ktp: dict, kv_data, seconds: float,
+                     em) -> None:
+        """Account one successful pull: bytes + bandwidth into the
+        engine metrics (labeled by path) and a bounded per-transfer
+        record. Works for numpy and jax arrays (both carry .nbytes)."""
+        nbytes = int(getattr(kv_data, "nbytes", 0) or 0)
+        path = self.last_pull_path or "?"
+        bw = nbytes / seconds if seconds > 0 else 0.0
+        if em is not None and nbytes:
+            em.kv_pull_bytes.inc(nbytes, path=path)
+            em.kv_pull_bw.observe(bw)
+        self.transfer_log.append({
+            "transfer_id": ktp.get("transfer_id"),
+            "path": path,
+            "bytes": nbytes,
+            "seconds": round(seconds, 6),
+            "bandwidth_bytes_per_s": round(bw, 1),
+            "prefill_len": int(ktp.get("prefill_len") or 0),
+            "at": time.time(),
+        })
+
     async def generate(self, request: dict, context: Context
                        ) -> AsyncIterator[dict]:
         token_ids = list(request.get("token_ids", ()))
@@ -469,9 +495,12 @@ class DecodeWorkerHandler:
             kv_data = await asyncio.wait_for(
                 self._pull_kv(ktp, context),
                 self.pull_deadline or None)
+            pull_s = time.perf_counter() - t_pull
             em = getattr(self.engine, "metrics", None)
             if em is not None:
-                em.kv_pull.observe(time.perf_counter() - t_pull)
+                em.kv_pull.observe(pull_s)
+            if kv_data is not None:
+                self._record_pull(ktp, kv_data, pull_s, em)
         except asyncio.TimeoutError:
             logger.warning("KV pull for transfer %s exceeded %.1fs; "
                            "serving locally", ktp.get("transfer_id"),

@@ -95,6 +95,13 @@ def main(argv=None) -> int:
         from dynamo_tpu.doctor.profile import main as profile_main
 
         return profile_main(argv[1:])
+    if argv and argv[0] == "router":
+        # `doctor router <frontend-url|payload.json|events.jsonl>`
+        # explains KV-aware placement from /debug/router or replays a
+        # KvRecorder capture offline (doctor/router.py)
+        from dynamo_tpu.doctor.router import main as router_main
+
+        return router_main(argv[1:])
     if argv and argv[0] == "preflight":
         # `doctor preflight` probes the device backend from a child
         # process with wedge diagnosis (doctor/preflight.py)

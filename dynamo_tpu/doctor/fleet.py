@@ -68,6 +68,25 @@ def _fmt_goodput(gp: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_router(rs: Optional[dict]) -> str:
+    """KV-router health (present only on components that made routing
+    decisions — kv-mode frontends / standalone routers)."""
+    if not rs:
+        return ""
+    parts = [f"routed={rs.get('decisions', 0)}",
+             f"saved={rs.get('prefill_tokens_saved', 0)}tok"]
+    ov = rs.get("overlap")
+    if ov:
+        parts.append(f"hit={100.0 * ov.get('mean_hit_ratio', 0.0):.1f}%")
+    err = rs.get("load_error")
+    if err:
+        parts.append(f"pred_err={err.get('mean', 0.0):.2f}")
+    dropped = rs.get("events_dropped")
+    if dropped:
+        parts.append(f"dropped={dropped}")
+    return "  " + " ".join(parts)
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -76,10 +95,12 @@ def render(status: dict) -> int:
               f"/{c.get('instance', '?')} "
               f"(age {c.get('age_s', '?')}s): "
               f"{_fmt_latency(c.get('latency') or {})}"
-              f"{_fmt_goodput(c.get('goodput'))}")
+              f"{_fmt_goodput(c.get('goodput'))}"
+              f"{_fmt_router(c.get('router'))}")
     fleet = status.get("fleet") or {}
     print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
-          f"{_fmt_goodput(fleet.get('goodput'))}")
+          f"{_fmt_goodput(fleet.get('goodput'))}"
+          f"{_fmt_router(fleet.get('router'))}")
     slo = status.get("slo")
     if slo:
         print("slo:")

@@ -1,0 +1,228 @@
+"""`python -m dynamo_tpu.doctor router <url-or-file>` — explain the
+router's placement decisions.
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/router``;
+  * a ``.json`` capture of the same payload (or a single-router
+    `router_payload` dict);
+  * a ``.jsonl`` KvRecorder capture (``--kv-record`` / DYN_KV_RECORD) —
+    replayed offline into a fresh KvIndexer to render what the prefix
+    index looked like, no engines needed.
+
+Renders, per router: placement share by worker (with tokens-of-prefill
+avoided), the overlap-ratio distribution, logit-margin stats (how close
+the calls were), predicted-vs-actual load error, consumer drop counters,
+and index composition. Exit code 0 when at least one router (or a
+replayed index) was rendered, 1 when the input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/router from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/router"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor router: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor router: cannot read {source}: {e!r}")
+        return None
+
+
+def _router_payloads(body: dict) -> list[dict]:
+    """Normalize: the frontend wraps payloads in `models`; a raw
+    single-router `router_payload` capture is accepted as-is."""
+    if isinstance(body.get("models"), list):
+        return [m for m in body["models"] if isinstance(m, dict)]
+    if "counters" in body or "index" in body:
+        return [body]
+    return []
+
+
+def _pct(v) -> str:
+    try:
+        return f"{float(v):5.1f}%"
+    except (TypeError, ValueError):
+        return f"{v!s:>6}"
+
+
+def _bar(n: int, width: int = 40) -> str:
+    return "#" * min(n, width)
+
+
+def render_router(payload: dict, idx: int, *, top_workers: int = 16
+                  ) -> bool:
+    """Print one router's view; False only on an empty payload."""
+    name = payload.get("model", f"router[{idx}]")
+    counters = payload.get("counters") or {}
+    decisions = counters.get("decisions") or {}
+    routed = decisions.get("route", 0)
+    queried = decisions.get("query", 0)
+    print(f"{name}: mode={payload.get('mode', '?')} "
+          f"block_size={payload.get('block_size', '?')} "
+          f"temperature={payload.get('temperature', 0)} "
+          f"overlap_weight={payload.get('overlap_weight', 1)}")
+    print(f"  decisions: routed={routed:.0f} queried={queried:.0f} "
+          f"prefill_tokens_saved="
+          f"{counters.get('prefill_tokens_saved', 0):.0f}")
+
+    index = payload.get("index") or {}
+    blocks = index.get("index_blocks") or {}
+    print(f"  index: {index.get('index_workers', 0)} worker(s), "
+          f"{index.get('total_blocks', 0)} cached block(s)"
+          + (f", {index.get('events_applied')} event(s) applied"
+             if index.get("events_applied") is not None else ""))
+    for wkey, n in sorted(blocks.items(), key=lambda kv: -kv[1]):
+        print(f"    {wkey:<12} {n} block(s)")
+
+    dropped = payload.get("counters", {}).get("events_dropped") or {}
+    dropped = {k: v for k, v in dropped.items() if v}
+    if dropped or counters.get("snapshot_failures"):
+        drops = " ".join(f"{k}={v:.0f}" for k, v in sorted(dropped.items()))
+        print(f"  WARN consumer drops: {drops or 'none'} "
+              f"snapshot_failures="
+              f"{counters.get('snapshot_failures', 0):.0f}")
+
+    le = payload.get("load_error") or {}
+    if le.get("count"):
+        print(f"  load prediction error: n={le['count']} "
+              f"mean={le.get('mean', 0.0):.3f} "
+              f"p90={le.get('p90', 0.0):.3f}")
+
+    kv_rec = payload.get("kv_record")
+    if kv_rec:
+        print(f"  kv-record: {kv_rec.get('events', 0)} event(s) -> "
+              f"{kv_rec.get('path')}")
+
+    if not payload.get("enabled"):
+        hint = payload.get("hint", "set DYN_ROUTER_LOG=1")
+        print(f"  ring: disabled ({hint})")
+        return True
+
+    s = payload.get("summary") or {}
+    print(f"  ring: {s.get('decisions', 0)} decision(s) recorded "
+          f"({s.get('in_ring', 0)} in ring, {s.get('evicted', 0)} "
+          f"evicted), tokens saved {s.get('tokens_saved', 0)}")
+
+    placement = s.get("placement") or {}
+    if placement:
+        print("  placement share:")
+        rows = sorted(placement.items(),
+                      key=lambda kv: -kv[1].get("decisions", 0))
+        for wkey, row in rows[:top_workers]:
+            print(f"    {wkey:<12} {_pct(row.get('share_pct'))} "
+                  f"n={row.get('decisions', 0):<6} "
+                  f"saved={row.get('tokens_saved', 0):<8} "
+                  f"mean_overlap={row.get('mean_overlap_blocks', 0.0)}"
+                  f"blk")
+        if len(rows) > top_workers:
+            print(f"    ... {len(rows) - top_workers} more worker(s)")
+
+    ov = s.get("overlap") or {}
+    counts = ov.get("counts") or []
+    if any(counts):
+        print(f"  overlap (prefix-hit ratio, mean="
+              f"{ov.get('mean_hit_ratio', 0.0):.3f}):")
+        edges = ov.get("buckets") or []
+        lo = 0.0
+        for edge, n in zip(edges, counts):
+            if n:
+                print(f"    <={edge:<5} {_bar(n)} {n}")
+            lo = edge
+        if len(counts) > len(edges) and counts[-1]:
+            print(f"    >{lo:<6} {_bar(counts[-1])} {counts[-1]}")
+
+    mg = s.get("margins") or {}
+    if s.get("decisions"):
+        print(f"  logit margins: mean={mg.get('mean', 0.0):.2f}blk "
+              f"p50={mg.get('p50', 0.0):.2f}blk "
+              f"min={mg.get('min', 0.0):.2f}blk "
+              f"close_calls(<1blk)={mg.get('close_call_pct', 0.0):.1f}%")
+
+    err_rows = s.get("load_error") or {}
+    if err_rows:
+        print("  load prediction error by worker:")
+        for wkey, e in sorted(err_rows.items()):
+            print(f"    {wkey:<12} n={e.get('samples', 0):<5} "
+                  f"mean={e.get('mean_abs', 0.0):.3f} "
+                  f"max={e.get('max_abs', 0.0):.3f} "
+                  f"last pred/actual={e.get('last_predicted', 0)}/"
+                  f"{e.get('last_actual', 0)}")
+    return True
+
+
+def replay_kv_record(path: str, block_size: int) -> int:
+    """Rebuild a prefix index from a KvRecorder JSONL capture and render
+    its composition — the offline half of `--kv-record` debugging."""
+    import asyncio
+
+    from dynamo_tpu.router.decision_log import worker_label
+    from dynamo_tpu.router.indexer import KvIndexer
+    from dynamo_tpu.router.recorder import KvRecorder
+
+    indexer = KvIndexer(block_size)
+    try:
+        n = asyncio.run(KvRecorder.replay_into(path, indexer))
+    except (OSError, ValueError, KeyError) as e:
+        print(f"doctor router: replay of {path} failed: {e!r}")
+        return 1
+    tree = indexer.tree
+    workers = sorted(tree.workers(), key=worker_label)
+    print(f"kv-record replay: {n} event(s) from {path} "
+          f"(block_size={block_size})")
+    print(f"  index: {len(workers)} worker(s), "
+          f"{sum(tree.block_count(w) for w in workers)} cached block(s)")
+    for w in workers:
+        print(f"    {worker_label(w):<12} {tree.block_count(w)} block(s)")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor router",
+        description="explain KV-aware placement decisions "
+                    "(/debug/router or a KvRecorder capture)")
+    p.add_argument("source",
+                   help="frontend base url, router JSON capture, or "
+                        "KvRecorder .jsonl file")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="block size for .jsonl replay (must match the "
+                        "recording engine's)")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    if args.source.endswith(".jsonl"):
+        return replay_kv_record(args.source, args.block_size)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    payloads = _router_payloads(body)
+    if not payloads:
+        print("doctor router: no router payloads in input")
+        return 1
+    rendered = 0
+    for i, payload in enumerate(payloads):
+        if render_router(payload, i):
+            rendered += 1
+    return 0 if rendered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

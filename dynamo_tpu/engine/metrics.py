@@ -42,6 +42,11 @@ _ITL_BUCKETS_MS = tuple(e for e in ITL_BUCKET_EDGES_MS
 _GAP_BUCKETS = (0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
                 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 1.0)
+# Per-transfer KV pull bandwidth spans the host wire on loopback
+# (~100 MB/s) through DCN (~GB/s) up to the device-to-device paths
+# (tens of GB/s) — log-ish edges across five decades.
+_BW_BUCKETS = (1e6, 3e6, 1e7, 3e7, 1e8, 3e8, 1e9, 3e9, 1e10, 3e10,
+               1e11, 3e11)
 
 # Canonical histogram names, importable by telemetry consumers
 # (runtime/telemetry.py latency summaries, doctor fleet) so renames
@@ -77,6 +82,17 @@ class EngineMetrics:
             "dynamo_engine_kv_pull_seconds",
             "disagg KV pull, prefill worker -> decode worker",
             _STAGE_BUCKETS)
+        # KV-transfer volume/bandwidth (disagg/handlers.py): the latency
+        # histogram above says how long pulls took; these say how much
+        # moved and how fast — the inputs a network-aware placement cost
+        # model needs (ROADMAP "network-aware disagg placement").
+        self.kv_pull_bytes = c(
+            "dynamo_kv_pull_bytes_total",
+            "disagg KV bytes pulled onto this decode worker, by "
+            "transfer path (device/plane/wire)")
+        self.kv_pull_bw = h(
+            "dynamo_kv_pull_bandwidth_bytes_per_s",
+            "per-transfer disagg KV pull bandwidth", _BW_BUCKETS)
         self.offload_drain = h(
             "dynamo_engine_offload_drain_seconds",
             "one kvbm offload batch: device gather + tier demote",
@@ -126,6 +142,7 @@ class EngineMetrics:
         scrape renders them (idempotent; first engine wins a name)."""
         for m in (self.queue_wait, self.admission_stall,
                   self.prefill_chunk, self.ttft, self.itl, self.kv_pull,
+                  self.kv_pull_bytes, self.kv_pull_bw,
                   self.offload_drain, self.prefill_seconds,
                   self.decode_seconds, self.tokens_emitted,
                   self.prefill_emitted, self.prefill_new_tokens,

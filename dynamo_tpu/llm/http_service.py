@@ -118,6 +118,7 @@ class HttpService:
             web.get("/fleet/status", self._fleet_status),
             web.get("/debug/requests", self._debug_requests),
             web.get("/debug/profile", self._debug_profile),
+            web.get("/debug/router", self._debug_router),
             web.get("/openapi.json", self._openapi),
         ])
         # request-lifecycle debug view: in-flight dicts keyed by request
@@ -620,6 +621,33 @@ class HttpService:
                 capture_device_profile, secs)
         return web.json_response(body)
 
+    async def _debug_router(self, request: web.Request) -> web.Response:
+        """Router decision flight-recorder view (docs/observability.md
+        "Router observability"): per-model decision counters, index
+        stats, and — when DYN_ROUTER_LOG arms the DecisionRecorder —
+        the placement/overlap/margin summary plus the raw decision
+        ring. `?limit=N` bounds each ring dump. 503 when no kv-mode
+        model is being served (round-robin/random routing records no
+        placement decisions)."""
+        from dynamo_tpu.router.decision_log import router_payload
+
+        routers = self.manager.kv_routers()
+        if not routers:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no kv-mode model served by this frontend"},
+                status=503)
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            limit = 256
+        models = [{"model": name, **router_payload(r, limit)}
+                  for name, r in routers.items()]
+        return web.json_response({
+            "enabled": any(m.get("enabled") for m in models),
+            "models": models,
+        })
+
     @staticmethod
     def _has_content(chunk: dict) -> bool:
         """True for any token-bearing delta. reasoning_content and
@@ -711,6 +739,9 @@ class HttpService:
             "/debug/profile": ("Step flight-recorder ring + goodput/"
                                "padding summary (?format=chrome, "
                                "?capture_s=N)", False),
+            "/debug/router": ("Router decision ring + placement/overlap "
+                              "summary per kv-mode model (?limit=N)",
+                              False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -75,6 +75,12 @@ class ModelManager:
         e = self._models.get(model)
         return e.engine if e else None
 
+    def kv_routers(self) -> dict[str, KvPushRouter]:
+        """model name → its KvPushRouter, kv-mode models only — the
+        /debug/router surface iterates this."""
+        return {name: e.kv_router for name, e in sorted(self._models.items())
+                if e.kv_router is not None}
+
     async def add_model(self, card: ModelDeploymentCard,
                         card_key: str) -> ModelEntry:
         entry = self._models.get(card.name)

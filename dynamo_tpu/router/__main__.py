@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from dynamo_tpu.cli_util import (
     add_runtime_args,
@@ -35,6 +36,11 @@ def parse_args(argv=None):
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--router-replica-sync", action="store_true")
+    p.add_argument("--kv-record", default=os.environ.get("DYN_KV_RECORD"),
+                   metavar="PATH",
+                   help="capture the consumed KV-event stream to this "
+                        "JSONL file (replayable via `doctor router`); "
+                        "DYN_KV_RECORD is the env equivalent")
     return p.parse_args(argv)
 
 
@@ -56,13 +62,14 @@ def main(argv=None) -> None:
             overlap_weight=args.kv_overlap_score_weight,
             temperature=args.router_temperature,
             use_kv_events=not args.no_kv_events,
-            replica_sync=args.router_replica_sync)).start()
+            replica_sync=args.router_replica_sync,
+            kv_record_path=args.kv_record)).start()
 
         async def best_worker_id(request: dict, context):
-            wid, dp, overlap = await router.best_worker_id(
+            wid, dp, overlap, margin = await router.best_worker_id(
                 list(request.get("token_ids", ())))
             yield {"worker_id": wid, "dp_rank": dp,
-                   "overlap_blocks": overlap}
+                   "overlap_blocks": overlap, "logit_margin": margin}
 
         comp = ns.component(args.router_component)
         served = [

@@ -20,6 +20,8 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import time
 import uuid
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Optional
@@ -29,7 +31,14 @@ from dynamo_tpu.protocols import (
     KvCacheEvent,
     PreprocessedRequest,
 )
+from dynamo_tpu.router.decision_log import (
+    DecisionRecorder,
+    RouterMetrics,
+    recorder_from_env,
+    worker_label,
+)
 from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, WorkerKey
+from dynamo_tpu.router.recorder import KvRecorder
 from dynamo_tpu.router.scheduler import (
     DefaultWorkerSelector,
     MultiWorkerSequences,
@@ -42,6 +51,7 @@ from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.events import EventBus
 from dynamo_tpu.runtime.push import PushRouter
 from dynamo_tpu.runtime.store import DELETE
+from dynamo_tpu.runtime.tracing import tracer
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +84,10 @@ class KvRouterConfig:
     replica_sync: bool = False
     snapshot_threshold: int = SNAPSHOT_THRESHOLD
     ttl_secs: float = 120.0           # approx-indexer TTL
+    # JSONL capture of the consumed KV-event stream (router/recorder.py)
+    # for offline replay through `doctor router`; the DYN_KV_RECORD env
+    # applies when unset here (KvPushRouter.start).
+    kv_record_path: Optional[str] = None
 
 
 class KvRouter:
@@ -95,6 +109,16 @@ class KvRouter:
         # workers known from instance discovery: worker_id -> set of dp_ranks
         self._known: dict[int, int] = {}      # worker_id -> dp_size
         self._metrics: dict[WorkerKey, ForwardPassMetrics] = {}
+        # Decision observability (router/decision_log.py): metrics are
+        # always on (cheap counters/histograms with fixed names); the
+        # per-decision ring is armed only by DYN_ROUTER_LOG.
+        self.metrics = RouterMetrics()
+        self.recorder: Optional[DecisionRecorder] = recorder_from_env()
+
+    def register_metrics(self, registry) -> None:
+        """Adopt the router metrics into a runtime registry; the prefix-
+        index gauges refresh at scrape time."""
+        self.metrics.register(registry, index_stats=self.index_stats)
 
     # -- worker membership (fed by instance watch) --------------------------
 
@@ -120,7 +144,21 @@ class KvRouter:
             self.indexer.apply_event(ev)
 
     def apply_metrics(self, m: ForwardPassMetrics) -> None:
-        self._metrics[(m.worker_id, m.dp_rank)] = m
+        w = (m.worker_id, m.dp_rank)
+        # Predicted-vs-actual load error: MultiWorkerSequences' predicted
+        # active blocks against the worker's own KvStats, sampled at every
+        # metrics arrival for workers the router has actually routed to
+        # (peek, not worker(): no fabricated zero-load state).
+        seqs = self.sequences.peek(w)
+        kv = getattr(m, "kv_stats", None)
+        if seqs is not None and kv is not None:
+            predicted = seqs.active_blocks
+            actual = kv.kv_active_blocks
+            self.metrics.load_error.observe(
+                abs(predicted - actual) / max(actual, 1))
+            if self.recorder is not None:
+                self.recorder.record_load_error(w, predicted, actual)
+        self._metrics[w] = m
 
     # -- the decision (kv_router.rs:320 find_best_match) --------------------
 
@@ -149,6 +187,22 @@ class KvRouter:
         result.prefill_tokens = max(
             len(token_ids) - result.overlap_blocks * self.config.block_size, 0)
         result.total_blocks = request_blocks
+        mode = "route" if update_states else "query"
+        m = self.metrics
+        m.decisions.inc(mode=mode)
+        m.overlap_ratio.observe(
+            result.overlap_blocks / max(result.total_blocks, 1))
+        m.candidates.observe(len(candidates))
+        m.logit_margin.observe(result.margin)
+        # tokens the chosen worker will NOT prefill thanks to overlap;
+        # query probes don't place work, so only routes count as saved
+        saved = len(token_ids) - result.prefill_tokens
+        if update_states and saved > 0:
+            m.prefill_tokens_saved.inc(saved)
+        if self.recorder is not None:
+            self.recorder.record_decision(
+                request_id, result, candidates, mode=mode,
+                tokens_saved=max(saved, 0), n_tokens=len(token_ids))
         if update_states:
             self.sequences.add_request(
                 request_id, result.worker,
@@ -174,6 +228,27 @@ class KvRouter:
         for d in events:
             self.apply_kv_event(KvCacheEvent.from_dict(d))
 
+    # -- introspection -------------------------------------------------------
+
+    def index_stats(self) -> dict:
+        """Prefix-index composition for /debug/router and the scrape-time
+        gauges: per-worker cached block counts plus event totals."""
+        tree = getattr(self.indexer, "tree", None)
+        blocks: dict[str, int] = {}
+        if tree is not None:
+            for w in tree.workers():
+                blocks[worker_label(w)] = tree.block_count(w)
+        out: dict[str, Any] = {
+            "workers": len(self._known),
+            "index_workers": len(blocks),
+            "index_blocks": blocks,
+            "total_blocks": sum(blocks.values()),
+        }
+        applied = getattr(self.indexer, "events_applied", None)
+        if applied is not None:
+            out["events_applied"] = applied
+        return out
+
 
 class KvPushRouter:
     """AsyncEngine: route a PreprocessedRequest to the KV-best worker and
@@ -193,6 +268,12 @@ class KvPushRouter:
         self._tasks: list[asyncio.Task] = []
         self._started = False
         self._events_since_snapshot = 0
+        # live KV-event capture (router/recorder.py), armed by config or
+        # DYN_KV_RECORD at start(); replayable via `doctor router`
+        self.kv_recorder: Optional[KvRecorder] = None
+        # consumer crash-proofing: first failure per stream logs with a
+        # traceback, the rest only count in events_dropped
+        self._logged_streams: set[str] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -205,6 +286,15 @@ class KvPushRouter:
             self.router.add_worker(
                 inst.instance_id, inst.metadata.get("dp_size", 1))
         self.client.on_change(self._on_instance_change)
+        record_path = self.config.kv_record_path \
+            or os.environ.get("DYN_KV_RECORD")
+        if record_path:
+            self.kv_recorder = KvRecorder(record_path)
+        reg = getattr(self.client.endpoint.runtime, "metrics", None)
+        if reg is not None:
+            # one /metrics scrape renders the router metrics; first
+            # router wins a name (same contract as EngineMetrics)
+            self.router.register_metrics(reg)
         await self._restore_snapshot()
         loop = asyncio.get_running_loop()
         if self.config.use_kv_events:
@@ -224,6 +314,9 @@ class KvPushRouter:
         for t in self._tasks:
             t.cancel()
         self._tasks.clear()
+        if self.kv_recorder is not None:
+            await self.kv_recorder.close()
+            self.kv_recorder = None
 
     def _on_instance_change(self, kind: str, inst: Instance) -> None:
         if kind == DELETE:
@@ -233,35 +326,76 @@ class KvPushRouter:
                 inst.instance_id, inst.metadata.get("dp_size", 1))
 
     # -- background consumers ----------------------------------------------
+    #
+    # Each iteration is individually guarded: one malformed payload (or a
+    # failing snapshot persist) must drop that message, not kill the
+    # consumer task silently — the router would keep serving on a frozen
+    # index/load view. First failure per stream logs a traceback; every
+    # drop counts in dynamo_router_events_dropped_total{stream}.
+
+    def _drop(self, stream: str, why: str) -> None:
+        self.router.metrics.events_dropped.inc(stream=stream)
+        if stream not in self._logged_streams:
+            self._logged_streams.add(stream)
+            logger.exception(
+                "router %s consumer: %s (logged once; further drops only "
+                "count in dynamo_router_events_dropped_total)", stream, why)
 
     async def _consume_kv_events(self, sub) -> None:
+        m = self.router.metrics
         async for msg in sub:
-            self.router.apply_kv_event(
-                KvCacheEvent.from_dict(msg["payload"]))
+            try:
+                ev = KvCacheEvent.from_dict(msg["payload"])
+                self.router.apply_kv_event(ev)
+                if self.kv_recorder is not None:
+                    self.kv_recorder.record(ev)
+                m.events.inc(stream="kv")
+            except Exception:
+                self._drop("kv", "malformed KV event")
+                continue
             self._events_since_snapshot += 1
             if self._events_since_snapshot >= self.config.snapshot_threshold:
                 self._events_since_snapshot = 0
-                await self._save_snapshot()
+                t0 = time.perf_counter()
+                try:
+                    await self._save_snapshot()
+                    m.snapshot_save.observe(time.perf_counter() - t0)
+                except Exception:
+                    # store hiccup: the snapshot is an optimization (a
+                    # restart replays the retained event tail) — never
+                    # worth the consumer's life
+                    m.snapshot_failures.inc()
+                    self._drop("snapshot", "snapshot persist failed")
 
     async def _consume_metrics(self, sub) -> None:
+        m = self.router.metrics
         async for msg in sub:
-            self.router.apply_metrics(
-                ForwardPassMetrics.from_dict(msg["payload"]))
+            try:
+                self.router.apply_metrics(
+                    ForwardPassMetrics.from_dict(msg["payload"]))
+                m.events.inc(stream="metrics")
+            except Exception:
+                self._drop("metrics", "malformed ForwardPassMetrics")
 
     async def _consume_sync(self, sub) -> None:
+        m = self.router.metrics
         async for msg in sub:
-            p = msg["payload"]
-            if p.get("router_id") == self.router.router_id:
-                continue  # our own publication
-            op = p.get("op")
-            if op == "add":
-                self.router.sequences.add_request(
-                    p["request_id"], tuple(p["worker"]),
-                    p["prefill_tokens"], p["total_blocks"])
-            elif op == "prefill_done":
-                self.router.mark_prefill_completed(p["request_id"])
-            elif op == "free":
-                self.router.free(p["request_id"])
+            try:
+                p = msg["payload"]
+                if p.get("router_id") == self.router.router_id:
+                    continue  # our own publication
+                op = p.get("op")
+                if op == "add":
+                    self.router.sequences.add_request(
+                        p["request_id"], tuple(p["worker"]),
+                        p["prefill_tokens"], p["total_blocks"])
+                elif op == "prefill_done":
+                    self.router.mark_prefill_completed(p["request_id"])
+                elif op == "free":
+                    self.router.free(p["request_id"])
+                m.events.inc(stream="sync")
+            except Exception:
+                self._drop("sync", "malformed replica-sync payload")
 
     async def _publish_sync(self, payload: dict) -> None:
         if not self.config.replica_sync:
@@ -285,8 +419,11 @@ class KvPushRouter:
         store = self.client.endpoint.runtime.store
         kv = await store.get(self._snapshot_key)
         if kv is not None:
+            t0 = time.perf_counter()
             try:
                 self.router.restore_snapshot(json.loads(kv.value))
+                self.router.metrics.snapshot_restore.observe(
+                    time.perf_counter() - t0)
             except Exception:
                 logger.exception("router snapshot restore failed; starting cold")
 
@@ -304,19 +441,43 @@ class KvPushRouter:
     # -- engine contract ----------------------------------------------------
 
     async def best_worker_id(self, token_ids: list[int]
-                             ) -> tuple[int, int, int]:
-        """Query-only endpoint: (worker_id, dp_rank, overlap_blocks)
-        — the standalone `dynamo.router` service's `best_worker_id`."""
+                             ) -> tuple[int, int, int, float]:
+        """Query-only endpoint: (worker_id, dp_rank, overlap_blocks,
+        logit_margin) — the standalone `dynamo.router` service's
+        `best_worker_id`. The margin (second-best minus best logit, in
+        block units) makes the answer self-explaining: ~0 means the
+        placement was a coin flip, large means a clear winner."""
         r = self.router.find_best_match(
             uuid.uuid4().hex, token_ids, update_states=False)
-        return r.worker[0], r.worker[1], r.overlap_blocks
+        return r.worker[0], r.worker[1], r.overlap_blocks, r.margin
+
+    def _select(self, request_id: str,
+                token_ids: list[int]) -> SelectionResult:
+        """find_best_match under a `router.decide` span so end-to-end
+        traces explain placement. The disabled-tracer path calls the
+        router directly — no span allocation on the hot path."""
+        tr = tracer()
+        if not tr.enabled:
+            return self.router.find_best_match(request_id, token_ids)
+        with tr.start_span("router.decide",
+                           attributes={"request.id": request_id}) as span:
+            sel = self.router.find_best_match(request_id, token_ids)
+            span.set_attribute("router.worker", worker_label(sel.worker))
+            span.set_attribute("router.overlap_blocks", sel.overlap_blocks)
+            span.set_attribute(
+                "router.prefix_hit_ratio",
+                round(sel.overlap_blocks / max(sel.total_blocks, 1), 4))
+            span.set_attribute("router.logit_margin", round(sel.margin, 4))
+            span.set_attribute("router.prefill_tokens", sel.prefill_tokens)
+            span.set_attribute("router.candidates", len(sel.logits))
+            return sel
 
     async def generate(self, request: dict, context: Optional[Context] = None
                        ) -> AsyncIterator[dict]:
         ctx = context or Context()
         token_ids = list(request.get("token_ids", ()))
         request_id = ctx.request_id
-        sel = self.router.find_best_match(request_id, token_ids)
+        sel = self._select(request_id, token_ids)
         worker_id, dp_rank = sel.worker
         await self._publish_sync({
             "op": "add", "request_id": request_id,

@@ -77,6 +77,12 @@ class MultiWorkerSequences:
             self._workers[w] = ActiveSequences(self.block_size)
         return self._workers[w]
 
+    def peek(self, w: WorkerKey) -> Optional[ActiveSequences]:
+        """Like `worker` but without auto-creating: prediction-error
+        sampling must not fabricate zero-load state for workers the
+        router never routed to."""
+        return self._workers.get(w)
+
     def add_request(self, request_id: str, w: WorkerKey,
                     prefill_tokens: int, total_blocks: int) -> None:
         self.worker(w).add_request(request_id, prefill_tokens, total_blocks)
@@ -130,6 +136,16 @@ class SelectionResult:
     prefill_tokens: int = 0
     total_blocks: int = 0
     logits: dict[WorkerKey, float] = field(default_factory=dict)
+    # Decision explanation (router/decision_log.py): the cost-function
+    # terms behind each logit, how close the call was (second-best minus
+    # best logit; 0 with a single candidate), the tie count at the
+    # argmin, and the softmax draw (None at temperature 0). Computed
+    # unconditionally — recording must not perturb selection.
+    potential_prefill: dict[WorkerKey, float] = field(default_factory=dict)
+    potential_decode: dict[WorkerKey, float] = field(default_factory=dict)
+    margin: float = 0.0
+    ties: int = 1
+    draw: Optional[float] = None
 
 
 class DefaultWorkerSelector:
@@ -154,33 +170,47 @@ class DefaultWorkerSelector:
             raise ValueError("no candidate workers")
         cfg = self.config
         logits: dict[WorkerKey, float] = {}
+        pot_prefill: dict[WorkerKey, float] = {}
+        pot_decode: dict[WorkerKey, float] = {}
         for c in candidates:
             new_prefill = max(request_blocks - c.overlap_blocks, 0)
             backlog_blocks = c.active_prefill_tokens / max(1, cfg.block_size)
             potential_prefill = new_prefill + backlog_blocks
             potential_decode = c.active_decode_blocks + request_blocks
+            pot_prefill[c.worker] = potential_prefill
+            pot_decode[c.worker] = float(potential_decode)
             logits[c.worker] = (cfg.overlap_weight * potential_prefill
                                 + potential_decode)
-        worker = self._sample(logits)
+        worker, ties, draw = self._sample(logits)
         overlap = next(c.overlap_blocks for c in candidates
                        if c.worker == worker)
+        ordered = sorted(logits.values())
+        margin = ordered[1] - ordered[0] if len(ordered) > 1 else 0.0
         return SelectionResult(worker=worker, overlap_blocks=overlap,
-                               logits=logits)
+                               logits=logits,
+                               potential_prefill=pot_prefill,
+                               potential_decode=pot_decode,
+                               margin=margin, ties=ties, draw=draw)
 
-    def _sample(self, logits: dict[WorkerKey, float]) -> WorkerKey:
+    def _sample(self, logits: dict[WorkerKey, float]
+                ) -> tuple[WorkerKey, int, Optional[float]]:
+        """(worker, argmin tie count, softmax draw). The RNG is consumed
+        exactly as before the decision log existed — one `choice` at
+        t==0, one `random` at t>0 — so seeded selections reproduce."""
         t = self.config.temperature
         if t <= 0.0:
             best = min(logits.values())
             ties = [w for w, v in logits.items() if v == best]
-            return self.rng.choice(ties)
+            return self.rng.choice(ties), len(ties), None
         # softmax over negated logits (lower logit ⇒ higher probability)
         mx = min(logits.values())
         weights = {w: math.exp(-(v - mx) / t) for w, v in logits.items()}
         total = sum(weights.values())
-        r = self.rng.random() * total
+        u = self.rng.random()
+        r = u * total
         acc = 0.0
         for w, p in weights.items():
             acc += p
             if r <= acc:
-                return w
-        return next(iter(logits))
+                return w, 1, u
+        return next(iter(logits)), 1, u

@@ -179,6 +179,35 @@ def goodput_summary(snapshot: dict[str, dict]) -> Optional[dict]:
             "padded_pct": round(100.0 * padded / work, 3) if work else 0.0}
 
 
+def router_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """KV-aware routing health from the router's always-on metrics
+    (router/decision_log.py). None when the component made no routing
+    decisions — workers and round-robin frontends stay unchanged."""
+    decisions = _counter_total(snapshot, "dynamo_router_decisions_total")
+    if not decisions:
+        return None
+    out = {
+        "decisions": int(decisions),
+        "prefill_tokens_saved": int(_counter_total(
+            snapshot, "dynamo_router_prefill_tokens_saved_total")),
+    }
+    dropped = _counter_total(snapshot, "dynamo_router_events_dropped_total")
+    if dropped:
+        out["events_dropped"] = int(dropped)
+    ov = snapshot.get("dynamo_router_overlap_ratio")
+    if ov and ov.get("type") == "histogram" and ov.get("count"):
+        out["overlap"] = {
+            "mean_hit_ratio": round(ov["sum"] / ov["count"], 4),
+            "p50_hit_ratio": round(hist_quantile(
+                ov["buckets"], ov["counts"], 0.5), 4),
+        }
+    err = snapshot.get("dynamo_router_load_prediction_error")
+    if err and err.get("type") == "histogram" and err.get("count"):
+        out["load_error"] = {"samples": err["count"],
+                             "mean": round(err["sum"] / err["count"], 4)}
+    return out
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -314,6 +343,9 @@ class TelemetryCollector:
                     gp["goodput_tok_s"] = round(rate, 2)
                     fleet_tok_s += rate
                 entry["goodput"] = gp
+            rs = router_summary(metrics)
+            if rs is not None:
+                entry["router"] = rs
             components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
@@ -327,6 +359,9 @@ class TelemetryCollector:
             if fleet_tok_s:
                 fleet_gp["goodput_tok_s"] = round(fleet_tok_s, 2)
             out["fleet"]["goodput"] = fleet_gp
+        fleet_rs = router_summary(merged)
+        if fleet_rs is not None:
+            out["fleet"]["router"] = fleet_rs
         if slo is not None:
             out["slo"] = slo.status()
         return out

@@ -1,0 +1,608 @@
+"""Router decision flight recorder (router/decision_log.py): gating,
+ring semantics, byte-identical selection when disabled, prefix-reuse
+accounting parity, consumer crash-proofing, the /debug/router surface,
+`doctor router`, and disagg KV-pull bytes/bandwidth accounting."""
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from dynamo_tpu.protocols import (
+    KV_STORED,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    StoredBlock,
+    WorkerStats,
+)
+from dynamo_tpu.router.decision_log import (
+    DecisionRecorder,
+    recorder_from_env,
+    router_log_enabled,
+    router_payload,
+)
+from dynamo_tpu.router.kv_router import (
+    KvPushRouter,
+    KvRouter,
+    KvRouterConfig,
+    kv_events_subject,
+    metrics_subject,
+    router_sync_subject,
+)
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.tokens import compute_block_hashes, compute_seq_hashes
+
+pytestmark = pytest.mark.tier0
+
+BS = 16
+
+
+async def make_rt():
+    return await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+
+
+def make_request(tokens, max_tokens=4):
+    return {"token_ids": tokens, "model": "m",
+            "stop": {"max_tokens": max_tokens}, "sampling": {}}
+
+
+def stored_event(worker_id, tokens, bs=BS):
+    """A KV_STORED event chain for every complete block of `tokens` —
+    what the engine publishes after caching the prompt."""
+    local = compute_block_hashes(tokens, bs)
+    seq = compute_seq_hashes(tokens, bs)
+    return KvCacheEvent(
+        kind=KV_STORED, worker_id=worker_id,
+        blocks=[StoredBlock(s, l) for s, l in zip(seq, local)])
+
+
+async def spawn_mock_worker(rt, ns, component, worker_id, speedup=200.0):
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+
+    subject_ev = kv_events_subject(ns, component)
+    subject_m = metrics_subject(ns, component)
+    bus = rt.events
+
+    def on_event(ev):
+        if hasattr(bus, "publish_nowait"):
+            bus.publish_nowait(subject_ev, ev.to_dict())
+
+    def on_metrics(m):
+        if hasattr(bus, "publish_nowait"):
+            bus.publish_nowait(subject_m, m.to_dict())
+
+    eng = MockEngine(
+        MockEngineConfig(block_size=BS, worker_id=worker_id,
+                         speedup=speedup, total_kv_blocks=256),
+        event_sink=on_event, metrics_sink=on_metrics)
+    ep = rt.namespace(ns).component(component).endpoint("generate")
+    served = await ep.serve(eng, instance_id=worker_id)
+    return eng, served
+
+
+# ---------------------------------------------------------------------------
+# gating + ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_off_by_default():
+    assert recorder_from_env({}) is None
+    assert not router_log_enabled({})
+    rec = recorder_from_env({"DYN_ROUTER_LOG": "1"})
+    assert isinstance(rec, DecisionRecorder)
+    rec = recorder_from_env({"DYN_ROUTER_LOG": "true",
+                             "DYN_ROUTER_LOG_RING": "64"})
+    assert rec.capacity == 64
+    # bad ring size falls back; floor is 16
+    assert recorder_from_env({"DYN_ROUTER_LOG": "1",
+                              "DYN_ROUTER_LOG_RING": "x"}).capacity == 2048
+    assert recorder_from_env({"DYN_ROUTER_LOG": "1",
+                              "DYN_ROUTER_LOG_RING": "1"}).capacity == 16
+    # a fresh KvRouter without the env stores None — zero-cost path
+    assert KvRouter(KvRouterConfig(block_size=BS)).recorder is None
+
+
+def test_ring_bound_and_eviction():
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    router.recorder = DecisionRecorder(capacity=16)
+    router.add_worker(1)
+    router.add_worker(2)
+    for i in range(40):
+        router.find_best_match(f"r{i}", list(range(i * 100, i * 100 + 32)))
+    rec = router.recorder
+    assert rec.recorded == 40
+    assert len(rec.snapshot()) == 16
+    s = rec.summary()
+    assert s["in_ring"] == 16 and s["evicted"] == 24
+    # cumulative placement totals survive ring eviction
+    assert sum(v["decisions"] for v in s["placement"].values()) == 40
+    assert abs(sum(v["share_pct"] for v in s["placement"].values())
+               - 100.0) < 0.1
+    assert len(rec.snapshot(limit=4)) == 4
+
+
+def test_disabled_is_byte_identical_to_enabled():
+    """Arming the recorder must not perturb selection: same seed, same
+    request stream → identical SelectionResults, at t=0 and t>0."""
+    for temp in (0.0, 0.5):
+        cfg = KvRouterConfig(block_size=BS, temperature=temp)
+        armed, bare = KvRouter(cfg), KvRouter(cfg)
+        armed.recorder = DecisionRecorder()
+        assert bare.recorder is None
+        for r in (armed, bare):
+            r.selector.rng = random.Random(7)
+            r.add_worker(1)
+            r.add_worker(2)
+            r.add_worker(3)
+        for i in range(25):
+            toks = list(range(i * 50, i * 50 + 48))
+            ra = armed.find_best_match(f"r{i}", toks)
+            rb = bare.find_best_match(f"r{i}", toks)
+            assert ra == rb  # dataclass eq: every field incl. draw/ties
+        assert armed.recorder.recorded == 25
+
+
+def test_deterministic_records_under_seeded_selector():
+    def run():
+        router = KvRouter(KvRouterConfig(block_size=BS))
+        router.recorder = DecisionRecorder()
+        router.selector.rng = random.Random(3)
+        router.add_worker(1)
+        router.add_worker(2)
+        for i in range(10):
+            router.find_best_match(f"r{i}", list(range(i, i + 32)))
+        recs = router.recorder.snapshot()
+        for r in recs:
+            r.pop("at")  # wall-clock differs between runs
+        return recs
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# prefix-reuse accounting
+# ---------------------------------------------------------------------------
+
+
+def test_tokens_saved_equals_overlap_times_block_size():
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    router.recorder = DecisionRecorder()
+    router.add_worker(1)
+    router.add_worker(2)
+    prompt = list(range(64))  # 4 full blocks
+    router.apply_kv_event(stored_event(1, prompt))
+
+    sel = router.find_best_match("req", prompt)
+    assert sel.worker == (1, 0)
+    assert sel.overlap_blocks == 4 and sel.prefill_tokens == 0
+    assert router.metrics.prefill_tokens_saved.get() == 64
+
+    rec = router.recorder.snapshot()[-1]
+    assert rec["tokens_saved"] == rec["overlap_blocks"] * BS == 64
+    assert rec["worker"] == "1:0"
+    assert rec["prefix_hit_ratio"] == 1.0
+    # candidate rows explain the choice: cached worker has lower logit
+    by_worker = {c["worker"]: c for c in rec["candidates"]}
+    assert by_worker["1:0"]["overlap_blocks"] == 4
+    assert by_worker["1:0"]["logit"] < by_worker["2:0"]["logit"]
+    assert rec["logit_margin"] > 0
+
+    # query probes place no work: counter must not move
+    router.find_best_match("probe", prompt, update_states=False)
+    assert router.metrics.prefill_tokens_saved.get() == 64
+    assert router.metrics.decisions.get(mode="query") == 1
+    assert router.metrics.decisions.get(mode="route") == 1
+
+
+def test_load_prediction_error_sampled():
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    router.recorder = DecisionRecorder()
+    router.add_worker(1)
+    # no decision yet → peek() is None → no fabricated sample
+    router.apply_metrics(ForwardPassMetrics(
+        worker_id=1, kv_stats=KvStats(kv_active_blocks=5)))
+    assert router.metrics.load_error.count == 0
+
+    sel = router.find_best_match("r", list(range(64)))
+    predicted = router.sequences.peek(sel.worker).active_blocks
+    router.apply_metrics(ForwardPassMetrics(
+        worker_id=1, worker_stats=WorkerStats(request_active_slots=1),
+        kv_stats=KvStats(kv_active_blocks=predicted + 2,
+                         kv_total_blocks=256)))
+    assert router.metrics.load_error.count == 1
+    err = router.recorder.summary()["load_error"]["1:0"]
+    assert err["samples"] == 1
+    assert err["last_predicted"] == predicted
+    assert err["last_actual"] == predicted + 2
+
+
+def test_index_stats_and_payload_without_ring():
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    router.add_worker(1)
+    router.apply_kv_event(stored_event(1, list(range(48))))
+    stats = router.index_stats()
+    assert stats["index_workers"] == 1
+    assert stats["index_blocks"]["1:0"] == 3
+    assert stats["total_blocks"] == 3
+    assert stats["events_applied"] == 1
+
+    payload = router_payload(router)  # bare KvRouter accepted
+    assert payload["enabled"] is False and "hint" in payload
+    assert "records" not in payload
+    assert payload["index"]["total_blocks"] == 3
+    json.dumps(payload)  # must be wire-serializable
+
+
+# ---------------------------------------------------------------------------
+# consumer crash-proofing
+# ---------------------------------------------------------------------------
+
+
+async def test_consumers_survive_malformed_events():
+    rt = await make_rt()
+    try:
+        ns, comp = "ns", "mock"
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events,
+            KvRouterConfig(block_size=BS, replica_sync=True)).start()
+        bus = rt.events
+
+        bus.publish_nowait(kv_events_subject(ns, comp), {"bogus": True})
+        bus.publish_nowait(metrics_subject(ns, comp),
+                           {"worker_stats": "not-a-dict"})
+        bus.publish_nowait(router_sync_subject(ns, comp),
+                           {"op": "add", "router_id": "other"})
+        # valid events AFTER the poison: the loops must still be alive
+        bus.publish_nowait(kv_events_subject(ns, comp),
+                           stored_event(1, list(range(32))).to_dict())
+        bus.publish_nowait(metrics_subject(ns, comp), ForwardPassMetrics(
+            worker_id=1, kv_stats=KvStats(kv_total_blocks=64)).to_dict())
+
+        m = kv_push.router.metrics
+        for _ in range(100):
+            if (kv_push.router.indexer.events_applied >= 1
+                    and m.events.get(stream="metrics") >= 1):
+                break
+            await asyncio.sleep(0.01)
+        assert kv_push.router.indexer.events_applied == 1
+        assert m.events_dropped.get(stream="kv") == 1
+        assert m.events_dropped.get(stream="metrics") == 1
+        assert m.events_dropped.get(stream="sync") == 1
+        assert m.events.get(stream="kv") == 1
+        assert kv_push.router._metrics.get((1, 0)) is not None
+        await kv_push.stop()
+    finally:
+        await rt.close()
+
+
+async def test_snapshot_failure_never_kills_consumer():
+    rt = await make_rt()
+    try:
+        ns, comp = "ns", "mock"
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events,
+            KvRouterConfig(block_size=BS, snapshot_threshold=1)).start()
+
+        async def broken_put(key, value):
+            raise OSError("store down")
+
+        rt.store.put = broken_put
+        bus = rt.events
+        bus.publish_nowait(kv_events_subject(ns, comp),
+                           stored_event(1, list(range(32))).to_dict())
+        m = kv_push.router.metrics
+        for _ in range(100):
+            if m.snapshot_failures.get() >= 1:
+                break
+            await asyncio.sleep(0.01)
+        assert m.snapshot_failures.get() >= 1
+        # the consumer survived: a second event still lands in the index
+        bus.publish_nowait(kv_events_subject(ns, comp),
+                           stored_event(1, list(range(100, 132))).to_dict())
+        for _ in range(100):
+            if kv_push.router.indexer.events_applied >= 2:
+                break
+            await asyncio.sleep(0.01)
+        assert kv_push.router.indexer.events_applied == 2
+        await kv_push.stop()
+    finally:
+        await rt.close()
+
+
+# ---------------------------------------------------------------------------
+# push-router surfaces: best_worker_id margin, span, registry, kv-record
+# ---------------------------------------------------------------------------
+
+
+async def test_best_worker_id_returns_margin():
+    rt = await make_rt()
+    try:
+        ns, comp = "ns", "mock"
+        e1, _ = await spawn_mock_worker(rt, ns, comp, worker_id=1)
+        e2, _ = await spawn_mock_worker(rt, ns, comp, worker_id=2)
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events, KvRouterConfig(block_size=BS)).start()
+        await client.wait_ready()
+
+        wid, dp, overlap, margin = await kv_push.best_worker_id(
+            list(range(64)))
+        assert wid in (1, 2) and dp == 0
+        assert overlap == 0
+        assert isinstance(margin, float) and margin >= 0.0
+        await kv_push.stop()
+        await e1.close()
+        await e2.close()
+    finally:
+        await rt.close()
+
+
+async def test_router_decide_span_exported(tmp_path):
+    from dynamo_tpu.runtime.recorder import Recorder
+    from dynamo_tpu.runtime.tracing import Tracer, set_tracer
+
+    rt = await make_rt()
+    path = tmp_path / "trace.jsonl"
+    t = Tracer(enabled=True, path=str(path))
+    set_tracer(t)
+    try:
+        ns, comp = "ns", "mock"
+        e1, _ = await spawn_mock_worker(rt, ns, comp, worker_id=1)
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events, KvRouterConfig(block_size=BS)).start()
+        await client.wait_ready()
+        out = [x async for x in kv_push.generate(
+            make_request(list(range(64))), Context())]
+        assert out and out[-1]["finish_reason"] == "length"
+        await kv_push.stop()
+        await e1.close()
+        await t.close()
+
+        rows = [e for _, e in Recorder.iter_events(path)]
+        decide = [r for r in rows if r["name"] == "router.decide"]
+        assert len(decide) == 1
+        attrs = {a["key"]: a["value"]["stringValue"]
+                 for a in decide[0]["attributes"]}
+        assert attrs["router.worker"] == "1:0"
+        assert attrs["router.candidates"] == "1"
+        assert "router.logit_margin" in attrs
+        assert "router.prefill_tokens" in attrs
+    finally:
+        set_tracer(None)  # back to env-configured (disabled) tracer
+        await rt.close()
+
+
+async def test_metrics_registered_on_start_and_scrape_refreshes_gauges():
+    rt = await make_rt()
+    try:
+        ns, comp = "ns", "mock"
+        e1, _ = await spawn_mock_worker(rt, ns, comp, worker_id=1)
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events, KvRouterConfig(block_size=BS)).start()
+        await client.wait_ready()
+
+        out = [x async for x in kv_push.generate(
+            make_request(list(range(64))), Context())]
+        assert out
+        for _ in range(100):  # let KV events land in the index
+            if kv_push.router.index_stats()["total_blocks"] >= 1:
+                break
+            await asyncio.sleep(0.01)
+        rendered = rt.metrics.render()
+        assert "dynamo_router_decisions_total" in rendered
+        assert 'mode="route"' in rendered
+        # on_scrape refreshed the index gauges from index_stats()
+        assert 'dynamo_router_index_blocks{worker="1:0"}' in rendered
+        assert "dynamo_router_prefill_tokens_saved_total" in rendered
+
+        # the telemetry plane picks the same counters up
+        from dynamo_tpu.runtime.telemetry import (
+            router_summary,
+            snapshot_metrics,
+        )
+
+        rs = router_summary(snapshot_metrics(rt.metrics))
+        assert rs is not None and rs["decisions"] >= 1
+        assert router_summary({}) is None  # non-routing components
+        await kv_push.stop()
+        await e1.close()
+    finally:
+        await rt.close()
+
+
+async def test_kv_record_capture_and_doctor_replay(tmp_path, capsys):
+    from dynamo_tpu.doctor.router import main as router_main
+
+    rt = await make_rt()
+    record = tmp_path / "kv_events.jsonl"
+    try:
+        ns, comp = "ns", "mock"
+        e1, _ = await spawn_mock_worker(rt, ns, comp, worker_id=1)
+        ep = rt.namespace(ns).component(comp).endpoint("generate")
+        client = await ep.client()
+        kv_push = await KvPushRouter(
+            client, rt.events,
+            KvRouterConfig(block_size=BS,
+                           kv_record_path=str(record))).start()
+        await client.wait_ready()
+        out = [x async for x in kv_push.generate(
+            make_request(list(range(64))), Context())]
+        assert out
+        for _ in range(100):
+            if kv_push.kv_recorder.event_count >= 1:
+                break
+            await asyncio.sleep(0.01)
+        events = kv_push.kv_recorder.event_count
+        assert events >= 1
+        payload = router_payload(kv_push)
+        assert payload["kv_record"]["events"] == events
+        await kv_push.stop()  # closes + flushes the recorder
+        await e1.close()
+    finally:
+        await rt.close()
+
+    # offline replay rebuilds the index, no engines involved
+    rc = await asyncio.to_thread(
+        router_main, [str(record), "--block-size", str(BS)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "kv-record replay" in out
+    assert "1:0" in out
+
+
+async def test_debug_router_endpoint_and_doctor_render(tmp_path, capsys,
+                                                       monkeypatch):
+    """Full stack: DYN_ROUTER_LOG=1 → serve traffic → /debug/router
+    carries all four views → `doctor router` renders them from both the
+    live scrape and a saved payload file."""
+    import aiohttp
+
+    from dynamo_tpu.doctor.router import main as router_main
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+
+    monkeypatch.setenv("DYN_ROUTER_LOG", "1")
+    rt = await make_rt()
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="kv", migration_limit=1)
+    ev_sink, m_sink = wire_engine_events(rt, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=64),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    handle = await serve_engine(rt, eng, card, instance_id=1)
+    fe = await start_frontend(rt)
+    try:
+        for _ in range(100):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 4,
+                    "messages": [{"role": "user",
+                                  "content": "route me twice please"}]}
+            for _ in range(2):
+                async with s.post(f"{fe.url}/v1/chat/completions",
+                                  json=body) as r:
+                    assert r.status == 200
+                    await r.json()
+            async with s.get(f"{fe.url}/debug/router?limit=10") as r:
+                assert r.status == 200
+                dbg = await r.json()
+        assert dbg["enabled"] is True
+        model = dbg["models"][0]
+        assert model["model"] == "mock-model"
+        # the four views: placement, overlap, margins, prediction error
+        summary = model["summary"]
+        assert summary["decisions"] >= 2
+        assert summary["placement"]["1:0"]["decisions"] >= 2
+        assert "overlap" in summary and "margins" in summary
+        assert "load_error" in summary
+        assert model["records"]
+        assert model["counters"]["decisions"]["route"] >= 2
+
+        # doctor router from the live scrape (thread: urllib is sync)
+        rc = await asyncio.to_thread(router_main, [fe.url])
+        assert rc == 0
+        # ... and from a saved payload file
+        capture = tmp_path / "router.json"
+        capture.write_text(json.dumps(dbg))
+        assert await asyncio.to_thread(router_main, [str(capture)]) == 0
+        out = capsys.readouterr().out
+        assert "placement share" in out
+        assert "logit margins" in out
+        assert "overlap" in out
+        assert "index:" in out
+    finally:
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt.close()
+
+
+# ---------------------------------------------------------------------------
+# disagg KV-pull accounting
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_pull_bytes_and_bandwidth_accounting():
+    import numpy as np
+
+    from dynamo_tpu.disagg.handlers import DecodeWorkerHandler
+    from dynamo_tpu.engine.metrics import EngineMetrics
+
+    class _Eng:
+        metrics = EngineMetrics()
+
+    eng = _Eng()
+    handler = DecodeWorkerHandler(eng)
+    em = eng.metrics
+
+    kv = np.zeros((2, 1, 2, 8, 16, 4), dtype=np.float32)
+    handler.last_pull_path = "wire"
+    handler._record_pull({"transfer_id": "t1", "prefill_len": 128},
+                         kv, 0.01, em)
+    assert em.kv_pull_bytes.get(path="wire") == kv.nbytes
+    assert em.kv_pull_bw.count == 1
+    assert abs(em.kv_pull_bw.sum - kv.nbytes / 0.01) < 1.0
+
+    handler.last_pull_path = "device"
+    handler._record_pull({"transfer_id": "t2", "prefill_len": 64},
+                         kv, 0.002, em)
+    assert em.kv_pull_bytes.get(path="device") == kv.nbytes
+    assert em.kv_pull_bytes.get(path="wire") == kv.nbytes  # unchanged
+
+    assert len(handler.transfer_log) == 2
+    rec = handler.transfer_log[-1]
+    assert rec["path"] == "device" and rec["bytes"] == kv.nbytes
+    assert rec["bandwidth_bytes_per_s"] == pytest.approx(
+        kv.nbytes / 0.002, rel=1e-3)
+    assert rec["prefill_len"] == 64
+
+    # zero-duration pull must not divide by zero
+    handler._record_pull({"transfer_id": "t3"}, kv, 0.0, em)
+    assert handler.transfer_log[-1]["bandwidth_bytes_per_s"] == 0.0
+
+
+def test_doctor_fleet_renders_router_block(capsys):
+    from dynamo_tpu.doctor.fleet import render
+
+    status = {
+        "components": [{
+            "component": "frontend", "instance": "i1", "role": "frontend",
+            "age_s": 0.5, "latency": {},
+            "router": {"decisions": 12, "prefill_tokens_saved": 640,
+                       "overlap": {"mean_hit_ratio": 0.42,
+                                   "p50_hit_ratio": 0.5},
+                       "load_error": {"samples": 3, "mean": 0.08},
+                       "events_dropped": 2},
+        }],
+        "fleet": {"latency": {}},
+    }
+    assert render(status) == 0
+    out = capsys.readouterr().out
+    assert "routed=12" in out
+    assert "saved=640tok" in out
+    assert "hit=42.0%" in out
+    assert "pred_err=0.08" in out
+    assert "dropped=2" in out

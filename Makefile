@@ -4,7 +4,7 @@
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke \
-	profile-smoke router-smoke
+	profile-smoke router-smoke kv-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -65,6 +65,16 @@ fleet-smoke:
 # the existing KV-router e2e suite. Chip-free (mock engines only).
 router-smoke:
 	$(PYTEST) tests/test_router_decisions.py tests/test_kv_router.py
+
+# KV-lifecycle gate (docs/observability.md "KV lifecycle"): arm
+# DYN_KV_LIFECYCLE over PagePool / MockKvManager / TieredStore workouts
+# with analytically-known eviction causes, reuse distances, and
+# premature-eviction windows; pins the unarmed byte-identical contract,
+# KV-event gap detection in the router indexer, hint-driven prefetch
+# attribution, and GET /debug/kv + doctor kv end to end (mock engines,
+# chip-free).
+kv-smoke:
+	$(PYTEST) tests/test_kv_lifecycle.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

@@ -490,6 +490,13 @@ async def _phase_long_body(cfg, eng):
     sp = step_profile_summary(eng)
     if sp is not None:
         out["step_profile"] = sp
+    # KV memory-plane block (kvbm/lifecycle.py): present when the phase
+    # ran with DYN_KV_LIFECYCLE — hits/evictions/reuse-distance/hotness
+    from dynamo_tpu.kvbm.lifecycle import kv_lifecycle_summary
+
+    kvl = kv_lifecycle_summary(eng)
+    if kvl is not None:
+        out["kv_lifecycle"] = kvl
     del params
     return out
 
@@ -1033,9 +1040,12 @@ async def phase_traffic():
     results = await replay(fe.url, "mock-model", schedule, cfg)
     summary = summarize_results(results)
     from dynamo_tpu.engine.profiler import step_profile_summary
+    from dynamo_tpu.kvbm.lifecycle import kv_lifecycle_summary
 
     step_profiles = [sp for sp in (step_profile_summary(e)
                                    for e in engines) if sp is not None]
+    kv_summaries = [kv for kv in (kv_lifecycle_summary(e)
+                                  for e in engines) if kv is not None]
     await fe.stop()
     for h in handles:
         await h.stop()
@@ -1058,6 +1068,18 @@ async def phase_traffic():
             "mean_dispatch_gap_s": round(
                 sum(s["mean_dispatch_gap_s"] for s in step_profiles)
                 / len(step_profiles), 6),
+        }
+    if kv_summaries:
+        # fleet-level memory-plane totals; per-worker detail (reuse
+        # distance, hotness, residency) stays in /debug/kv
+        out["kv_lifecycle"] = {
+            "events": sum(s["events"] for s in kv_summaries),
+            "hits": sum(s["hits"] for s in kv_summaries),
+            "tokens_saved": sum(s["tokens_saved"] for s in kv_summaries),
+            "evictions": sum(sum(s["evictions"].values())
+                             for s in kv_summaries),
+            "premature_evictions": sum(s["premature_evictions"]
+                                       for s in kv_summaries),
         }
     if summary["errors"]:
         out["error"] = f"{summary['errors']} replay errors: " \
@@ -1089,6 +1111,9 @@ def run_one_phase(name: str) -> None:
         # (goodput, padded-token %, dispatch gap); the other phases keep
         # the byte-identical unprofiled step loop
         os.environ.setdefault("DYN_STEP_PROFILE", "1")
+        # and the KV lifecycle ring (kvbm/lifecycle.py) so the same
+        # records carry a kv_lifecycle memory-plane block
+        os.environ.setdefault("DYN_KV_LIFECYCLE", "1")
     try:
         result = asyncio.run(PHASES[name]())
     except Exception as e:

@@ -102,6 +102,13 @@ def main(argv=None) -> int:
         from dynamo_tpu.doctor.router import main as router_main
 
         return router_main(argv[1:])
+    if argv and argv[0] == "kv":
+        # `doctor kv <frontend-url|dump.json>` explains the KV-cache
+        # memory plane from /debug/kv: tier occupancy, eviction causes,
+        # reuse distance, prefix hotness (doctor/kv.py)
+        from dynamo_tpu.doctor.kv import main as kv_main
+
+        return kv_main(argv[1:])
     if argv and argv[0] == "preflight":
         # `doctor preflight` probes the device backend from a child
         # process with wedge diagnosis (doctor/preflight.py)

@@ -87,6 +87,25 @@ def _fmt_router(rs: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_kv(ks: Optional[dict]) -> str:
+    """KV-cache memory-plane health (present only on workers that armed
+    DYN_KV_LIFECYCLE)."""
+    if not ks:
+        return ""
+    parts = [f"kv_saved={ks.get('tokens_saved', 0)}tok"]
+    ev = ks.get("evictions")
+    if ev:
+        parts.append(f"evict={sum(ev.values())}")
+    prem = ks.get("premature_evictions")
+    if prem:
+        parts.append(f"premature={prem}")
+    tiers = ks.get("tiers")
+    if tiers:
+        parts.append("tiers=" + ",".join(
+            f"{t}:{n}" for t, n in sorted(tiers.items())))
+    return "  " + " ".join(parts)
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -96,11 +115,13 @@ def render(status: dict) -> int:
               f"(age {c.get('age_s', '?')}s): "
               f"{_fmt_latency(c.get('latency') or {})}"
               f"{_fmt_goodput(c.get('goodput'))}"
-              f"{_fmt_router(c.get('router'))}")
+              f"{_fmt_router(c.get('router'))}"
+              f"{_fmt_kv(c.get('kv'))}")
     fleet = status.get("fleet") or {}
     print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
           f"{_fmt_goodput(fleet.get('goodput'))}"
-          f"{_fmt_router(fleet.get('router'))}")
+          f"{_fmt_router(fleet.get('router'))}"
+          f"{_fmt_kv(fleet.get('kv'))}")
     slo = status.get("slo")
     if slo:
         print("slo:")

@@ -1,0 +1,177 @@
+"""`python -m dynamo_tpu.doctor kv <url-or-file>` — explain the KV-cache
+memory plane.
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/kv``;
+  * a ``.json`` capture of the same payload (or a single-engine
+    `kv_payload` dict) — the same render works offline on a saved dump.
+
+Renders, per engine: tier occupancy (g1 device / g2 host / g3 disk),
+eviction counts by cause, the reuse-distance distribution (allocations
+between a block's register and its next prefix hit — distances past the
+pool size mean LRU could never have kept the block), per-tier residency
+time, offload pin balance, premature-eviction callout ("we evicted the
+wrong block"), and the top-K hottest prefixes. Exit code 0 when at
+least one engine was rendered, 1 when the input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/kv from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/kv"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor kv: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor kv: cannot read {source}: {e!r}")
+        return None
+
+
+def _engine_payloads(body: dict) -> list[dict]:
+    """Normalize: the frontend wraps payloads in `engines`; a raw
+    single-engine `kv_payload` capture is accepted as-is."""
+    if isinstance(body.get("engines"), list):
+        return [e for e in body["engines"] if isinstance(e, dict)]
+    if "tiers" in body or "summary" in body:
+        return [body]
+    return []
+
+
+def _bar(n: int, width: int = 40) -> str:
+    return "#" * min(n, width)
+
+
+def render_engine(payload: dict, idx: int, *, top_prefixes: int = 10
+                  ) -> bool:
+    """Print one engine's view; False only on an empty payload."""
+    wid = payload.get("worker_id")
+    name = f"engine[{idx}]" if wid is None else f"worker {wid}"
+    print(f"{name}:")
+
+    tiers = payload.get("tiers") or {}
+    for tier, row in sorted(tiers.items()):
+        cap = row.get("capacity", 0)
+        blocks = row.get("blocks", 0)
+        pct = 100.0 * blocks / cap if cap else 0.0
+        nbytes = row.get("bytes", 0)
+        mb = f" {nbytes / 2 ** 20:.1f}MiB" if nbytes else ""
+        print(f"  {tier}: {blocks}/{cap} block(s) ({pct:.1f}%){mb}")
+
+    pipe = payload.get("pipeline")
+    if pipe:
+        rows = " ".join(f"{k}={v}" for k, v in sorted(pipe.items())
+                        if isinstance(v, (int, float)) and v)
+        if rows:
+            print(f"  pipeline: {rows}")
+
+    if not payload.get("enabled"):
+        hint = payload.get("hint", "set DYN_KV_LIFECYCLE=1")
+        print(f"  ring: disabled ({hint})")
+        return True
+
+    s = payload.get("summary") or {}
+    print(f"  ring: {s.get('events', 0)} event(s) recorded "
+          f"({s.get('in_ring', 0)} in ring, {s.get('evicted', 0)} "
+          f"evicted)")
+    print(f"  blocks: {s.get('allocations', 0)} allocated, "
+          f"{s.get('hits', 0)} prefix hit(s), "
+          f"{s.get('tokens_saved', 0)} token(s) saved")
+
+    ev = s.get("evictions") or {}
+    if ev:
+        causes = " ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+        print(f"  evictions: {sum(ev.values())} ({causes})")
+    prem = s.get("premature_evictions", 0)
+    if prem:
+        print(f"  WARN premature evictions: {prem} block(s) onboarded "
+              f"back within {s.get('premature_window', '?')} "
+              f"allocations of leaving the device — the device pool is "
+              f"evicting blocks it is about to need")
+
+    pins = s.get("pins") or {}
+    if pins.get("pinned"):
+        leak = pins.get("pinned", 0) - pins.get("released", 0)
+        print(f"  offload pins: {pins.get('pinned', 0)} pinned / "
+              f"{pins.get('released', 0)} released"
+              + (f" (WARN {leak} still held)" if leak > 0 else ""))
+
+    rd = s.get("reuse_distance") or {}
+    counts = rd.get("counts") or []
+    if rd.get("samples"):
+        print(f"  reuse distance (allocations, n={rd['samples']}, "
+              f"mean={rd.get('mean', 0.0)}, p50={rd.get('p50', 0)}, "
+              f"p90={rd.get('p90', 0)}):")
+        edges = rd.get("buckets") or []
+        for edge, n in zip(edges, counts):
+            if n:
+                print(f"    <={edge:<5} {_bar(n)} {n}")
+        if len(counts) > len(edges) and counts[-1]:
+            print(f"    >{edges[-1] if edges else 0:<6} "
+                  f"{_bar(counts[-1])} {counts[-1]}")
+
+    res = s.get("residency") or {}
+    if res:
+        print("  residency:")
+        for tier, row in sorted(res.items()):
+            print(f"    {tier}: mean {row.get('mean_s', 0.0)}s over "
+                  f"{row.get('samples', 0)} exit(s), "
+                  f"{row.get('live', 0)} live")
+
+    hot = s.get("hotness") or []
+    if hot:
+        print("  hottest prefixes:")
+        for row in hot[:top_prefixes]:
+            print(f"    {row.get('seq_hash', '?'):<18} "
+                  f"hits={row.get('hits', 0):<6} "
+                  f"saved={row.get('tokens_saved', 0):<8} "
+                  f"tier={row.get('tier', '?')}")
+        if len(hot) > top_prefixes:
+            print(f"    ... {len(hot) - top_prefixes} more prefix(es)")
+    return True
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor kv",
+        description="explain the KV-cache memory plane "
+                    "(/debug/kv or a saved dump)")
+    p.add_argument("source",
+                   help="frontend base url or kv JSON capture")
+    p.add_argument("--top", type=int, default=10,
+                   help="prefix-hotness rows to show per engine")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    payloads = _engine_payloads(body)
+    if not payloads:
+        print("doctor kv: no engine payloads in input")
+        return 1
+    rendered = 0
+    for i, payload in enumerate(payloads):
+        if render_engine(payload, i, top_prefixes=args.top):
+            rendered += 1
+    return 0 if rendered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -656,6 +656,16 @@ class TpuEngine:
         # on `is not None`, so off means zero allocation and a
         # byte-identical step loop.
         self.step_recorder = recorder_from_env(self.metrics)
+        # KV lifecycle flight recorder (kvbm/lifecycle.py): same
+        # contract — None unless DYN_KV_LIFECYCLE, metrics always-on.
+        # The pool shares the recorder; KvbmManager picks it up (and
+        # hands it to the tier store) when attached.
+        from dynamo_tpu.kvbm.lifecycle import KvbmMetrics
+        from dynamo_tpu.kvbm.lifecycle import \
+            recorder_from_env as kv_recorder_from_env
+        self.kv_metrics = KvbmMetrics()
+        self.kv_lifecycle = kv_recorder_from_env(self.kv_metrics)
+        self.pool.lifecycle = self.kv_lifecycle
         # raw ITL samples (ms), capped FIFO — bench reads these for
         # exact percentiles; the wire carries only the histogram
         self.itl_samples: list[float] = []
@@ -941,8 +951,13 @@ class TpuEngine:
                 if self.kvbm is not None and self._waiting:
                     # stage tier blocks for still-queued requests so
                     # their admission onboard is one device write
-                    # (no-op unless kvbm prefetch_blocks > 0)
-                    self.kvbm.prefetch_waiting(self._waiting)
+                    # (no-op unless kvbm prefetch_blocks > 0); router
+                    # prefix hints (request extra.kv_hints) ride along
+                    hints = [s.req.extra.get("kv_hints")
+                             for s in self._waiting]
+                    self.kvbm.prefetch_waiting(
+                        self._waiting,
+                        hints=[h for h in hints if h] or None)
                 if self.kvbm is not None and self.kvbm.remote is not None:
                     # G4: continue freshly-admitted prompts' block chains
                     # from peer workers' tiers before prefill. Fetches

@@ -79,6 +79,10 @@ class PagePool:
         # the manager pays one device gather, not one sync per page
         self.evict_hook: Optional[Callable[[list[tuple[int, int]]], None]] \
             = None
+        # KV lifecycle flight recorder (kvbm/lifecycle.py): None unless
+        # DYN_KV_LIFECYCLE armed it — every touch below is one
+        # `is not None` check and never changes allocator behavior
+        self.lifecycle = None
         self._free: list[int] = list(range(num_pages - 1, 0, -1))
         self._pages: dict[int, _Page] = {}
         self._registered: dict[int, int] = {}       # seq_hash -> page_id
@@ -154,6 +158,8 @@ class PagePool:
                 return None
         pid = self._free.pop()
         self._pages[pid] = _Page(page_id=pid, refcount=1)
+        if self.lifecycle is not None:
+            self.lifecycle.on_allocate(pid)
         return pid
 
     def allocate_sequence(self, seq_hashes: list[int], total_len: int
@@ -179,7 +185,7 @@ class PagePool:
         # instead of one device sync per page inside the allocate loop
         deficit = fresh_needed - len(self._free)
         if deficit > 0:
-            self._evict_many(deficit)
+            self._evict_many(deficit, cause="admission-deficit")
         for _ in range(fresh_needed):
             pid = self.allocate_page()
             # reachable when the evict hook pinned the victims for
@@ -189,6 +195,9 @@ class PagePool:
                 self.release_sequence(pages)
                 return None
             pages.append(pid)
+        if self.lifecycle is not None:
+            for h in seq_hashes[:len(matched)]:
+                self.lifecycle.on_hit(h, self.page_size)
         return pages, len(matched) * self.page_size
 
     # -- registration / release --------------------------------------------
@@ -219,12 +228,16 @@ class PagePool:
             page.state = REGISTERED
         else:
             page.state = COMPLETE
+        if self.lifecycle is not None:
+            self.lifecycle.on_register(page_id, seq_hash)
         if self.event_sink is not None:
             self.event_sink(KvCacheEvent(
                 kind=KV_STORED, worker_id=self.worker_id,
                 dp_rank=self.dp_rank, event_id=next(self._event_ids),
                 parent_seq_hash=parent_seq_hash,
                 blocks=[StoredBlock(seq_hash, local_hash)]))
+            if self.lifecycle is not None:
+                self.lifecycle.on_kv_event(KV_STORED, 1)
 
     def release_sequence(self, page_ids: list[int]) -> None:
         for pid in page_ids:
@@ -253,7 +266,8 @@ class PagePool:
         them too. In-flight (refcounted) pages are untouched. The KVBM
         offload hook deliberately does NOT fire — clearing means
         forgetting, not demoting to a slower tier."""
-        return self._evict_many(len(self._inactive), fire_hook=False)
+        return self._evict_many(len(self._inactive), fire_hook=False,
+                                cause="clear")
 
     # -- pending-offload pins (async KVBM pipeline) -------------------------
 
@@ -273,18 +287,24 @@ class PagePool:
                     f"offload pin of page {pid} in state {page.state} "
                     f"refcount {page.refcount}")
             self._pending_offload.add(pid)
+        if self.lifecycle is not None and page_ids:
+            self.lifecycle.on_pin(len(page_ids))
 
     def release_offload_pin(self, page_ids: list[int]) -> None:
         """The deferred gather landed (or was abandoned): recycle the
         pinned pages. Idempotent — close paths may race the worker's
         own cleanup."""
+        released = 0
         for pid in page_ids:
             if pid not in self._pending_offload:
                 continue
             self._pending_offload.discard(pid)
+            released += 1
             page = self._pages.get(pid)
             if page is not None:
                 self._discard(page)
+        if self.lifecycle is not None and released:
+            self.lifecycle.on_unpin(released)
 
     def _discard(self, page: _Page) -> None:
         self._pages.pop(page.page_id, None)
@@ -293,10 +313,15 @@ class PagePool:
     def _evict_one(self) -> bool:
         return self._evict_many(1) == 1
 
-    def _evict_many(self, n: int, fire_hook: bool = True) -> int:
+    def _evict_many(self, n: int, fire_hook: bool = True,
+                    cause: str = "capacity-pressure") -> int:
         """Evict up to n LRU inactive pages; ONE offload-hook call for the
         whole batch (device data still intact when it fires).
-        ``fire_hook=False`` for admin clears: drop, don't offload."""
+        ``fire_hook=False`` for admin clears: drop, don't offload.
+        ``cause`` is lifecycle-recorder attribution only (capacity-
+        pressure = allocate_page, admission-deficit = allocate_sequence
+        pre-evict, clear = clear_inactive) — it never changes victim
+        selection."""
         victims: list[_Page] = []
         while len(victims) < n and self._inactive:
             pid, _ = self._inactive.popitem(last=False)   # LRU
@@ -314,11 +339,15 @@ class PagePool:
             self.evict_hook([(p.page_id, p.seq_hash) for p in registered])
         for page in registered:
             self._registered.pop(page.seq_hash, None)
+            if self.lifecycle is not None:
+                self.lifecycle.on_evict(page.seq_hash, cause)
             if self.event_sink is not None:
                 self.event_sink(KvCacheEvent(
                     kind=KV_REMOVED, worker_id=self.worker_id,
                     dp_rank=self.dp_rank, event_id=next(self._event_ids),
                     seq_hashes=[page.seq_hash]))
+                if self.lifecycle is not None:
+                    self.lifecycle.on_kv_event(KV_REMOVED, 1)
         for page in victims:
             # a hook that pinned the page (pin_for_offload) owns its
             # recycling; everything else frees immediately as before

@@ -43,6 +43,7 @@ from typing import Optional
 import numpy as np
 
 from dynamo_tpu.kvbm.tiers import TieredStore
+from dynamo_tpu.runtime.tracing import tracer
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
@@ -84,6 +85,9 @@ class KvbmStats:
     prefetched: int = 0         # blocks staged ahead of admission
     prefetch_hits: int = 0      # staged blocks consumed by onboard
     remote_prefetched: int = 0  # of prefetched, pulled from peers
+    # of prefetch_hits, blocks that were staged off a router prefix
+    # hint chain rather than a _waiting request's own hashes
+    prefetch_hint_hits: int = 0
 
 
 class KvbmManager:
@@ -126,7 +130,16 @@ class KvbmManager:
         self._staged: "OrderedDict[int, np.ndarray]" = OrderedDict()
         self._staged_bytes = 0
         self._prefetch_tasks: set = set()
+        # router prefix hints (satellite of the fleet-reuse direction):
+        # hashes staged off a hint chain, so their consumption counts as
+        # prefetch_hint_hits; seen-chain LRU bounds re-stage churn
+        self._hint_staged: set[int] = set()
+        self._hint_seen: OrderedDict[tuple, None] = OrderedDict()
         self._closed = False
+        # lifecycle flight recorder: owned by the engine (None unless
+        # DYN_KV_LIFECYCLE); the store shares it for tier transitions
+        self.lifecycle = getattr(engine, "kv_lifecycle", None)
+        self.store.lifecycle = self.lifecycle
         engine.pool.evict_hook = self._on_evict
         engine.kvbm = self
 
@@ -172,6 +185,7 @@ class KvbmManager:
             "offload_inline": self.stats.offload_inline,
             "prefetched": self.stats.prefetched,
             "prefetch_hits": self.stats.prefetch_hits,
+            "prefetch_hint_hits": self.stats.prefetch_hint_hits,
             "remote_prefetched": self.stats.remote_prefetched,
             "staged_blocks": len(self._staged),
             "staged_bytes": self._staged_bytes,
@@ -320,17 +334,26 @@ class KvbmManager:
                         # backpressure into the inline path
                         await asyncio.Event().wait()
                 t0 = time.perf_counter()
-                async with self.engine._device_lock:
-                    data = await asyncio.to_thread(
-                        self.engine._read_kv_pages_sync, page_ids)
+                tr = tracer()
+                span = tr.start_span(
+                    "kvbm.offload",
+                    attributes={"kvbm.blocks": len(pairs)}) \
+                    if tr.enabled else None
+                try:
+                    async with self.engine._device_lock:
+                        data = await asyncio.to_thread(
+                            self.engine._read_kv_pages_sync, page_ids)
 
-                def demote() -> None:
-                    for i, (_, seq_hash) in enumerate(pairs):
-                        self.store.put(
-                            seq_hash,
-                            np.ascontiguousarray(data[:, :, :, i]))
+                    def demote() -> None:
+                        for i, (_, seq_hash) in enumerate(pairs):
+                            self.store.put(
+                                seq_hash,
+                                np.ascontiguousarray(data[:, :, :, i]))
 
-                await self._run_io(demote)
+                    await self._run_io(demote)
+                finally:
+                    if span is not None:
+                        span.end()
                 self.stats.offloaded += len(pairs)
                 em = getattr(self.engine, "metrics", None)
                 if em is not None:
@@ -357,11 +380,19 @@ class KvbmManager:
 
     # -- onboard staging (prefetch) ----------------------------------------
 
-    def prefetch_waiting(self, waiting: list) -> None:
+    def prefetch_waiting(self, waiting: list,
+                         hints: Optional[list] = None) -> None:
         """Scheduler-loop kickoff: stage tier blocks for requests still
         queued in `_waiting` so their eventual admission onboard is one
         batched device write (disk reads and remote pulls happen here,
-        off the admission path). No-op unless prefetch_blocks > 0."""
+        off the admission path). No-op unless prefetch_blocks > 0.
+
+        `hints` is an optional list of seq-hash chains carried on routed
+        requests by the kv_router (request["extra"]["kv_hints"]) — the
+        router computed the prompt's block chain anyway, so the tiers
+        can warm up before admission even looks at the request; staged
+        blocks consumed from a hint chain count as prefetch_hint_hits
+        (the fleet-reuse direction's first measurable lever)."""
         if self.config.prefetch_blocks <= 0 or self._closed:
             return
         for seq in waiting[:8]:
@@ -375,8 +406,42 @@ class KvbmManager:
                 self._prefetch_seq(seq))
             self._prefetch_tasks.add(task)
             task.add_done_callback(self._prefetch_tasks.discard)
+        for chain in (hints or [])[:8]:
+            if not chain:
+                continue
+            key = (chain[-1], len(chain))
+            if key in self._hint_seen:
+                continue
+            self._hint_seen[key] = None
+            while len(self._hint_seen) > 256:
+                self._hint_seen.popitem(last=False)
+            task = asyncio.get_running_loop().create_task(
+                self._prefetch_hint([int(h) for h in chain]))
+            self._prefetch_tasks.add(task)
+            task.add_done_callback(self._prefetch_tasks.discard)
+
+    async def _prefetch_hint(self, hashes: list[int]) -> None:
+        """Stage the leading tier-resident run of a router hint chain.
+        Same staging buffer as _prefetch_seq — admission onboard is the
+        single convergence point — but staged hashes are tagged so
+        their consumption is attributable to the router hint."""
+        try:
+            dev = len(self.engine.pool.match_prefix(hashes))
+            limit = min(len(hashes), dev + self.config.prefetch_blocks)
+            if dev >= limit:
+                return
+            got = await self._run_io(self._read_chain, hashes[dev:limit])
+            fresh = [(h, d) for h, d in got if d is not None]
+            for h, d in fresh:
+                self._stage(h, d, hint=True)
+            self.stats.prefetched += len(fresh)
+        except Exception:
+            logger.exception("kvbm hint prefetch failed; admission will "
+                             "read the tiers directly")
 
     async def _prefetch_seq(self, seq) -> None:
+        tr = tracer()
+        span = tr.start_span("kvbm.prefetch") if tr.enabled else None
         try:
             ps = self.engine.model_cfg.page_size
             hashes = seq.prompt_hashes
@@ -405,6 +470,9 @@ class KvbmManager:
         except Exception:
             logger.exception("kvbm prefetch failed; admission will read "
                              "the tiers directly")
+        finally:
+            if span is not None:
+                span.end()
 
     def _read_chain(self, hashes: list[int]) -> list[tuple]:
         """(thread) leading run of tier reads; staged blocks count as
@@ -420,23 +488,34 @@ class KvbmManager:
             out.append((h, data))
         return out
 
-    def _stage(self, seq_hash: int, data) -> None:
+    def _stage(self, seq_hash: int, data, hint: bool = False) -> None:
         if seq_hash in self._staged:
             self._staged.move_to_end(seq_hash)
             return
         self._staged[seq_hash] = data
         self._staged_bytes += data.nbytes
+        if hint:
+            self._hint_staged.add(seq_hash)
+        if self.lifecycle is not None:
+            self.lifecycle.on_prefetch(
+                seq_hash, "hint_stage" if hint else "stage")
         # bound the buffer: a few waves' worth of prefetch, LRU-dropped
         # (dropping only costs a re-read — the tiers still hold the data)
         cap = max(self.config.prefetch_blocks, 1) * 8
         while len(self._staged) > cap:
-            _, old = self._staged.popitem(last=False)
+            old_hash, old = self._staged.popitem(last=False)
             self._staged_bytes -= old.nbytes
+            self._hint_staged.discard(old_hash)
 
     def _take_staged(self, seq_hash: int):
         data = self._staged.pop(seq_hash, None)
         if data is not None:
             self._staged_bytes -= data.nbytes
+            if seq_hash in self._hint_staged:
+                self._hint_staged.discard(seq_hash)
+                self.stats.prefetch_hint_hits += 1
+            if self.lifecycle is not None:
+                self.lifecycle.on_prefetch(seq_hash, "consume")
         return data
 
     # -- onboard (G2/G3 → G1) -----------------------------------------------
@@ -473,8 +552,19 @@ class KvbmManager:
         if not hits:
             return seq.cached_len
         t0 = time.perf_counter()
-        self._write_and_register(seq, start, hits)
+        tr = tracer()
+        span = tr.start_span(
+            "kvbm.onboard",
+            attributes={"kvbm.blocks": len(hits),
+                        "kvbm.source": "local"}) if tr.enabled else None
+        try:
+            self._write_and_register(seq, start, hits)
+        finally:
+            if span is not None:
+                span.end()
         self.stats.onboarded += len(hits)
+        if self.lifecycle is not None:
+            self.lifecycle.on_onboard(hashes[start:i], "local", ps)
         trace = getattr(seq, "trace", None)
         if trace is not None:
             if staged_hits:
@@ -538,9 +628,22 @@ class KvbmManager:
                     expect_shape=self.block_shape()))
             if not blocks_data:
                 return seq.cached_len
-            async with self.engine._device_lock:
-                self._write_and_register(seq, start, blocks_data)
+            tr = tracer()
+            span = tr.start_span(
+                "kvbm.onboard",
+                attributes={"kvbm.blocks": len(blocks_data),
+                            "kvbm.source": "remote"}) \
+                if tr.enabled else None
+            try:
+                async with self.engine._device_lock:
+                    self._write_and_register(seq, start, blocks_data)
+            finally:
+                if span is not None:
+                    span.end()
             self.stats.remote_onboarded += len(blocks_data)
+            if self.lifecycle is not None:
+                self.lifecycle.on_onboard(
+                    hashes[start:start + len(blocks_data)], "remote", ps)
             seq.cached_len = (start + len(blocks_data)) * ps
             trace = getattr(seq, "trace", None)
             if trace is not None:
@@ -578,6 +681,7 @@ class KvbmManager:
         self._offload_q_blocks = 0
         self._staged.clear()
         self._staged_bytes = 0
+        self._hint_staged.clear()
         if self._io_pool is not None:
             self._io_pool.shutdown(wait=False)
             self._io_pool = None

@@ -164,6 +164,13 @@ class TieredStore:
         self.host = HostTier(host_blocks)
         self.disk = DiskTier(disk_blocks, disk_dir) if disk_blocks else None
         self._lock = threading.RLock()
+        # KV lifecycle flight recorder (kvbm/lifecycle.py): None unless
+        # armed; set by KvbmManager. `_promoting` distinguishes the
+        # nested put inside `get`'s disk-hit path (a g3→g2 promote)
+        # from a fresh device offload (g1→g2 demote); it is only ever
+        # flipped under self._lock, so concurrent puts cannot misfile.
+        self.lifecycle = None
+        self._promoting = False
         # fired after ANY mutation of the held-block set (insert, LRU
         # displacement/drop, promotion) — the distributed advert
         # subscribes so it can never over-claim for long. May fire from a
@@ -182,11 +189,27 @@ class TieredStore:
 
     def put(self, seq_hash: int, data: np.ndarray) -> None:
         with self._lock:
-            for demoted_hash, demoted in self.host.put(seq_hash, data):
+            lc = self.lifecycle
+            fresh = lc is not None and not self.host.contains(seq_hash)
+            displaced = self.host.put(seq_hash, data)
+            if fresh:
+                if self._promoting:
+                    lc.on_promote(seq_hash, "g3", "g2")
+                else:
+                    lc.on_demote(seq_hash, "g1", "g2")
+            for demoted_hash, demoted in displaced:
                 if self.disk is not None:
+                    if lc is not None:
+                        if len(self.disk) >= self.disk.capacity \
+                                and not self.disk.contains(demoted_hash):
+                            # the disk LRU head falls off to make room
+                            lc.on_drop(next(iter(self.disk._lru)), "g3")
+                        lc.on_demote(demoted_hash, "g2", "g3")
                     self.disk.put(demoted_hash, demoted)
-                # disk-capacity unlinks and no-disk drops both shrink
-                # the set
+                elif lc is not None:
+                    # disk-capacity unlinks and no-disk drops both
+                    # shrink the set
+                    lc.on_drop(demoted_hash, "g2")
         self._changed()
 
     def get(self, seq_hash: int) -> Optional[np.ndarray]:
@@ -202,7 +225,11 @@ class TieredStore:
                 # the disk slot (a lingering entry would double-count the
                 # block against disk capacity and strand its file)
                 self.disk.pop(seq_hash)
-                self.put(seq_hash, data)   # fires _changed
+                self._promoting = True
+                try:
+                    self.put(seq_hash, data)   # fires _changed
+                finally:
+                    self._promoting = False
             return data
 
     def match_prefix(self, seq_hashes: list[int]) -> int:
@@ -225,6 +252,8 @@ class TieredStore:
                 dropped["g2"] = self.host.clear()
             if level in ("g3", "all") and self.disk is not None:
                 dropped["g3"] = self.disk.clear()
+            if dropped and self.lifecycle is not None:
+                self.lifecycle.on_tier_clear(dropped)
         if dropped:
             self._changed()
         return dropped

@@ -224,6 +224,16 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
         if not hasattr(runtime, "profile_engines"):
             runtime.profile_engines = []
         runtime.profile_engines.append(core)
+    # KV lifecycle surface (kvbm/lifecycle.py): always-on lifecycle
+    # counters join the scrape, and the tier-occupancy gauges refresh per
+    # scrape from the live pools (the recorder itself stays None unless
+    # DYN_KV_LIFECYCLE armed it at engine construction)
+    km = getattr(core, "kv_metrics", None)
+    if km is not None and hasattr(km, "register"):
+        from dynamo_tpu.kvbm.lifecycle import tier_occupancy
+
+        km.register(runtime.metrics,
+                    occupancy=lambda eng=core: tier_occupancy(eng))
     # one-token greedy canary (vllm health_check.py builds the same shape);
     # only probed when the runtime's health manager is enabled + idle.
     # The extra.canary marker lets sinks/metrics tell probes from traffic.

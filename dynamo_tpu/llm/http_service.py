@@ -119,6 +119,7 @@ class HttpService:
             web.get("/debug/requests", self._debug_requests),
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/router", self._debug_router),
+            web.get("/debug/kv", self._debug_kv),
             web.get("/openapi.json", self._openapi),
         ])
         # request-lifecycle debug view: in-flight dicts keyed by request
@@ -621,6 +622,32 @@ class HttpService:
                 capture_device_profile, secs)
         return web.json_response(body)
 
+    async def _debug_kv(self, request: web.Request) -> web.Response:
+        """KV lifecycle flight-recorder view (docs/observability.md "KV
+        lifecycle"): per-engine tier occupancy (always) plus — when
+        DYN_KV_LIFECYCLE arms the KvLifecycleRecorder — eviction causes,
+        reuse-distance histogram, tier residency, premature evictions,
+        and prefix hotness. `?limit=N` bounds each ring dump. 503 when
+        no in-proc engine is wired (frontend-only process — hit the
+        worker's surface)."""
+        if self.profile_engines is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no in-proc engine wired for kv lifecycle"},
+                status=503)
+        from dynamo_tpu.kvbm.lifecycle import kv_payload
+
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            limit = 256
+        payloads = [kv_payload(e, limit)
+                    for e in list(self.profile_engines() or [])]
+        return web.json_response({
+            "enabled": any(p.get("enabled") for p in payloads),
+            "engines": payloads,
+        })
+
     async def _debug_router(self, request: web.Request) -> web.Response:
         """Router decision flight-recorder view (docs/observability.md
         "Router observability"): per-model decision counters, index
@@ -742,6 +769,9 @@ class HttpService:
             "/debug/router": ("Router decision ring + placement/overlap "
                               "summary per kv-mode model (?limit=N)",
                               False),
+            "/debug/kv": ("KV lifecycle ring: tier occupancy, eviction "
+                          "causes, reuse distance, prefix hotness "
+                          "(?limit=N)", False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -108,6 +108,16 @@ class MockEngine:
         # steps record the same goodput/padding attribution the real
         # dispatch sites do, with _pow2 as the bucketing model
         self.step_recorder = recorder_from_env(self.metrics)
+        # KV lifecycle flight recorder parity (kvbm/lifecycle.py): the
+        # mock block pools record the same allocate/hit/evict/kv_event
+        # transitions, so the lifecycle math is analytically checkable
+        # chip-free. None unless DYN_KV_LIFECYCLE.
+        from dynamo_tpu.kvbm.lifecycle import KvbmMetrics
+        from dynamo_tpu.kvbm.lifecycle import \
+            recorder_from_env as kv_recorder_from_env
+        self.kv_metrics = KvbmMetrics()
+        self.kv_lifecycle = kv_recorder_from_env(self.kv_metrics)
+        self.kv.lifecycle = self.kv_lifecycle
         self._waiting: list[_MockRequest] = []
         self._running: list[_MockRequest] = []
         self._arrivals = 0

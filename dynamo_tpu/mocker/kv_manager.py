@@ -45,6 +45,11 @@ class MockKvManager:
         self._active: dict[int, _Block] = {}           # seq_hash -> block
         self._inactive: OrderedDict[int, _Block] = OrderedDict()  # LRU
         self._event_id = 0
+        # KV lifecycle flight recorder (kvbm/lifecycle.py): None unless
+        # DYN_KV_LIFECYCLE armed it (set by MockEngine); every touch is
+        # one `is not None` check and never changes pool behavior
+        self.lifecycle = None
+        self._alloc_seq = 0      # synthetic page ids for the recorder
 
     # -- accounting --------------------------------------------------------
 
@@ -83,6 +88,8 @@ class MockKvManager:
                 seq_hashes=[b.seq_hash for b in blocks],
             )
         self.event_sink(ev)
+        if self.lifecycle is not None:
+            self.lifecycle.on_kv_event(kind, len(blocks))
 
     # -- core ops ----------------------------------------------------------
 
@@ -127,34 +134,49 @@ class MockKvManager:
         overflow = (self.used_blocks - len(reactivate)) + len(needed) \
             - self.total_blocks
         if overflow > 0:
-            self._evict_lru(overflow, protect=set(reactivate))
+            self._evict_lru(overflow, protect=set(reactivate),
+                            cause="admission-deficit")
         stored: list[_Block] = []
+        lc = self.lifecycle
         for b in seq.blocks:
             blk = self._active.get(b.seq_hash)
             if blk is not None:
                 blk.ref_count += 1
+                if lc is not None:
+                    lc.on_hit(b.seq_hash, self.block_size)
                 continue
             blk = self._inactive.pop(b.seq_hash, None)
             if blk is not None:
                 blk.ref_count = 1
                 self._active[b.seq_hash] = blk
+                if lc is not None:
+                    lc.on_hit(b.seq_hash, self.block_size)
                 continue
             blk = _Block(b.seq_hash, b.local_hash, b.parent_seq_hash, 1)
             self._active[b.seq_hash] = blk
             stored.append(blk)
+            if lc is not None:
+                self._alloc_seq += 1
+                lc.on_allocate(self._alloc_seq)
+                lc.on_register(self._alloc_seq, b.seq_hash)
         self._emit(KV_STORED, stored)
         return True
 
     def append_block(self, seq_hash: int, local_hash: int,
                      parent_seq_hash: int) -> bool:
         """Add one newly-completed decode block for a running request."""
+        lc = self.lifecycle
         if seq_hash in self._active:
             self._active[seq_hash].ref_count += 1
+            if lc is not None:
+                lc.on_hit(seq_hash, self.block_size)
             return True
         blk = self._inactive.pop(seq_hash, None)
         if blk is not None:
             blk.ref_count = 1
             self._active[seq_hash] = blk
+            if lc is not None:
+                lc.on_hit(seq_hash, self.block_size)
             return True
         if len(self._active) + 1 > self.total_blocks:
             return False
@@ -162,6 +184,10 @@ class MockKvManager:
             self._evict_lru(1)
         blk = _Block(seq_hash, local_hash, parent_seq_hash, 1)
         self._active[seq_hash] = blk
+        if lc is not None:
+            self._alloc_seq += 1
+            lc.on_allocate(self._alloc_seq)
+            lc.on_register(self._alloc_seq, seq_hash)
         self._emit(KV_STORED, [blk])
         return True
 
@@ -177,7 +203,8 @@ class MockKvManager:
                 self._inactive[sh] = blk
                 self._inactive.move_to_end(sh)
 
-    def _evict_lru(self, n: int, protect: Optional[set[int]] = None) -> None:
+    def _evict_lru(self, n: int, protect: Optional[set[int]] = None,
+                   cause: str = "capacity-pressure") -> None:
         evicted = []
         for sh in list(self._inactive):
             if len(evicted) >= n:
@@ -185,9 +212,15 @@ class MockKvManager:
             if protect and sh in protect:
                 continue
             evicted.append(self._inactive.pop(sh))
+        if self.lifecycle is not None:
+            for blk in evicted:
+                self.lifecycle.on_evict(blk.seq_hash, cause)
         self._emit(KV_REMOVED, evicted)
 
     def clear(self) -> None:
         removed = list(self._inactive.values())
         self._inactive.clear()
+        if self.lifecycle is not None:
+            for blk in removed:
+                self.lifecycle.on_evict(blk.seq_hash, "clear")
         self._emit(KV_REMOVED, removed)

@@ -109,6 +109,10 @@ class RouterMetrics:
             "dynamo_router_snapshot_failures_total",
             "snapshot persists that raised (consumer survives; counted "
             "here)")
+        self.kv_event_gaps = c(
+            "dynamo_router_kv_event_gaps_total",
+            "KV events missed per worker (event_id discontinuities — the "
+            "prefix index silently diverged from that worker's cache)")
         self.index_blocks = Gauge(
             "dynamo_router_index_blocks",
             "cached blocks in the prefix index per worker")
@@ -126,8 +130,8 @@ class RouterMetrics:
                   self.overlap_ratio, self.candidates, self.logit_margin,
                   self.load_error, self.events, self.events_dropped,
                   self.snapshot_save, self.snapshot_restore,
-                  self.snapshot_failures, self.index_blocks,
-                  self.index_workers):
+                  self.snapshot_failures, self.kv_event_gaps,
+                  self.index_blocks, self.index_workers):
             registry.register(m)
         if index_stats is not None:
             def update() -> None:
@@ -347,6 +351,7 @@ def router_payload(push_router, limit: int = 256) -> dict:
             "events": _by_label(m.events, "stream"),
             "events_dropped": _by_label(m.events_dropped, "stream"),
             "snapshot_failures": m.snapshot_failures.get(),
+            "kv_event_gaps": _by_label(m.kv_event_gaps, "worker"),
         },
         "load_error": {
             "count": m.load_error.count,

@@ -205,8 +205,29 @@ class KvIndexer:
         self.tree = RadixTree() if use_native is False \
             else make_radix_tree()
         self.events_applied = 0
+        # per-worker event_id continuity: engines stamp stored/removed
+        # events from a monotone counter, so a jump means the bus
+        # dropped one and the index silently diverged from the worker's
+        # real cache — placement overlap is skewed until the blocks
+        # churn out. Events with id 0 (snapshot dumps, approx events)
+        # carry no sequencing and are skipped.
+        self._last_event_id: dict[WorkerKey, int] = {}
+        self.gaps: dict[WorkerKey, int] = {}     # worker -> missed events
+        self.on_gap = None       # callable(worker, missed) | None
 
     def apply_event(self, ev: KvCacheEvent) -> None:
+        eid = getattr(ev, "event_id", 0) or 0
+        if eid > 0:
+            w: WorkerKey = (ev.worker_id, ev.dp_rank)
+            last = self._last_event_id.get(w)
+            if last is not None and eid > last + 1:
+                missed = eid - last - 1
+                self.gaps[w] = self.gaps.get(w, 0) + missed
+                if self.on_gap is not None:
+                    self.on_gap(w, missed)
+            # eid <= last means the worker restarted (counter reset) or
+            # a snapshot replayed — resync, no gap
+            self._last_event_id[w] = eid
         self.tree.apply_event(ev)
         self.events_applied += 1
 

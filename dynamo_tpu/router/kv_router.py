@@ -114,6 +114,24 @@ class KvRouter:
         # per-decision ring is armed only by DYN_ROUTER_LOG.
         self.metrics = RouterMetrics()
         self.recorder: Optional[DecisionRecorder] = recorder_from_env()
+        # KV-event stream gap detection (indexer.py): a missed event means
+        # the index diverged from the worker's real cache until its blocks
+        # churn out. Count per worker; log once per worker so a lossy bus
+        # doesn't flood the log.
+        self._gap_logged: set[WorkerKey] = set()
+        if config.use_kv_events:
+            self.indexer.on_gap = self._on_event_gap
+
+    def _on_event_gap(self, worker: WorkerKey, missed: int) -> None:
+        self.metrics.kv_event_gaps.inc(missed, worker=worker_label(worker))
+        if worker not in self._gap_logged:
+            self._gap_logged.add(worker)
+            logger.warning(
+                "KV-event gap for worker %s: %d event(s) missed — prefix "
+                "index may over/under-credit this worker until its blocks "
+                "churn (logged once; further gaps only count in "
+                "dynamo_router_kv_event_gaps_total)",
+                worker_label(worker), missed)
 
     def register_metrics(self, registry) -> None:
         """Adopt the router metrics into a runtime registry; the prefix-
@@ -247,6 +265,10 @@ class KvRouter:
         applied = getattr(self.indexer, "events_applied", None)
         if applied is not None:
             out["events_applied"] = applied
+        gaps = getattr(self.indexer, "gaps", None)
+        if gaps:
+            out["event_gaps"] = {worker_label(w): n
+                                 for w, n in sorted(gaps.items())}
         return out
 
 
@@ -487,6 +509,18 @@ class KvPushRouter:
         })
         request = dict(request)
         request["dp_rank"] = dp_rank
+        if token_ids and self.config.use_kv_events:
+            # Prefix hint for the worker's KVBM (kvbm/manager.py
+            # prefetch_waiting): the router already chained-hashed the
+            # prompt for placement, so ship the seq-hash chain in `extra`
+            # (top-level unknown keys are dropped by
+            # PreprocessedRequest.from_dict) and the engine can stage
+            # matching offloaded blocks before the request is scheduled.
+            from dynamo_tpu.tokens import compute_seq_hashes
+            extra = dict(request.get("extra") or {})
+            extra["kv_hints"] = compute_seq_hashes(
+                token_ids, self.config.block_size)
+            request["extra"] = extra
         first = True
         try:
             async for item in self.push.direct(request, worker_id, ctx):

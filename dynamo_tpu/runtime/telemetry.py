@@ -208,6 +208,52 @@ def router_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     return out
 
 
+def _counter_by_label(snapshot: dict[str, dict], name: str,
+                      label: str) -> dict[str, float]:
+    m = snapshot.get(name)
+    if not m or m.get("type") != "counter":
+        return {}
+    out: dict[str, float] = {}
+    for lbl, v in m.get("values", []):
+        key = dict(lbl).get(label, "")
+        out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def kv_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """KV-cache memory-plane health from the lifecycle recorder's
+    always-on counters (kvbm/lifecycle.py). None when the component
+    never armed `DYN_KV_LIFECYCLE` — the fleet view stays unchanged for
+    unrecorded workers."""
+    events = _counter_total(snapshot, "dynamo_kv_lifecycle_events_total")
+    if not events:
+        return None
+    out: dict[str, Any] = {
+        "events": int(events),
+        "tokens_saved": int(_counter_total(
+            snapshot, "dynamo_kv_lifecycle_tokens_saved_total")),
+    }
+    ev = _counter_by_label(snapshot, "dynamo_kv_lifecycle_evictions_total",
+                           "cause")
+    if ev:
+        out["evictions"] = {k: int(v) for k, v in sorted(ev.items())}
+    prem = _counter_total(
+        snapshot, "dynamo_kv_lifecycle_premature_evictions_total")
+    if prem:
+        out["premature_evictions"] = int(prem)
+    rd = snapshot.get("dynamo_kv_lifecycle_reuse_distance")
+    if rd and rd.get("type") == "histogram" and rd.get("count"):
+        out["reuse_distance"] = {
+            "samples": rd["count"],
+            "p50": hist_quantile(rd["buckets"], rd["counts"], 0.5),
+        }
+    tiers = snapshot.get("dynamo_kvbm_tier_blocks")
+    if tiers and tiers.get("type") == "gauge":
+        out["tiers"] = {dict(lbl).get("tier", "?"): int(v)
+                        for lbl, v in tiers.get("values", [])}
+    return out
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -346,6 +392,9 @@ class TelemetryCollector:
             rs = router_summary(metrics)
             if rs is not None:
                 entry["router"] = rs
+            ks = kv_summary(metrics)
+            if ks is not None:
+                entry["kv"] = ks
             components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
@@ -362,6 +411,9 @@ class TelemetryCollector:
         fleet_rs = router_summary(merged)
         if fleet_rs is not None:
             out["fleet"]["router"] = fleet_rs
+        fleet_kv = kv_summary(merged)
+        if fleet_kv is not None:
+            out["fleet"]["kv"] = fleet_kv
         if slo is not None:
             out["slo"] = slo.status()
         return out

@@ -1,0 +1,687 @@
+"""KV lifecycle flight recorder (kvbm/lifecycle.py): ring semantics,
+byte-identical off path, eviction-cause attribution on both allocators,
+analytic reuse distance + premature evictions, tier residency, KV-event
+gap detection, hint-prefetch accounting, doctor kv rendering, the fleet
+kv block, and the /debug/kv surface."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.pages import PagePool
+from dynamo_tpu.kvbm.lifecycle import (
+    KvbmMetrics,
+    KvLifecycleRecorder,
+    kv_lifecycle_summary,
+    kv_payload,
+    recorder_from_env,
+    tier_occupancy,
+)
+from dynamo_tpu.protocols import (
+    KV_STORED,
+    KvCacheEvent,
+    PreprocessedRequest,
+    StoredBlock,
+)
+
+pytestmark = pytest.mark.tier0
+
+
+def H(i: int) -> int:
+    return 0x1000 + i
+
+
+# -- ring semantics ---------------------------------------------------------
+
+
+def test_ring_bound_and_eviction():
+    rec = KvLifecycleRecorder(capacity=16)
+    for i in range(40):
+        rec.on_allocate(i)
+    s = rec.summary()
+    assert s["events"] == 40
+    assert s["in_ring"] == 16
+    assert s["capacity"] == 16
+    assert s["evicted"] == 24
+    # cumulative analytics survive ring eviction: exact over all 40
+    assert s["allocations"] == 40
+    assert s["by_event"]["allocate"] == 40
+    assert len(rec.snapshot()) == 16
+    assert len(rec.snapshot(limit=4)) == 4
+
+
+def test_capacity_floor_and_env_gate():
+    assert KvLifecycleRecorder(capacity=1).capacity == 16
+    assert recorder_from_env(env={}) is None
+    assert recorder_from_env(env={"DYN_KV_LIFECYCLE": "0"}) is None
+    rec = recorder_from_env(env={"DYN_KV_LIFECYCLE": "1",
+                                 "DYN_KV_LIFECYCLE_RING": "64",
+                                 "DYN_KV_LIFECYCLE_PREMATURE": "8"})
+    assert rec is not None
+    assert rec.capacity == 64
+    assert rec.premature_window == 8
+    # junk values fall back to defaults rather than raising
+    rec = recorder_from_env(env={"DYN_KV_LIFECYCLE": "yes",
+                                 "DYN_KV_LIFECYCLE_RING": "nope"})
+    assert rec is not None and rec.capacity == 2048
+
+
+# -- analytic reuse distance + premature evictions --------------------------
+
+
+def test_reuse_distance_analytic():
+    m = KvbmMetrics()
+    rec = KvLifecycleRecorder(metrics=m)
+    rec.on_allocate(1)                 # alloc clock -> 1
+    rec.on_register(1, 42)             # 42 registered at clock 1
+    for i in range(5):
+        rec.on_allocate(2 + i)         # clock -> 6
+    rec.on_hit(42, 16)                 # distance 6 - 1 = 5 -> bucket <=8
+    rec.on_hit(42, 16)                 # distance 0 (hit refreshes clock)
+    s = rec.summary()
+    rd = s["reuse_distance"]
+    assert rd["samples"] == 2
+    assert rd["mean"] == 2.5
+    assert rd["counts"][rd["buckets"].index(8)] == 1
+    assert rd["counts"][rd["buckets"].index(0)] == 1
+    assert s["hits"] == 2 and s["tokens_saved"] == 32
+    # hotness table tracks the reused prefix
+    assert s["hotness"][0]["seq_hash"] == f"{42:016x}"
+    assert s["hotness"][0]["hits"] == 2
+    # mirrored into the always-on metrics
+    assert m.events.get(ev="hit") == 2
+    assert m.tokens_saved.get() == 32
+    assert m.reuse_distance.snapshot()[2] == 2
+
+
+def test_premature_eviction_window():
+    m = KvbmMetrics()
+    rec = KvLifecycleRecorder(metrics=m, premature_window=4)
+    rec.on_evict(7, "capacity-pressure")
+    rec.on_onboard([7], "local", 4)       # 0 allocs later: premature
+    rec.on_evict(8, "capacity-pressure")
+    for i in range(5):
+        rec.on_allocate(i)
+    rec.on_onboard([8], "local", 4)       # 5 > window: not premature
+    rec.on_onboard([7], "local", 4)       # demoted_at consumed: not again
+    s = rec.summary()
+    assert s["premature_evictions"] == 1
+    assert s["premature_window"] == 4
+    assert s["evictions"] == {"capacity-pressure": 2}
+    # every onboard still credits saved tokens
+    assert s["tokens_saved"] == 12
+    assert m.premature.get() == 1
+
+
+def test_residency_and_pins():
+    rec = KvLifecycleRecorder()
+    rec.on_register(1, 99)                # enters g1
+    rec.on_register(2, 98)
+    rec.on_evict(99, "clear")             # exits g1
+    rec.on_pin(3)
+    rec.on_unpin(2)
+    s = rec.summary()
+    assert s["residency"]["g1"]["samples"] == 1
+    assert s["residency"]["g1"]["mean_s"] >= 0.0
+    assert s["residency"]["g1"]["live"] == 1
+    assert s["pins"] == {"pinned": 3, "released": 2}
+
+
+# -- PagePool: cause attribution + byte-identical off path ------------------
+
+
+def _run_pool_script(armed: bool):
+    """Deterministic allocator workout hitting all three eviction causes;
+    returns everything observable from outside the recorder."""
+    events, hooks = [], []
+    pool = PagePool(6, 4, worker_id=7, event_sink=events.append)
+    pool.evict_hook = lambda batch: hooks.append(list(batch))
+    if armed:
+        pool.lifecycle = KvLifecycleRecorder(capacity=64)
+    # seq A: two fresh blocks, registered, released to the inactive LRU
+    pages_a, cached = pool.allocate_sequence([H(1), H(2)], 8)
+    assert cached == 0
+    for j, pid in enumerate(pages_a):
+        pool.register_page(pid, H(1 + j), 10 + j, H(j) if j else 0)
+    pool.release_sequence(pages_a)
+    # seq B reuses the H(1) prefix (one device hit) + three fresh blocks
+    pages_b, cached = pool.allocate_sequence([H(1), H(3), H(4), H(5)], 16)
+    assert cached == 4
+    for j in range(1, 4):
+        pool.register_page(pages_b[j], H(2 + j), 20 + j, H(1 + j))
+    pool.release_sequence(pages_b)
+    # seq C: free list empty -> pre-evicts its deficit (admission-deficit)
+    pages_c, _ = pool.allocate_sequence([H(6), H(7)], 8)
+    pool.release_sequence(pages_c)        # unregistered: freed, not cached
+    # direct allocation past the free list -> LRU evict (capacity-pressure)
+    for _ in range(3):
+        assert pool.allocate_page() is not None
+    # admin clear of what's left (clear; hook must NOT fire)
+    pool.clear_inactive()
+    return {
+        "events": [e.to_dict() for e in events],
+        "hooks": hooks,
+        "free": list(pool._free),
+        "registered": sorted(pool._registered),
+        "inactive": list(pool._inactive),
+    }
+
+
+def test_pagepool_cause_attribution():
+    armed = _pool_after_script()
+    s = armed.lifecycle.summary()
+    assert s["evictions"] == {"admission-deficit": 2,
+                              "capacity-pressure": 1, "clear": 2}
+    assert s["hits"] == 1
+    assert s["tokens_saved"] == 4           # one page-sized prefix hit
+    assert s["allocations"] == 10
+    assert s["by_event"]["register"] == 5
+    # KV events mirrored: 5 stored + 5 removed
+    assert s["by_event"]["kv_event"] == 10
+
+
+def _pool_after_script() -> PagePool:
+    pool = PagePool(6, 4, worker_id=7, event_sink=lambda e: None)
+    pool.lifecycle = KvLifecycleRecorder(capacity=64)
+    pages_a, _ = pool.allocate_sequence([H(1), H(2)], 8)
+    for j, pid in enumerate(pages_a):
+        pool.register_page(pid, H(1 + j), 10 + j, H(j) if j else 0)
+    pool.release_sequence(pages_a)
+    pages_b, _ = pool.allocate_sequence([H(1), H(3), H(4), H(5)], 16)
+    for j in range(1, 4):
+        pool.register_page(pages_b[j], H(2 + j), 20 + j, H(1 + j))
+    pool.release_sequence(pages_b)
+    pages_c, _ = pool.allocate_sequence([H(6), H(7)], 8)
+    pool.release_sequence(pages_c)
+    for _ in range(3):
+        pool.allocate_page()
+    pool.clear_inactive()
+    return pool
+
+
+def test_pagepool_byte_identical_when_unarmed():
+    """The determinism contract: arming the recorder must not change
+    eviction order, offload-hook batching, free-list state, or the
+    emitted KV-event bytes."""
+    off = _run_pool_script(armed=False)
+    on = _run_pool_script(armed=True)
+    assert off == on
+    # and the hook actually saw the admission-deficit + LRU batches
+    assert off["hooks"] == [[(2, H(2)), (1, H(1))], [(3, H(3))]]
+
+
+# -- MockKvManager parity ---------------------------------------------------
+
+
+def test_mock_kv_manager_cause_attribution():
+    from dynamo_tpu.mocker.kv_manager import MockKvManager
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    kv = MockKvManager(total_blocks=4, block_size=2)
+    rec = kv.lifecycle = KvLifecycleRecorder(capacity=64)
+    seq1 = TokenBlockSequence(2, [1, 2, 3, 4])            # 2 blocks
+    assert kv.allocate_sequence(seq1)
+    kv.free_sequence([b.seq_hash for b in seq1.blocks])   # -> inactive
+    assert kv.allocate_sequence(seq1)                     # 2 prefix hits
+    kv.free_sequence([b.seq_hash for b in seq1.blocks])
+    seq2 = TokenBlockSequence(2, [9, 8, 7, 6, 5, 4, 3, 2])  # 4 blocks
+    assert kv.allocate_sequence(seq2)     # overflow 2 -> admission-deficit
+    kv.free_sequence([b.seq_hash for b in seq2.blocks])
+    # pool full of inactive blocks: one decode append forces an LRU evict
+    assert kv.append_block(0x999, 0x99, seq2.blocks[-1].seq_hash)
+    kv.clear()
+    s = rec.summary()
+    assert s["evictions"]["admission-deficit"] == 2
+    assert s["evictions"]["capacity-pressure"] == 1
+    assert s["evictions"]["clear"] == 3
+    assert s["hits"] == 2
+    assert s["tokens_saved"] == 4
+    assert s["allocations"] == 7          # 2 + 4 fresh + 1 append
+
+
+# -- tier transitions (TieredStore) -----------------------------------------
+
+
+def test_tiered_store_demote_promote_drop_clear():
+    from dynamo_tpu.kvbm.tiers import TieredStore
+
+    rec = KvLifecycleRecorder(capacity=64)
+    store = TieredStore(host_blocks=2, disk_blocks=2)
+    store.lifecycle = rec
+    blk = np.arange(16, dtype=np.float32).reshape(2, 1, 1, 2, 4)
+    store.put(H(1), blk)                  # g1 -> g2
+    store.put(H(2), blk)                  # g1 -> g2
+    store.put(H(3), blk)                  # displaces H(1): g2 -> g3
+    store.put(H(4), blk)                  # displaces H(2): g2 -> g3
+    store.put(H(5), blk)                  # H(3) to disk; disk full: H(1) drops
+    assert store.get(H(2)) is not None    # disk hit: g3 -> g2 promote
+    store.clear("all")
+    ev = rec.summary()["by_event"]
+    # 5 fresh g1->g2 puts + 4 g2->g3 displacements (incl. the one the
+    # promote itself displaces)
+    assert ev["demote"] == 9
+    assert ev["promote"] == 1
+    assert ev["drop"] == 1
+    assert ev["tier_clear"] == 1
+    # residency recorded exits for both tiers
+    res = rec.summary()["residency"]
+    assert res["g2"]["samples"] >= 1
+    assert res["g3"]["samples"] >= 1
+
+
+def test_tiered_store_unchanged_when_unarmed():
+    from dynamo_tpu.kvbm.tiers import TieredStore
+
+    def run(armed):
+        store = TieredStore(host_blocks=2, disk_blocks=2)
+        if armed:
+            store.lifecycle = KvLifecycleRecorder()
+        blk = np.ones((2, 1, 1, 2, 4), dtype=np.float32)
+        for i in range(1, 6):
+            store.put(H(i), blk)
+        store.get(H(2))
+        return (sorted(store.host._blocks), sorted(store.disk._lru),
+                store.occupancy())
+
+    assert run(False) == run(True)
+
+
+# -- KV-event gap detection (router satellite) ------------------------------
+
+
+def _ev(eid, h, worker=1):
+    return KvCacheEvent(kind=KV_STORED, worker_id=worker, dp_rank=0,
+                        event_id=eid, parent_seq_hash=None,
+                        blocks=[StoredBlock(h, h & 0xFF)])
+
+
+def test_indexer_gap_detection():
+    from dynamo_tpu.router.indexer import KvIndexer
+
+    idx = KvIndexer(4, use_native=False)
+    seen = []
+    idx.on_gap = lambda w, n: seen.append((w, n))
+    idx.apply_event(_ev(1, H(1)))
+    idx.apply_event(_ev(2, H(2)))
+    assert idx.gaps == {}
+    idx.apply_event(_ev(5, H(3)))          # 3,4 missed
+    assert idx.gaps == {(1, 0): 2}
+    assert seen == [((1, 0), 2)]
+    idx.apply_event(_ev(6, H(4)))          # contiguous again
+    # id 0 events (snapshot restores, approx) carry no sequencing
+    idx.apply_event(KvCacheEvent(kind=KV_STORED, worker_id=1, dp_rank=0,
+                                 parent_seq_hash=None,
+                                 blocks=[StoredBlock(H(5), 5)]))
+    assert idx.gaps == {(1, 0): 2}
+    # counter reset = worker restart: resync without counting a gap
+    idx.apply_event(_ev(1, H(6)))
+    idx.apply_event(_ev(2, H(7)))
+    assert idx.gaps == {(1, 0): 2}
+    # workers are tracked independently
+    idx.apply_event(_ev(10, H(8), worker=2))
+    idx.apply_event(_ev(12, H(9), worker=2))
+    assert idx.gaps == {(1, 0): 2, (2, 0): 1}
+
+
+def test_router_gap_metric_and_stats():
+    from dynamo_tpu.router.kv_router import KvRouter, KvRouterConfig
+
+    r = KvRouter(KvRouterConfig(block_size=4))
+    r.apply_kv_event(_ev(1, H(1)))
+    r.apply_kv_event(_ev(4, H(2)))         # 2,3 missed
+    assert r.metrics.kv_event_gaps.get(worker="1:0") == 2
+    assert r.index_stats()["event_gaps"] == {"1:0": 2}
+    # a gapless router keeps the pre-existing stats shape
+    r2 = KvRouter(KvRouterConfig(block_size=4))
+    r2.apply_kv_event(_ev(1, H(1)))
+    assert "event_gaps" not in r2.index_stats()
+
+
+# -- hint prefetch (router -> KVBM satellite) -------------------------------
+
+
+def test_kv_hints_ride_extra_roundtrip():
+    from dynamo_tpu.tokens import compute_seq_hashes
+
+    hints = compute_seq_hashes(list(range(32)), 16)
+    assert len(hints) == 2
+    d = PreprocessedRequest(token_ids=list(range(32))).to_dict()
+    d["extra"] = {"kv_hints": hints}
+    back = PreprocessedRequest.from_dict(d)
+    assert back.extra["kv_hints"] == hints
+
+
+class _FakePool:
+    evict_hook = None
+    pending_offload_pages = 0
+
+    def match_prefix(self, hashes):
+        return []
+
+
+class _FakeCfg:
+    num_layers = 1
+    num_kv_heads = 1
+    page_size = 2
+    head_dim = 4
+
+
+class _FakeEngine:
+    def __init__(self, rec):
+        self.pool = _FakePool()
+        self.kv_lifecycle = rec
+        self.model_cfg = _FakeCfg()
+        self.perf = {}
+
+
+async def test_hint_prefetch_staging_and_attribution():
+    from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmManager
+
+    rec = KvLifecycleRecorder(capacity=64)
+    eng = _FakeEngine(rec)
+    mgr = KvbmManager(eng, KvbmConfig(host_blocks=8, prefetch_blocks=2))
+    blk = np.ones((2, 1, 1, 2, 4), dtype=np.float32)
+    mgr.store.put(H(1), blk)
+    mgr.store.put(H(2), blk)
+    # the router's hint chain stages the leading tier-resident run
+    mgr.prefetch_waiting([], hints=[[H(1), H(2)], [H(1), H(2)]])
+    await asyncio.gather(*mgr._prefetch_tasks)
+    assert mgr.stats.prefetched == 2      # the duplicate chain deduped
+    assert set(mgr._staged) == {H(1), H(2)}
+    assert mgr._hint_staged == {H(1), H(2)}
+    # consumption is attributed to the hint
+    assert mgr._take_staged(H(1)) is not None
+    assert mgr.stats.prefetch_hint_hits == 1
+    # a non-hint stage consumes without the hint credit
+    mgr._stage(H(9), blk)
+    mgr._take_staged(H(9))
+    assert mgr.stats.prefetch_hint_hits == 1
+    ev = rec.summary()["by_event"]
+    assert ev["prefetch_hint_stage"] == 2
+    assert ev["prefetch_stage"] == 1
+    assert ev["prefetch_consume"] == 2
+
+
+# -- payload / summary helpers ----------------------------------------------
+
+
+def test_tier_occupancy_and_payload_duck_typing():
+    from dynamo_tpu.kvbm.manager import KvbmConfig, KvbmManager
+
+    rec = KvLifecycleRecorder(capacity=64)
+    eng = _FakeEngine(rec)
+    mgr = KvbmManager(eng, KvbmConfig(host_blocks=8))
+    blk = np.ones((2, 1, 1, 2, 4), dtype=np.float32)
+    mgr.store.put(H(1), blk)
+    rec.on_allocate(1)
+    tiers = tier_occupancy(eng)
+    assert tiers["g2"]["blocks"] == 1 and tiers["g2"]["capacity"] == 8
+    p = kv_payload(eng, limit=8)
+    assert p["enabled"] is True
+    assert p["summary"]["allocations"] == 1
+    assert p["records"]
+    assert "pipeline" in p
+    summary = kv_lifecycle_summary(eng)
+    assert summary is not None and summary["tiers"]["g2"] == 1
+
+
+def test_payload_off_by_default():
+    class _Bare:
+        pool = None
+
+    p = kv_payload(_Bare())
+    assert p["enabled"] is False
+    assert "DYN_KV_LIFECYCLE" in p["hint"]
+    assert "summary" not in p
+    assert kv_lifecycle_summary(_Bare()) is None
+    # armed but silent: bench block stays absent (record shape identical)
+    class _Armed:
+        pool = None
+        kv_lifecycle = KvLifecycleRecorder()
+
+    assert kv_lifecycle_summary(_Armed()) is None
+
+
+# -- scrape-time tier gauges ------------------------------------------------
+
+
+def test_tier_gauges_refresh_on_scrape():
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    occ = {"g1": {"blocks": 3, "bytes": 96}}
+    m = KvbmMetrics()
+    reg = MetricsRegistry()
+    m.register(reg, occupancy=lambda: occ)
+    reg.collect()
+    assert m.tier_blocks.get(tier="g1") == 3
+    assert m.tier_bytes.get(tier="g1") == 96
+    occ["g1"]["blocks"] = 5
+    reg.collect()
+    assert m.tier_blocks.get(tier="g1") == 5
+    assert "dynamo_kvbm_tier_blocks" in reg.render()
+
+
+# -- doctor kv --------------------------------------------------------------
+
+
+def _armed_payload():
+    rec = KvLifecycleRecorder(capacity=64)
+    rec.on_allocate(1)
+    rec.on_register(1, H(1))
+    rec.on_allocate(2)
+    rec.on_hit(H(1), 16)
+    rec.on_evict(H(1), "capacity-pressure")
+    rec.on_onboard([H(1)], "local", 16)
+    rec.on_pin(2)
+    rec.on_unpin(1)
+
+    class _E:
+        kv_lifecycle = rec
+        pool = None
+
+    return kv_payload(_E())
+
+
+def test_doctor_kv_renders(tmp_path, capsys):
+    from dynamo_tpu.doctor.kv import main as kv_main
+
+    payload = _armed_payload()
+    payload["tiers"] = {"g1": {"blocks": 3, "capacity": 8,
+                               "bytes": 4 << 20}}
+    src = tmp_path / "kv.json"
+    src.write_text(json.dumps({"enabled": True, "engines": [payload]}))
+    assert kv_main([str(src)]) == 0
+    out = capsys.readouterr().out
+    assert "g1: 3/8 block(s) (37.5%) 4.0MiB" in out
+    assert "evictions: 1 (capacity-pressure=1)" in out
+    assert "WARN premature evictions" in out
+    assert "offload pins: 2 pinned / 1 released (WARN 1 still held)" in out
+    assert "reuse distance" in out
+    assert "hottest prefixes:" in out
+    # a raw single-engine capture renders through the same path
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(payload))
+    assert kv_main([str(raw)]) == 0
+    # disabled payload renders the arming hint
+    off = tmp_path / "off.json"
+    off.write_text(json.dumps({"enabled": False, "engines": [
+        {"enabled": False, "tiers": {},
+         "hint": "set DYN_KV_LIFECYCLE=1"}]}))
+    assert kv_main([str(off)]) == 0
+    assert "ring: disabled" in capsys.readouterr().out
+    # unusable input exits nonzero
+    assert kv_main([str(tmp_path / "missing.json")]) == 1
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert kv_main([str(empty)]) == 1
+
+
+def test_doctor_subcommand_dispatch(tmp_path, capsys):
+    from dynamo_tpu.doctor.__main__ import main as doctor_main
+
+    assert doctor_main(["kv", str(tmp_path / "missing.json")]) == 1
+    assert "cannot read" in capsys.readouterr().out
+
+
+# -- fleet plane ------------------------------------------------------------
+
+
+def test_fleet_status_kv_block():
+    import time as _time
+
+    from dynamo_tpu.runtime.telemetry import TelemetryCollector
+
+    col = TelemetryCollector(bus=None)
+    col.ingest({
+        "component": "mock", "instance": "w1", "role": "worker",
+        "at": _time.time(),
+        "metrics": {
+            "dynamo_kv_lifecycle_events_total": {
+                "type": "counter", "values": [[{"ev": "hit"}, 10]]},
+            "dynamo_kv_lifecycle_tokens_saved_total": {
+                "type": "counter", "values": [[{}, 640]]},
+            "dynamo_kv_lifecycle_evictions_total": {
+                "type": "counter",
+                "values": [[{"cause": "capacity-pressure"}, 3]]},
+            "dynamo_kv_lifecycle_premature_evictions_total": {
+                "type": "counter", "values": [[{}, 2]]},
+            "dynamo_kvbm_tier_blocks": {
+                "type": "gauge",
+                "values": [[{"tier": "g1"}, 5], [{"tier": "g2"}, 7]]},
+        }})
+    status = col.fleet_status()
+    ks = status["components"][0]["kv"]
+    assert ks["events"] == 10
+    assert ks["tokens_saved"] == 640
+    assert ks["evictions"] == {"capacity-pressure": 3}
+    assert ks["premature_evictions"] == 2
+    assert ks["tiers"] == {"g1": 5, "g2": 7}
+    assert status["fleet"]["kv"]["tokens_saved"] == 640
+    # unrecorded workers keep the pre-lifecycle payload shape
+    col2 = TelemetryCollector(bus=None)
+    col2.ingest({"component": "mock", "instance": "w2", "role": "worker",
+                 "at": _time.time(), "metrics": {}})
+    st2 = col2.fleet_status()
+    assert "kv" not in st2["components"][0]
+    assert "kv" not in st2["fleet"]
+
+
+def test_doctor_fleet_renders_kv(tmp_path, capsys):
+    from dynamo_tpu.doctor.fleet import main as fleet_main
+
+    status = {"components": [{"component": "mock", "instance": "w1",
+                              "role": "worker", "age_s": 1.0,
+                              "latency": {},
+                              "kv": {"events": 10, "tokens_saved": 640,
+                                     "evictions": {"capacity-pressure": 3},
+                                     "premature_evictions": 2,
+                                     "tiers": {"g1": 5, "g2": 7}}}],
+              "fleet": {"latency": {}}}
+    f = tmp_path / "status.json"
+    f.write_text(json.dumps(status))
+    assert fleet_main([str(f)]) == 0
+    out = capsys.readouterr().out
+    assert "kv_saved=640tok" in out
+    assert "evict=3" in out
+    assert "premature=2" in out
+    assert "tiers=g1:5,g2:7" in out
+
+
+# -- /debug/kv surface (full stack, MockEngine) -----------------------------
+
+
+async def test_debug_kv_endpoint(monkeypatch, tmp_path, capsys):
+    monkeypatch.setenv("DYN_KV_LIFECYCLE", "1")
+    import aiohttp
+
+    from dynamo_tpu.doctor.kv import main as kv_main
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin", migration_limit=1)
+    ev_sink, m_sink = wire_engine_events(rt, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=16),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    assert eng.kv_lifecycle is not None
+    handle = await serve_engine(rt, eng, card, instance_id=1)
+    fe = await start_frontend(rt)
+    try:
+        for _ in range(100):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            # prompt long enough to fill several complete KV blocks —
+            # the mock pool only records complete-block transitions
+            prompt = " ".join(f"tok{i}" for i in range(4 * 16))
+            body = {"model": "mock-model", "max_tokens": 8,
+                    "messages": [{"role": "user", "content": prompt}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+            async with s.get(f"{fe.url}/debug/kv") as r:
+                assert r.status == 200
+                data = await r.json()
+            assert data["enabled"] is True
+            p = data["engines"][0]
+            assert p["worker_id"] == 1
+            assert p["summary"]["allocations"] > 0
+            assert p["records"]
+            assert p["tiers"]["g1"]["capacity"] > 0
+            async with s.get(f"{fe.url}/debug/kv?limit=1") as r:
+                assert len((await r.json())["engines"][0]["records"]) == 1
+            async with s.get(f"{fe.url}/openapi.json") as r:
+                spec = await r.json()
+            assert "/debug/kv" in spec["paths"]
+            # doctor kv renders from the live url (fetched off-loop —
+            # urllib would block the loop serving the frontend) AND from
+            # a saved dump
+            assert await asyncio.to_thread(kv_main, [fe.url]) == 0
+            assert "worker 1:" in capsys.readouterr().out
+            dump = tmp_path / "kv.json"
+            dump.write_text(json.dumps(data))
+            assert kv_main([str(dump)]) == 0
+            assert "allocated" in capsys.readouterr().out
+        # bench's compact block is live off the same engine
+        summary = kv_lifecycle_summary(eng)
+        assert summary is not None and summary["allocations"] > 0
+        assert summary["tiers"]["g1"] >= 0
+    finally:
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt.close()
+
+
+async def test_kv_off_by_default(monkeypatch):
+    monkeypatch.delenv("DYN_KV_LIFECYCLE", raising=False)
+    from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+    from dynamo_tpu.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    eng = MockEngine(MockEngineConfig(speedup=1000.0))
+    assert eng.kv_lifecycle is None
+    assert eng.kv.lifecycle is None
+    r = PreprocessedRequest(token_ids=[1, 2, 3])
+    r.stop.max_tokens = 4
+    async for _ in eng.generate(r.to_dict(), Context()):
+        pass
+    await eng.close()
+    p = kv_payload(eng)
+    assert p["enabled"] is False and "hint" in p
+    assert kv_lifecycle_summary(eng) is None

@@ -4,7 +4,7 @@
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke \
-	profile-smoke router-smoke kv-smoke
+	profile-smoke router-smoke kv-smoke perf-gate perf-baseline
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -75,6 +75,24 @@ router-smoke:
 # chip-free).
 kv-smoke:
 	$(PYTEST) tests/test_kv_lifecycle.py
+
+# deterministic perf gate (docs/observability.md "Perf ledger &
+# regression gate"): run the chip-free perf phase (seeded virtual-clock
+# replay; scored metrics are analytic recorder counters, byte-identical
+# per seed) and hold it against the checked-in baseline with tight
+# per-metric thresholds. Exits nonzero and renders the doctor bench
+# delta table on any regression. Perf PRs that IMPROVE a metric rerun
+# `make perf-baseline` and commit the updated baseline.
+perf-gate:
+	JAX_PLATFORMS=cpu python -m dynamo_tpu.bench.perf \
+		--out /tmp/dynamo_perf_current.json
+	JAX_PLATFORMS=cpu python -m dynamo_tpu.doctor bench --gate \
+		benchmarks/perf_baseline.json /tmp/dynamo_perf_current.json
+
+# regenerate the gate baseline after an intentional perf change
+perf-baseline:
+	JAX_PLATFORMS=cpu python -m dynamo_tpu.bench.perf \
+		--out benchmarks/perf_baseline.json
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

@@ -1072,14 +1072,20 @@ async def phase_traffic():
     if kv_summaries:
         # fleet-level memory-plane totals; per-worker detail (reuse
         # distance, hotness, residency) stays in /debug/kv
+        allocs = sum(s["allocations"] for s in kv_summaries)
+        prem = sum(s["premature_evictions"] for s in kv_summaries)
         out["kv_lifecycle"] = {
             "events": sum(s["events"] for s in kv_summaries),
+            "allocations": allocs,
             "hits": sum(s["hits"] for s in kv_summaries),
             "tokens_saved": sum(s["tokens_saved"] for s in kv_summaries),
             "evictions": sum(sum(s["evictions"].values())
                              for s in kv_summaries),
-            "premature_evictions": sum(s["premature_evictions"]
-                                       for s in kv_summaries),
+            "premature_evictions": prem,
+            # the trajectory metric the perf ledger tracks
+            # (bench/ledger.py kv_premature_pct)
+            "premature_pct": round(100.0 * prem / allocs, 3)
+            if allocs else 0.0,
         }
     if summary["errors"]:
         out["error"] = f"{summary['errors']} replay errors: " \
@@ -1087,9 +1093,21 @@ async def phase_traffic():
     return out
 
 
+async def phase_perf():
+    """Deterministic chip-free perf phase (dynamo_tpu/bench/perf.py):
+    a seeded virtual-clock replay whose scored metrics are analytic
+    recorder counters — byte-identical per seed, so `doctor bench
+    --gate` can hold the checked-in benchmarks/perf_baseline.json to
+    tight thresholds with no chip attached."""
+    from dynamo_tpu.bench.perf import PerfConfig, run_perf
+
+    return run_perf(PerfConfig())
+
+
 PHASES = {"short": phase_short, "wide": phase_wide, "long": phase_long,
           "ckpt": phase_ckpt, "kv": phase_kv, "disagg": phase_disagg,
-          "quant": phase_quant, "traffic": phase_traffic}
+          "quant": phase_quant, "traffic": phase_traffic,
+          "perf": phase_perf}
 
 _MARK = "BENCH_PHASE_JSON: "
 
@@ -1179,15 +1197,26 @@ def main():
                       os.environ.get("DYN_BENCH_SKIP", "").split(",")))
     out = {"metric": "engine_output_tokens_per_sec_per_chip",
            "unit": "tok/s/chip"}
-    # traffic is chip-free; a traffic-only run needs no device preflight
-    if set(PHASES) - skip - {"traffic"}:
+    # traffic and perf are chip-free; runs reduced to them need no
+    # device preflight
+    if set(PHASES) - skip - {"traffic", "perf"}:
         pf = _device_preflight()
         if pf is not None:
             # distinct SKIPPED record: a wedged relay is an outage, not a
             # measurement — value stays null so the trajectory isn't
-            # polluted with fake zeros (BENCH_r04/r05)
+            # polluted with fake zeros (BENCH_r04/r05). The classified
+            # diagnosis rides along so `doctor bench` can say WHY the
+            # round is missing (axon-wedge vs timeout vs OOM) without
+            # string-matching the error.
+            from dynamo_tpu.doctor.preflight import classify
+
             out.update({"value": None, "vs_baseline": None,
-                        "skipped": True, "error": pf})
+                        "skipped": True, "error": pf,
+                        "preflight": classify(pf)})
+            # the chip-free phases still run on an outage round: the
+            # perf gate must keep guarding regressions even when the
+            # device is wedged
+            out["perf"] = _spawn_phase("perf")
             print(json.dumps(out), flush=True)
             return
 
@@ -1217,6 +1246,7 @@ def main():
     out["disagg"] = run("disagg")
     out["quant"] = run("quant")
     out["traffic"] = run("traffic")
+    out["perf"] = run("perf")
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,7 @@
+"""Perf ledger + deterministic chip-free perf gate (docs/observability.md).
+
+`ledger` normalizes every historical BENCH_*.json shape into one run
+record and computes per-metric deltas with noise bounds; `perf` is the
+seeded virtual-clock simulation whose scored metrics are analytic
+recorder counters, so the gate works with no chip attached.
+"""

@@ -1,0 +1,324 @@
+"""Bench-trajectory ledger (docs/observability.md "Perf ledger").
+
+BENCH_*.json files were write-only artifacts: five historical shapes
+(flat r01, rich r02, partial r03 with nested phase errors, outage
+r04/r05 with `value: 0.0` + a preflight error string, and the current
+bench.py shape with `value: null` + `skipped: true` + a machine-
+readable `preflight` block). `normalize_run` folds every one of them
+into a single `RunRecord` so `doctor bench` can render the whole
+trajectory honestly — outage rounds appear as outage rows with their
+preflight diagnosis, not as silent holes or fake zeros.
+
+Everything here is PURE math over parsed JSON: no clock, no network,
+no subprocess. Rendering lives in `dynamo_tpu/doctor/bench.py`.
+
+Two comparison planes:
+
+- **Trajectory deltas** (`trajectory_deltas`): consecutive-round deltas
+  for device-derived metrics, each with a per-metric *noise bound* —
+  wall-clock numbers off a shared TPU move a few percent run to run, so
+  a delta inside the bound renders as "~" (noise), not a verdict.
+- **The gate** (`gate_compare`): byte-deterministic perf records from
+  `dynamo_tpu.bench.perf` (analytic recorder counters, no wall clock)
+  compared against a checked-in baseline with tight per-metric
+  thresholds; any regression past threshold fails CI (`make perf-gate`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+PERF_SCHEMA = "dynamo-perf-v1"
+
+
+# ---------------------------------------------------------------------------
+# normalized run record
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunRecord:
+    label: str                      # "r01"… or filename stem
+    round: Optional[int]            # wrapper `n`; None for bare records
+    status: str                     # "ok" | "partial" | "outage"
+    value: Optional[float]          # tok/s/chip; None on outage
+    metrics: dict = field(default_factory=dict)   # key -> float
+    errors: list = field(default_factory=list)    # every error string found
+    diagnosis: Optional[dict] = None  # {"kind", "detail"} preflight classify
+    raw: dict = field(default_factory=dict)       # the unwrapped parsed dict
+
+
+@dataclass
+class MetricSpec:
+    key: str
+    label: str
+    unit: str
+    better: str                     # "higher" | "lower"
+    noise_rel: float                # trajectory noise bound (0 = analytic)
+    paths: tuple                    # probed in order over the parsed dict
+
+
+# Metric table for the trajectory view. Device-derived metrics carry a
+# noise bound (shared-TPU wall clocks wobble run to run); recorder
+# counters are analytic and get 0. Paths probe every historical shape.
+LEDGER_METRICS = (
+    MetricSpec("tok_s_chip", "tok/s/chip", "tok/s", "higher", 0.10,
+               (("value",),)),
+    MetricSpec("vs_device_loop", "vs device loop", "x", "higher", 0.05,
+               (("vs_device_loop",),)),
+    MetricSpec("ttft_ms", "TTFT p50", "ms", "lower", 0.15,
+               (("ttft_ms_unloaded_p50",),)),
+    MetricSpec("hbm_util_pct", "HBM util", "%", "higher", 0.10,
+               (("hbm_util_pct",),)),
+    MetricSpec("padded_pct", "padded tokens", "%", "lower", 0.0,
+               (("traffic", "step_profile", "padded_pct"),
+                ("long", "step_profile", "padded_pct"),
+                ("perf", "metrics", "engine", "padded_pct"))),
+    MetricSpec("goodput_tokens", "goodput tokens", "tok", "higher", 0.0,
+               (("traffic", "step_profile", "goodput_tokens"),
+                ("long", "step_profile", "goodput_tokens"),
+                ("perf", "metrics", "engine", "goodput_tokens"))),
+    MetricSpec("kv_premature_pct", "KV premature evict", "%", "lower", 0.0,
+               (("traffic", "kv_lifecycle", "premature_pct"),
+                ("perf", "metrics", "kv", "premature_pct"))),
+    MetricSpec("kv_tokens_saved", "KV tokens saved", "tok", "higher", 0.0,
+               (("traffic", "kv_lifecycle", "tokens_saved"),
+                ("long", "kv_lifecycle", "tokens_saved"),
+                ("perf", "metrics", "kv", "tokens_saved"))),
+    MetricSpec("router_tokens_saved", "router prefill saved", "tok",
+               "higher", 0.0,
+               (("traffic", "router", "tokens_saved"),
+                ("perf", "metrics", "router", "tokens_saved"))),
+)
+
+
+def _get(d: Any, path: tuple) -> Any:
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d
+
+
+def _num(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def _collect_errors(parsed: dict) -> list:
+    """Every error string anywhere in the record: top-level `error` /
+    `*_error` keys plus the same keys one phase-dict level down (r03
+    nests `ckpt.error` and `long.int4_error`)."""
+    out: list = []
+
+    def scan(d: dict) -> None:
+        for k in sorted(d):
+            v = d[k]
+            if (k == "error" or k.endswith("_error")) \
+                    and isinstance(v, str) and v:
+                out.append(v)
+
+    scan(parsed)
+    for k in sorted(parsed):
+        if isinstance(parsed.get(k), dict):
+            scan(parsed[k])
+    return out
+
+
+def normalize_run(data: dict, label: str = "") -> RunRecord:
+    """One RunRecord from any historical BENCH_*.json shape: the
+    `{n, cmd, rc, tail, parsed}` wrapper, a bare parsed dict, or the
+    current bench.py output (value:null + skipped + preflight block)."""
+    rnd = None
+    parsed = data
+    if isinstance(data.get("parsed"), dict):
+        rnd = data.get("n") if isinstance(data.get("n"), int) else None
+        parsed = data["parsed"]
+    if rnd is None and isinstance(parsed.get("n"), int):
+        rnd = parsed["n"]
+
+    errors = _collect_errors(parsed)
+    value = _num(parsed.get("value"))
+    top_error = parsed.get("error")
+    # outage shapes: current bench.py (`value: null` + `skipped: true`)
+    # and historical r04/r05 (`value: 0.0` + a top-level error string)
+    outage = parsed.get("value") is None or bool(parsed.get("skipped")) \
+        or (value == 0.0 and isinstance(top_error, str) and bool(top_error))
+    if outage:
+        status, value = "outage", None
+    elif errors:
+        status = "partial"          # r03: headline number + phase errors
+    else:
+        status = "ok"
+
+    diagnosis = None
+    pf = parsed.get("preflight")
+    if isinstance(pf, dict) and pf.get("kind"):
+        diagnosis = {"kind": pf["kind"], "detail": pf.get("detail", "")}
+    elif errors:
+        from dynamo_tpu.doctor.preflight import classify
+        diagnosis = classify(errors[0])
+
+    metrics: dict = {}
+    for spec in LEDGER_METRICS:
+        for path in spec.paths:
+            v = _num(_get(parsed, path))
+            if v is not None:
+                metrics[spec.key] = v
+                break
+    # derived: premature-eviction share of allocations, when the raw
+    # lifecycle block predates the precomputed pct
+    if "kv_premature_pct" not in metrics:
+        for phase in ("traffic", "long"):
+            kvl = parsed.get(phase, {}) if isinstance(
+                parsed.get(phase), dict) else {}
+            kvl = kvl.get("kv_lifecycle")
+            if isinstance(kvl, dict) and _num(kvl.get("allocations")):
+                prem = _num(kvl.get("premature_evictions")) or 0.0
+                metrics["kv_premature_pct"] = round(
+                    100.0 * prem / float(kvl["allocations"]), 3)
+                break
+    if status == "outage":
+        metrics.pop("tok_s_chip", None)
+
+    return RunRecord(label=label, round=rnd, status=status, value=value,
+                     metrics=metrics, errors=errors, diagnosis=diagnosis,
+                     raw=parsed)
+
+
+def load_run(path: str) -> RunRecord:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    label = stem[6:] if stem.lower().startswith("bench_") else stem
+    return normalize_run(data, label=label)
+
+
+# ---------------------------------------------------------------------------
+# trajectory deltas with noise bounds
+# ---------------------------------------------------------------------------
+
+
+def trajectory_deltas(records: list) -> list:
+    """Per-metric delta rows between consecutive rounds that BOTH carry
+    the metric (outage rounds don't break the chain — the next live
+    round compares against the last live one). Each row:
+    {metric, from, to, base, cur, delta_pct, verdict} where verdict is
+    "noise" inside the metric's noise bound, else "better"/"worse"."""
+    rows: list = []
+    last: dict = {}                  # metric key -> (label, value)
+    for rec in records:
+        for spec in LEDGER_METRICS:
+            v = rec.metrics.get(spec.key)
+            if v is None:
+                continue
+            prev = last.get(spec.key)
+            if prev is not None:
+                base_label, base = prev
+                delta = v - base
+                rel = abs(delta) / abs(base) if base else float(
+                    "inf") if delta else 0.0
+                if rel <= spec.noise_rel:
+                    verdict = "noise"
+                else:
+                    improved = (delta > 0) == (spec.better == "higher")
+                    verdict = "better" if improved else "worse"
+                rows.append({
+                    "metric": spec.key, "label": spec.label,
+                    "unit": spec.unit, "from": base_label,
+                    "to": rec.label, "base": base, "cur": v,
+                    "delta": round(delta, 4),
+                    "delta_pct": round(100.0 * rel, 2)
+                    if base else None,
+                    "noise_pct": round(100.0 * spec.noise_rel, 1),
+                    "verdict": verdict,
+                })
+            last[spec.key] = (rec.label, v)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# the deterministic gate
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GateSpec:
+    better: str                     # "higher" | "lower"
+    tol: float                      # allowed regression before failing
+    kind: str                       # "rel" (fraction) | "abs" (units)
+
+
+# Thresholds over `dynamo_tpu.bench.perf` records (dotted keys into
+# the record's `metrics` tree). The sim is byte-deterministic, so these
+# tolerances absorb *intentional semantic drift* (a scheduling change
+# that shifts batching by a hair), not measurement noise.
+GATE_THRESHOLDS = {
+    "engine.goodput_tokens":  GateSpec("higher", 0.02, "rel"),
+    "engine.padded_pct":      GateSpec("lower", 0.5, "abs"),
+    "engine.dispatches":      GateSpec("lower", 0.02, "rel"),
+    "engine.virtual_time_ms": GateSpec("lower", 0.02, "rel"),
+    "kv.hit_ratio_pct":       GateSpec("higher", 1.0, "abs"),
+    "kv.tokens_saved":        GateSpec("higher", 0.02, "rel"),
+    "kv.premature_pct":       GateSpec("lower", 0.5, "abs"),
+    "router.tokens_saved":    GateSpec("higher", 0.02, "rel"),
+}
+
+
+def flatten_metrics(tree: dict, prefix: str = "") -> dict:
+    """Nested metrics tree -> dotted numeric leaves (dicts of non-numeric
+    leaves, e.g. eviction-cause maps, flatten too; lists are skipped)."""
+    out: dict = {}
+    for k in sorted(tree):
+        v = tree[k]
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(flatten_metrics(v, prefix=f"{key}."))
+        else:
+            n = _num(v)
+            if n is not None:
+                out[key] = n
+    return out
+
+
+def gate_compare(baseline: dict, current: dict,
+                 thresholds: Optional[dict] = None) -> tuple:
+    """Compare two perf records. Returns (rows, failed): one row per
+    gated metric with {metric, base, cur, delta, allowed, ok}; `failed`
+    is True when any gated metric regressed past its threshold or went
+    missing from the current record. Improvements always pass."""
+    thresholds = GATE_THRESHOLDS if thresholds is None else thresholds
+    base_m = flatten_metrics(baseline.get("metrics", {}))
+    cur_m = flatten_metrics(current.get("metrics", {}))
+    rows: list = []
+    failed = False
+    for key in sorted(thresholds):
+        spec = thresholds[key]
+        b, c = base_m.get(key), cur_m.get(key)
+        if b is None:
+            continue                 # baseline never measured it
+        if c is None:
+            rows.append({"metric": key, "base": b, "cur": None,
+                         "delta": None, "allowed": None, "ok": False,
+                         "note": "missing from current record"})
+            failed = True
+            continue
+        delta = c - b
+        # signed regression amount: positive = worse
+        regress = -delta if spec.better == "higher" else delta
+        allowed = spec.tol * abs(b) if spec.kind == "rel" else spec.tol
+        ok = regress <= allowed
+        rows.append({"metric": key, "base": b, "cur": c,
+                     "delta": round(delta, 4),
+                     "allowed": round(allowed, 4), "ok": ok,
+                     "note": ""})
+        failed = failed or not ok
+    return rows, failed
+
+
+def is_perf_record(data: dict) -> bool:
+    return data.get("schema") == PERF_SCHEMA

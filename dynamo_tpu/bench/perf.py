@@ -1,0 +1,336 @@
+"""Deterministic chip-free perf phase (docs/observability.md).
+
+A synchronous virtual-clock replay of a seeded trafficgen schedule over
+a small simulated fleet, built from the *real* serving components:
+
+- placement: the genuine `DefaultWorkerSelector` cost function with a
+  seeded RNG + `MultiWorkerSequences` predicted-load tracking, every
+  decision captured by a real `DecisionRecorder`;
+- KV: one `MockKvManager` per worker (active/inactive pools, prefix
+  reuse, LRU eviction) with a real `KvLifecycleRecorder` attached;
+- engine cost model: the mocker's `_pow2` bucketing with the
+  MockEngine prefill/decode record shapes into real `StepRecorder`s.
+
+The scored record contains ONLY analytic counters — token/goodput/
+padding totals, dispatch counts, KV hit/eviction/premature ratios,
+router prefix-tokens-saved — plus virtual time derived from the cost
+model. No wall clock, no asyncio, no HTTP, no thread scheduling ever
+reaches the output, so two runs at the same seed are byte-identical
+and `doctor bench --gate` can hold a checked-in baseline to tight
+thresholds (ledger.GATE_THRESHOLDS). Wall-clock recorder fields
+(dispatch gaps, residency seconds, goodput tok/s) are deliberately
+never read.
+
+`bucket_floor` is the seeded-regression knob: raising it pads every
+prefill bucket and decode width up to at least that power of two,
+inflating padded-token share exactly the way a lazy bucketing ladder
+would — the gate must catch it (tests/test_perf_ledger.py pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+from dataclasses import asdict, dataclass, field
+
+from dynamo_tpu.engine.profiler import StepRecorder
+from dynamo_tpu.kvbm.lifecycle import KvLifecycleRecorder
+from dynamo_tpu.mocker.engine import _pow2
+from dynamo_tpu.mocker.kv_manager import MockKvManager
+from dynamo_tpu.router.decision_log import DecisionRecorder
+from dynamo_tpu.router.scheduler import (
+    DefaultWorkerSelector,
+    MultiWorkerSequences,
+    SelectorConfig,
+    WorkerLoad,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+from dynamo_tpu.trafficgen.schedule import (
+    TrafficConfig,
+    build_schedule,
+    prompt_token_ids,
+)
+
+from .ledger import PERF_SCHEMA
+
+# decode token ids live far above the prompt-id planes in
+# trafficgen.prompt_token_ids, so decode blocks never alias prompts
+_DECODE_BASE = 1 << 28
+
+
+@dataclass
+class PerfConfig:
+    seed: int = 11
+    workers: int = 4
+    total_kv_blocks: int = 192          # per worker; small → real evictions
+    block_size: int = 16
+    max_batch_size: int = 32
+    bucket_floor: int = 1               # regression knob (power-of-two floor)
+    max_requests: int = 160
+    prefill_us_per_token: float = 20.0
+    decode_ms_per_iter: float = 4.0
+    overlap_weight: float = 1.0
+    traffic: TrafficConfig = field(default_factory=lambda: TrafficConfig(
+        pattern="bursty", duration_s=30.0, base_rps=8.0, burst_rps=24.0,
+        seed=11, isl_mean=48, isl_sigma=0.6, isl_max=256,
+        osl_mean=24, osl_sigma=0.5, osl_max=96,
+        prefix_fraction=0.5, num_prefixes=4, prefix_len=64))
+
+
+@dataclass
+class _Lane:
+    seq: TokenBlockSequence
+    osl: int
+    emitted: int = 0
+
+
+def run_perf(cfg: PerfConfig) -> dict:
+    """One simulated replay → the scored perf record (pure given cfg)."""
+    tcfg = cfg.traffic
+    schedule = build_schedule(tcfg)[:cfg.max_requests]
+    floor = _pow2(max(cfg.bucket_floor, 1))
+
+    wkeys = [(i, 0) for i in range(cfg.workers)]
+    kv = {w: MockKvManager(cfg.total_kv_blocks, cfg.block_size,
+                           worker_id=w[0]) for w in wkeys}
+    steps = {w: StepRecorder(capacity=4096) for w in wkeys}
+    kv_recs = {w: KvLifecycleRecorder(capacity=4096) for w in wkeys}
+    for w in wkeys:
+        kv[w].lifecycle = kv_recs[w]
+    decisions = DecisionRecorder(capacity=4096)
+    selector = DefaultWorkerSelector(
+        SelectorConfig(overlap_weight=cfg.overlap_weight,
+                       temperature=0.0, block_size=cfg.block_size),
+        rng=random.Random(cfg.seed))
+    loads = MultiWorkerSequences(cfg.block_size)
+
+    shapes_seen: dict = {w: set() for w in wkeys}
+    lanes: dict = {w: {} for w in wkeys}         # rid -> _Lane
+    arrivals = list(schedule)
+    vclock = 0.0
+    completed = 0
+    admission_rejects = 0
+    append_fails = 0
+
+    def admit(req) -> None:
+        nonlocal vclock, admission_rejects
+        rid = f"perf-{req.index}"
+        ids = prompt_token_ids(req, tcfg)
+        seq = TokenBlockSequence(cfg.block_size, ids)
+        req_blocks = -(-len(ids) // cfg.block_size)
+        cands = []
+        for w in wkeys:
+            active = loads.peek(w)
+            cands.append(WorkerLoad(
+                worker=w,
+                overlap_blocks=kv[w].prefix_match_blocks(seq),
+                active_prefill_tokens=(active.active_prefill_tokens
+                                       if active else 0),
+                active_decode_blocks=(active.active_blocks
+                                      if active else 0),
+                total_kv_blocks=cfg.total_kv_blocks))
+        result = selector.select(req_blocks, cands)
+        w = result.worker
+        uncached = max(len(ids) - result.overlap_blocks * cfg.block_size, 0)
+        result.prefill_tokens = uncached
+        result.total_blocks = req_blocks
+        decisions.record_decision(
+            rid, result, cands, mode="route",
+            tokens_saved=result.overlap_blocks * cfg.block_size,
+            n_tokens=len(ids))
+        loads.add_request(rid, w, uncached, req_blocks)
+        # prefill dispatch, MockEngine cost model + bucket floor
+        bucket = max(_pow2(max(uncached, 1)), floor)
+        dt = bucket * cfg.prefill_us_per_token / 1e6
+        shape = (1, bucket)
+        fresh = shape not in shapes_seen[w]
+        shapes_seen[w].add(shape)
+        steps[w].record("prefill", shape, dt, good_tokens=uncached,
+                        work_tokens=bucket, lanes=1, width=1,
+                        compiled=fresh)
+        if not kv[w].allocate_sequence(seq):
+            admission_rejects += 1      # decode proceeds untracked by KV
+        loads.mark_prefill_completed(rid)
+        lanes[w][rid] = _Lane(seq=seq, osl=req.osl)
+        vclock += dt                    # prefills serialize on the sim clock
+
+    while arrivals or any(lanes[w] for w in wkeys):
+        if not any(lanes[w] for w in wkeys) and arrivals:
+            vclock = max(vclock, arrivals[0].at)
+        while arrivals and arrivals[0].at <= vclock:
+            admit(arrivals.pop(0))
+        # one decode iteration per worker with runnable lanes
+        step_s = cfg.decode_ms_per_iter / 1e3
+        for w in wkeys:
+            runnable = lanes[w]
+            if not runnable:
+                continue
+            width = min(max(_pow2(len(runnable)), floor),
+                        cfg.max_batch_size)
+            shape = (width, 1)
+            fresh = shape not in shapes_seen[w]
+            shapes_seen[w].add(shape)
+            steps[w].record("decode_burst", shape, step_s,
+                            good_tokens=len(runnable), work_tokens=width,
+                            lanes=len(runnable), width=width,
+                            tokens=len(runnable), compiled=fresh)
+            for rid in list(runnable):
+                lane = runnable[rid]
+                blk = lane.seq.append(_DECODE_BASE + lane.emitted)
+                lane.emitted += 1
+                if blk is not None:
+                    if not kv[w].append_block(blk.seq_hash, blk.local_hash,
+                                              blk.parent_seq_hash):
+                        append_fails += 1
+                if lane.emitted >= lane.osl:
+                    kv[w].free_sequence(lane.seq.seq_hashes())
+                    loads.free(rid)
+                    del runnable[rid]
+                    completed += 1
+        vclock += step_s
+
+    return _score(cfg, schedule, steps, kv_recs, decisions,
+                  completed=completed,
+                  admission_rejects=admission_rejects,
+                  append_fails=append_fails)
+
+
+def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
+           admission_rejects, append_fails) -> dict:
+    """Fold recorder summaries into the scored record. Only analytic
+    fields are read — never wall-clock ones (dispatch_gap, wall_span,
+    goodput_tok_s, residency)."""
+    good = work = dispatches = compiles = 0
+    virtual_s = 0.0
+    by_entry: dict = {}
+    for rec in steps.values():
+        s = rec.summary()
+        dispatches += s["recorded"]
+        for entry, e in s["entries"].items():
+            good += e["good_tokens"]
+            work += e["work_tokens"]
+            compiles += e["compiles"]
+            virtual_s += e["host_s"]
+            row = by_entry.setdefault(entry, {"count": 0, "good_tokens": 0,
+                                              "padded_tokens": 0})
+            row["count"] += e["count"]
+            row["good_tokens"] += e["good_tokens"]
+            row["padded_tokens"] += e["padded_tokens"]
+
+    kv_events = allocs = hits = saved = prem = 0
+    evictions: dict = {}
+    reuse_samples = 0
+    reuse_sum = 0.0
+    for rec in kv_recs.values():
+        s = rec.summary()
+        kv_events += s["events"]
+        allocs += s["allocations"]
+        hits += s["hits"]
+        saved += s["tokens_saved"]
+        prem += s["premature_evictions"]
+        for cause, n in s["evictions"].items():
+            evictions[cause] = evictions.get(cause, 0) + n
+        reuse_samples += s["reuse_distance"]["samples"]
+        reuse_sum += s["reuse_distance"]["mean"] \
+            * s["reuse_distance"]["samples"]
+
+    d = decisions.summary()
+    touches = hits + allocs
+
+    record = {
+        "schema": PERF_SCHEMA,
+        "seed": cfg.seed,
+        "workers": cfg.workers,
+        "requests": len(schedule),
+        "completed": completed,
+        "config": {
+            "bucket_floor": cfg.bucket_floor,
+            "block_size": cfg.block_size,
+            "total_kv_blocks": cfg.total_kv_blocks,
+            "max_batch_size": cfg.max_batch_size,
+            "prefill_us_per_token": cfg.prefill_us_per_token,
+            "decode_ms_per_iter": cfg.decode_ms_per_iter,
+            "traffic": asdict(cfg.traffic),
+        },
+        "metrics": {
+            "engine": {
+                "goodput_tokens": good,
+                "work_tokens": work,
+                "padded_tokens": work - good,
+                "padded_pct": round(100.0 * (work - good) / work, 3)
+                if work else 0.0,
+                "dispatches": dispatches,
+                "compiles": compiles,
+                "virtual_time_ms": round(virtual_s * 1e3, 3),
+                "by_entry": by_entry,
+            },
+            "kv": {
+                "events": kv_events,
+                "allocations": allocs,
+                "hits": hits,
+                "hit_ratio_pct": round(100.0 * hits / touches, 3)
+                if touches else 0.0,
+                "tokens_saved": saved,
+                "evictions": evictions,
+                "evictions_total": sum(evictions.values()),
+                "premature_evictions": prem,
+                "premature_pct": round(100.0 * prem / allocs, 3)
+                if allocs else 0.0,
+                "reuse_mean": round(reuse_sum / reuse_samples, 2)
+                if reuse_samples else 0.0,
+                "admission_rejects": admission_rejects,
+                "append_fails": append_fails,
+            },
+            "router": {
+                "decisions": d["decisions"],
+                "tokens_saved": d["tokens_saved"],
+                "mean_hit_ratio": d["overlap"]["mean_hit_ratio"],
+                "close_call_pct": d["margins"]["close_call_pct"],
+                "placement": {wkey: {"decisions": row["decisions"],
+                                     "share_pct": row["share_pct"]}
+                              for wkey, row in d["placement"].items()},
+            },
+        },
+    }
+    return record
+
+
+def record_to_json(record: dict) -> str:
+    """Canonical byte form: sorted keys, no trailing whitespace drift.
+    Equal records serialize to equal bytes — the determinism witness."""
+    return json.dumps(record, sort_keys=True, indent=1) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.bench.perf",
+        description="deterministic chip-free perf phase (analytic "
+                    "recorder counters; byte-identical per seed)")
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--requests", type=int, default=160)
+    p.add_argument("--bucket-floor", type=int, default=1,
+                   help="pad buckets/widths up to this power of two "
+                        "(regression-injection knob)")
+    p.add_argument("--out", default="-",
+                   help="output path; - for stdout")
+    args = p.parse_args(argv)
+
+    cfg = PerfConfig(seed=args.seed, workers=max(1, args.workers),
+                     bucket_floor=max(1, args.bucket_floor),
+                     max_requests=max(1, args.requests))
+    cfg.traffic.seed = args.seed
+    record = run_perf(cfg)
+    text = record_to_json(record)
+    if args.out == "-":
+        sys.stdout.write(text)
+    else:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

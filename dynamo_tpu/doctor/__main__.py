@@ -74,47 +74,61 @@ def _frontend(url: str):
     return f"healthy, models={models}"
 
 
+# Subcommand table: name -> (module under dynamo_tpu.doctor, help line).
+# Each module exposes `main(argv) -> int`; dispatch imports lazily so a
+# broken optional dep in one analyzer can't take down the others. Bare
+# `doctor` (no args) prints this list; `doctor check [...]` (or any
+# `--flag` start) runs the legacy environment health check below.
+SUBCOMMANDS: dict[str, tuple[str, str]] = {
+    "trace": ("trace",
+              "analyze a DYN_TRACE span JSONL file"),
+    "fleet": ("fleet",
+              "render the merged telemetry view from /fleet/status"),
+    "profile": ("profile",
+                "step flight-recorder ring from /debug/profile"),
+    "router": ("router",
+               "explain KV-aware placement from /debug/router, or "
+               "replay a KvRecorder capture"),
+    "kv": ("kv",
+           "KV-cache memory plane from /debug/kv: tiers, evictions, "
+           "reuse distance, hotness"),
+    "preflight": ("preflight",
+                  "probe the device backend from a child process "
+                  "(axon-wedge diagnosis)"),
+    "bench": ("bench",
+              "perf-ledger trajectory over BENCH_*.json; --gate "
+              "compares perf records against thresholds"),
+    "request": ("request",
+                "join trace spans + router decision + step/KV "
+                "recorder windows for one request"),
+}
+
+
+def _print_subcommands() -> None:
+    print("python -m dynamo_tpu.doctor <subcommand> [...]\n")
+    for name in sorted(SUBCOMMANDS):
+        print(f"  {name:<10} {SUBCOMMANDS[name][1]}")
+    print(f"  {'check':<10} environment health check "
+          "(--store/--frontend; also the default with flags)")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] == "trace":
-        # subcommand dispatch ahead of argparse: `doctor trace x.jsonl`
-        # analyzes a DYN_TRACE span file (doctor/trace.py)
-        from dynamo_tpu.doctor.trace import main as trace_main
+    if not argv:
+        _print_subcommands()
+        return 0
+    if argv[0] in SUBCOMMANDS:
+        import importlib
 
-        return trace_main(argv[1:])
-    if argv and argv[0] == "fleet":
-        # `doctor fleet <frontend-url|status.json>` renders the merged
-        # telemetry view served at /fleet/status (doctor/fleet.py)
-        from dynamo_tpu.doctor.fleet import main as fleet_main
-
-        return fleet_main(argv[1:])
-    if argv and argv[0] == "profile":
-        # `doctor profile <frontend-url|profile.json>` analyzes the
-        # step flight-recorder ring from /debug/profile
-        # (doctor/profile.py)
-        from dynamo_tpu.doctor.profile import main as profile_main
-
-        return profile_main(argv[1:])
-    if argv and argv[0] == "router":
-        # `doctor router <frontend-url|payload.json|events.jsonl>`
-        # explains KV-aware placement from /debug/router or replays a
-        # KvRecorder capture offline (doctor/router.py)
-        from dynamo_tpu.doctor.router import main as router_main
-
-        return router_main(argv[1:])
-    if argv and argv[0] == "kv":
-        # `doctor kv <frontend-url|dump.json>` explains the KV-cache
-        # memory plane from /debug/kv: tier occupancy, eviction causes,
-        # reuse distance, prefix hotness (doctor/kv.py)
-        from dynamo_tpu.doctor.kv import main as kv_main
-
-        return kv_main(argv[1:])
-    if argv and argv[0] == "preflight":
-        # `doctor preflight` probes the device backend from a child
-        # process with wedge diagnosis (doctor/preflight.py)
-        from dynamo_tpu.doctor.preflight import main as preflight_main
-
-        return preflight_main(argv[1:])
+        module, _ = SUBCOMMANDS[argv[0]]
+        mod = importlib.import_module(f"dynamo_tpu.doctor.{module}")
+        return mod.main(argv[1:])
+    if argv[0] == "check":
+        argv = argv[1:]
+    elif not argv[0].startswith("-"):
+        print(f"unknown subcommand {argv[0]!r}\n")
+        _print_subcommands()
+        return 2
     p = argparse.ArgumentParser(prog="python -m dynamo_tpu.doctor")
     p.add_argument("--store", default=None,
                    help="control-plane url to ping (tcp://host:port)")

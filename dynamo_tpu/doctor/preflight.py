@@ -39,6 +39,26 @@ WEDGE_HINT = ("axon relay wedged? see docs/ROUND4_NOTES.md — a client "
               "retrying")
 
 
+def classify(error: str) -> dict:
+    """Machine-readable diagnosis of a preflight/bench error string:
+    {"kind", "detail"} where kind is one of "axon-wedge", "timeout",
+    "oom", "other". bench.py attaches this to outage records and the
+    perf ledger uses it to tell r03's RESOURCE_EXHAUSTED from the
+    r04/r05 wedge — previously indistinguishable in the JSON."""
+    s = (error or "").strip()
+    low = s.lower()
+    if "axon relay wedged" in low or "wedge" in low:
+        kind = "axon-wedge"
+    elif "timed out" in low or "timeout" in low:
+        kind = "timeout"
+    elif "resource_exhausted" in low or "out of memory" in low \
+            or "oom" in low:
+        kind = "oom"
+    else:
+        kind = "other"
+    return {"kind": kind, "detail": s[:200]}
+
+
 def device_preflight(attempts: int = 2,
                      timeout_s: float = DEFAULT_TIMEOUT_S
                      ) -> Optional[str]:

@@ -1,0 +1,332 @@
+"""`python -m dynamo_tpu.doctor request <id> <sources...>` — one
+request across every flight recorder (docs/observability.md).
+
+The recorders share ids but nothing joined them until now: the
+request-lifecycle record carries the trace id, trace spans carry the
+request id, the router decision ring is keyed by request id, and the
+step/KV rings are windows in time on the routed worker. This
+subcommand takes a trace id (or request id) plus any mix of sources
+and renders a single where-did-the-milliseconds-go timeline:
+
+- a frontend base url — fetches ``/debug/requests``,
+  ``/debug/router``, ``/debug/profile`` and ``/debug/kv``;
+- a DYN_TRACE JSONL file — spans filtered to the trace;
+- saved JSON dumps of any of the four debug surfaces (shape-sniffed,
+  so argument order never matters).
+
+Exit 0 when at least one source matched the id; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+
+# ---------------------------------------------------------------------------
+# source loading / shape sniffing
+# ---------------------------------------------------------------------------
+
+
+def _fetch(url: str) -> Optional[dict]:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read())
+    except Exception as e:
+        print(f"doctor request: fetch {url} failed: {e!r}")
+        return None
+
+
+def gather_sources(sources: list) -> dict:
+    """{"requests": dict|None, "router": dict|None, "kv": dict|None,
+    "profile": dict|None, "spans": list} from urls, debug-surface JSON
+    dumps, and trace JSONL files."""
+    out = {"requests": None, "router": None, "kv": None,
+           "profile": None, "spans": []}
+    for src in sources:
+        if src.startswith("http://") or src.startswith("https://"):
+            base = src.rstrip("/")
+            for key, path in (("requests", "/debug/requests"),
+                              ("router", "/debug/router"),
+                              ("profile", "/debug/profile"),
+                              ("kv", "/debug/kv")):
+                body = _fetch(base + path)
+                if body is not None:
+                    out[key] = body
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"doctor request: cannot read {src}: {e!r}")
+            continue
+        body = None
+        try:
+            body = json.loads(text)
+        except json.JSONDecodeError:
+            pass
+        if isinstance(body, dict):
+            out[_sniff(body)] = body
+            continue
+        # not a single JSON document: treat as trace JSONL
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "traceId" in rec:
+                out["spans"].append(rec)
+    return out
+
+
+def _sniff(body: dict) -> str:
+    """Which debug surface a JSON dump came from, by shape."""
+    if "in_flight" in body or "recent" in body:
+        return "requests"
+    if "models" in body:
+        return "router"
+    engines = body.get("engines")
+    if isinstance(engines, list) and engines:
+        first = engines[0]
+        if isinstance(first, dict) and "tiers" in first:
+            return "kv"
+    return "profile"
+
+
+# ---------------------------------------------------------------------------
+# the join
+# ---------------------------------------------------------------------------
+
+
+def find_request(requests_body: Optional[dict], rid: str) -> Optional[dict]:
+    """Match by request_id OR trace_id across in-flight + recent."""
+    if not requests_body:
+        return None
+    rows = list(requests_body.get("in_flight") or []) \
+        + list(requests_body.get("recent") or [])
+    for rec in rows:
+        if rec.get("request_id") == rid or rec.get("trace_id") == rid:
+            return rec
+    return None
+
+
+def find_decision(router_body: Optional[dict], rid: str) -> Optional[dict]:
+    if not router_body:
+        return None
+    models = router_body.get("models")
+    if models is None:                  # bare router_payload dump
+        models = [router_body]
+    for m in models:
+        for rec in m.get("records") or []:
+            if rec.get("request_id") == rid:
+                return rec
+    return None
+
+
+def spans_for_trace(spans: list, trace_id: Optional[str]) -> list:
+    if not trace_id:
+        return []
+    mine = [s for s in spans if s.get("traceId") == trace_id]
+    mine.sort(key=lambda s: s.get("startTimeUnixNano") or 0)
+    return mine
+
+
+def _span_attr(span: dict, key: str) -> Optional[str]:
+    for a in span.get("attributes") or []:
+        if a.get("key") == key:
+            return (a.get("value") or {}).get("stringValue")
+    return None
+
+
+def window_events(records: list, t0: float, t1: float,
+                  time_key: str = "at") -> list:
+    return [r for r in records
+            if isinstance(r.get(time_key), (int, float))
+            and t0 <= r[time_key] <= t1]
+
+
+def correlate(sources: dict, rid: str) -> dict:
+    """The joined view. `rid` may be a trace id or a request id —
+    whichever record is found first supplies the other id."""
+    req = find_request(sources.get("requests"), rid)
+    trace_id = rid if not req else (req.get("trace_id") or rid)
+    request_id = req.get("request_id") if req else rid
+    decision = find_decision(sources.get("router"), request_id) \
+        or (find_decision(sources.get("router"), rid)
+            if rid != request_id else None)
+    spans = spans_for_trace(sources.get("spans") or [], trace_id)
+    if req is None and spans:
+        # trace-only join: recover the request id from the root span
+        for s in spans:
+            attr = _span_attr(s, "request.id")
+            if attr:
+                request_id = attr
+                if decision is None:
+                    decision = find_decision(sources.get("router"),
+                                             request_id)
+                break
+
+    # the request's wall window, for step/kv ring slicing
+    t0 = t1 = None
+    if req and isinstance(req.get("received_at"), (int, float)):
+        t0 = req["received_at"]
+        dur = req.get("duration_s")
+        t1 = t0 + (dur if isinstance(dur, (int, float)) else 0.0)
+    elif spans:
+        t0 = min(s["startTimeUnixNano"] for s in spans) / 1e9
+        t1 = max(s.get("endTimeUnixNano") or 0 for s in spans) / 1e9
+    if t1 is not None and t0 is not None and t1 < t0:
+        t1 = t0
+
+    kv_events: list = []
+    step_events: list = []
+    if t0 is not None:
+        body = sources.get("kv") or {}
+        for eng in body.get("engines") or []:
+            kv_events.extend(window_events(eng.get("records") or [],
+                                           t0, t1))
+        body = sources.get("profile") or {}
+        for eng in body.get("engines") or []:
+            step_events.extend(window_events(eng.get("records") or [],
+                                             t0, t1))
+        kv_events.sort(key=lambda r: r.get("at", 0.0))
+        step_events.sort(key=lambda r: r.get("at", 0.0))
+
+    return {"request": req, "decision": decision, "spans": spans,
+            "trace_id": trace_id, "request_id": request_id,
+            "window": (t0, t1), "kv_events": kv_events,
+            "step_events": step_events}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _ms(v) -> str:
+    return f"{v * 1e3:.2f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def render(joined: dict) -> str:
+    req = joined["request"]
+    decision = joined["decision"]
+    spans = joined["spans"]
+    lines = [f"request {joined['request_id']}  "
+             f"(trace {joined['trace_id'] or '-'})"]
+
+    if req:
+        lines.append(
+            f"  lifecycle [{req.get('status', '?')}]  "
+            f"endpoint={req.get('endpoint')}  model={req.get('model')}  "
+            f"ttft={_ms(req.get('first_token_s'))}  "
+            f"last_token={_ms(req.get('last_token_s'))}  "
+            f"total={_ms(req.get('duration_s'))}")
+        usage = req.get("usage") or {}
+        if usage:
+            lines.append(f"    usage: prompt={usage.get('prompt_tokens')}"
+                         f" completion={usage.get('completion_tokens')}")
+    else:
+        lines.append("  lifecycle: no /debug/requests record matched")
+
+    if decision:
+        lines.append(
+            f"  router → {decision.get('worker')}  "
+            f"overlap={decision.get('overlap_blocks')}/"
+            f"{decision.get('total_blocks')} blocks "
+            f"(hit {decision.get('prefix_hit_ratio')})  "
+            f"saved={decision.get('tokens_saved')} tok  "
+            f"margin={decision.get('logit_margin')}  "
+            f"ties={decision.get('ties')}")
+        cands = decision.get("candidates") or []
+        if cands:
+            row = ", ".join(
+                f"{c.get('worker')}: overlap={c.get('overlap_blocks')} "
+                f"logit={c.get('logit')}" for c in cands)
+            lines.append(f"    candidates: {row}")
+    else:
+        lines.append("  router: no decision record matched "
+                     "(DYN_ROUTER_LOG off, or id not in ring)")
+
+    if spans:
+        base = min(s["startTimeUnixNano"] for s in spans)
+        lines.append(f"  trace timeline ({len(spans)} spans; offsets "
+                     f"from root start)")
+        for s in spans:
+            off = (s["startTimeUnixNano"] - base) / 1e6
+            dur = ((s.get("endTimeUnixNano") or s["startTimeUnixNano"])
+                   - s["startTimeUnixNano"]) / 1e6
+            lines.append(f"    {off:9.2f}ms  {s['name']:<24} "
+                         f"{dur:9.2f}ms")
+            for ev in s.get("events") or []:
+                eoff = (ev.get("timeUnixNano", base) - base) / 1e6
+                lines.append(f"    {eoff:9.2f}ms    · {ev.get('name')}")
+    else:
+        lines.append("  trace: no spans matched (DYN_TRACE off, or "
+                     "trace file not passed)")
+
+    t0, t1 = joined["window"]
+    kv = joined["kv_events"]
+    if kv:
+        by_ev: dict = {}
+        for r in kv:
+            by_ev[r["ev"]] = by_ev.get(r["ev"], 0) + 1
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(by_ev.items()))
+        lines.append(f"  kv lifecycle in window: {len(kv)} events "
+                     f"({detail})")
+    elif t0 is not None:
+        lines.append("  kv lifecycle in window: none "
+                     "(DYN_KV_LIFECYCLE off, ring evicted, or idle)")
+
+    steps = joined["step_events"]
+    if steps:
+        by_entry: dict = {}
+        host = 0.0
+        good = work = 0
+        for r in steps:
+            by_entry[r["entry"]] = by_entry.get(r["entry"], 0) + 1
+            host += r.get("host_s", 0.0)
+            good += r.get("good_tokens", 0)
+            work += r.get("work_tokens", 0)
+        detail = ", ".join(f"{k}×{v}" for k, v in sorted(by_entry.items()))
+        padded = f", padded {100.0 * (work - good) / work:.1f}%" \
+            if work else ""
+        lines.append(f"  engine dispatches in window: {len(steps)} "
+                     f"({detail}) host={host * 1e3:.2f}ms{padded} "
+                     f"[engine-wide, not per-request]")
+    elif t0 is not None:
+        lines.append("  engine dispatches in window: none "
+                     "(DYN_STEP_PROFILE off, ring evicted, or idle)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor request",
+        description="join trace spans, the router decision, and the "
+                    "step/KV recorder windows for one request")
+    p.add_argument("id", help="trace id (32-hex) or request id")
+    p.add_argument("sources", nargs="+",
+                   help="frontend base url, trace JSONL file, and/or "
+                        "saved /debug/* JSON dumps, in any mix")
+    p.add_argument("--json", action="store_true",
+                   help="emit the joined record as JSON")
+    args = p.parse_args(argv)
+
+    sources = gather_sources(args.sources)
+    joined = correlate(sources, args.id)
+    matched = bool(joined["request"] or joined["decision"]
+                   or joined["spans"])
+    if args.json:
+        print(json.dumps(joined, indent=1, sort_keys=True, default=str))
+    else:
+        print(render(joined))
+    if not matched:
+        print(f"\nno source matched id {args.id!r}")
+        return 1
+    return 0

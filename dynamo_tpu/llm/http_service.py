@@ -116,6 +116,7 @@ class HttpService:
             web.get("/live", self._live),
             web.get("/metrics", self._metrics),
             web.get("/fleet/status", self._fleet_status),
+            web.get("/debug", self._debug_index),
             web.get("/debug/requests", self._debug_requests),
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/router", self._debug_router),
@@ -557,6 +558,49 @@ class HttpService:
         await resp.write_eof()
         return resp
 
+    async def _debug_index(self, request: web.Request) -> web.Response:
+        """Index of the live debug surfaces: which exist, which env
+        knob arms each flight recorder, and whether it is currently
+        armed on this process — so an operator never has to read docs
+        to discover what `/debug/*` offers or why a ring is empty."""
+        engines = list(self.profile_engines() or []) \
+            if self.profile_engines is not None else None
+        routers = self.manager.kv_routers()
+        surfaces = {
+            "/debug/requests": {
+                "what": "in-flight + recent request lifecycle timings",
+                "arm": None,                 # always on, bounded ring
+                "armed": True,
+                "available": True,
+            },
+            "/debug/profile": {
+                "what": "engine step flight recorder "
+                        "(goodput/padding, ?format=chrome, ?capture_s)",
+                "arm": "DYN_STEP_PROFILE=1",
+                "armed": any(getattr(e, "step_recorder", None)
+                             is not None for e in engines or []),
+                "available": engines is not None,
+            },
+            "/debug/router": {
+                "what": "router decision flight recorder "
+                        "(placement, overlap, margins)",
+                "arm": "DYN_ROUTER_LOG=1",
+                "armed": any(getattr(getattr(r, "router", r),
+                                     "recorder", None) is not None
+                             for r in routers.values()),
+                "available": bool(routers),
+            },
+            "/debug/kv": {
+                "what": "KV lifecycle flight recorder "
+                        "(tiers, evictions, reuse distance, hotness)",
+                "arm": "DYN_KV_LIFECYCLE=1",
+                "armed": any(getattr(e, "kv_lifecycle", None)
+                             is not None for e in engines or []),
+                "available": engines is not None,
+            },
+        }
+        return web.json_response({"surfaces": surfaces})
+
     async def _debug_requests(self, request: web.Request) -> web.Response:
         """Request-lifecycle debug view: every in-flight request plus a
         ring of recently finished ones, with per-stage timings
@@ -761,6 +805,8 @@ class HttpService:
             "/health": ("Model-serving readiness", False),
             "/live": ("Process liveness", False),
             "/metrics": ("Prometheus metrics", False),
+            "/debug": ("Index of debug surfaces with arming env knob "
+                       "and current armed state", False),
             "/debug/requests": ("In-flight + recent request lifecycle "
                                 "timings", False),
             "/debug/profile": ("Step flight-recorder ring + goodput/"

@@ -241,6 +241,14 @@ def kv_summary(snapshot: dict[str, dict]) -> Optional[dict]:
         snapshot, "dynamo_kv_lifecycle_premature_evictions_total")
     if prem:
         out["premature_evictions"] = int(prem)
+        # rate per allocation — the trajectory metric the perf ledger
+        # tracks (bench/ledger.py kv_premature_pct); the raw count is
+        # meaningless across components of different sizes
+        allocs = _counter_by_label(
+            snapshot, "dynamo_kv_lifecycle_events_total",
+            "ev").get("allocate", 0.0)
+        if allocs:
+            out["premature_pct"] = round(100.0 * prem / allocs, 3)
     rd = snapshot.get("dynamo_kv_lifecycle_reuse_distance")
     if rd and rd.get("type") == "histogram" and rd.get("count"):
         out["reuse_distance"] = {

@@ -170,6 +170,23 @@ def prompt_text(req: ScheduledRequest, cfg: TrafficConfig) -> str:
     return " ".join(words)
 
 
+def prompt_token_ids(req: ScheduledRequest, cfg: TrafficConfig,
+                     prefix_base: int = 1 << 20,
+                     unique_base: int = 1 << 24) -> list[int]:
+    """Token-id view of `prompt_text` for token-level consumers (the
+    chip-free perf simulation hashes these into KV blocks without a
+    tokenizer). Same sharing structure: requests with the same
+    prefix_id share their leading `prefix_len` ids exactly, and the
+    tail ids are unique per (request, position). Pure — no RNG."""
+    ids: list[int] = []
+    if req.prefix_id >= 0:
+        base = prefix_base + req.prefix_id * cfg.prefix_len
+        ids.extend(base + j for j in range(cfg.prefix_len))
+    base = unique_base + req.index * max(cfg.isl_max, req.isl)
+    ids.extend(base + j for j in range(req.isl))
+    return ids
+
+
 def schedule_to_jsonl(cfg: TrafficConfig,
                       reqs: list[ScheduledRequest]) -> str:
     """Header line (version + config) then one line per request. Keys

@@ -3,8 +3,9 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos kvbm-soak trace-smoke fleet-smoke autoscale-smoke \
-	profile-smoke router-smoke kv-smoke perf-gate perf-baseline
+.PHONY: tier0 tier1 chaos heal-smoke kvbm-soak trace-smoke fleet-smoke \
+	autoscale-smoke profile-smoke router-smoke kv-smoke perf-gate \
+	perf-baseline
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -17,11 +18,21 @@ tier1:
 
 # robustness gate (docs/robustness.md): deterministic fault injection
 # (seeded — every run sees the same faults) + the chaos soak, which
-# kills/stalls workers mid-stream and requires 100% of requests to
-# complete token-identically. tier0-marked, < 60 s.
-chaos:
+# kills/stalls/wedges workers mid-stream and requires 100% of requests
+# to complete token-identically — plus the self-healing suite
+# (heal-smoke). tier0-marked, < 60 s.
+chaos: heal-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
+
+# self-healing gate (docs/robustness.md "Watchdog & self-healing" /
+# "Degraded control plane"): dispatch-watchdog trip on a seeded wedge,
+# quarantine (deregister + stream abort + breaker purge), supervisor
+# respawn with crash-loop budget, corpse-first drain ordering,
+# stale-while-revalidate store degradation, KV-index gap resync, and
+# doctor preflight exit codes. Chip-free; off-by-default paths pinned.
+heal-smoke:
+	$(PYTEST) tests/test_healing.py
 
 # KVBM pipeline soak (docs/kvbm.md): loop admission/eviction with the
 # offload worker fault-delayed on every batch — output must stay

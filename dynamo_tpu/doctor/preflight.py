@@ -88,10 +88,21 @@ def device_preflight(attempts: int = 2,
     return last
 
 
+# distinct exit codes per classify() kind, so wrapper scripts (bench
+# orchestration, supervisor hooks) can branch without parsing output:
+# 0 = healthy, then one code per diagnosis; 1 stays reserved for
+# argparse/usage errors.
+EXIT_OK = 0
+EXIT_CODES = {"axon-wedge": 2, "timeout": 3, "oom": 4, "other": 5}
+
+
 def main(argv: list[str]) -> int:
     """`python -m dynamo_tpu.doctor preflight [--attempts N]
-    [--timeout S]` — exit 0 healthy, 1 wedged/broken."""
+    [--timeout S] [--json]` — exit 0 healthy; on failure the exit code
+    encodes the classify() kind (axon-wedge=2, timeout=3, oom=4,
+    other=5)."""
     import argparse
+    import json
 
     p = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.doctor preflight",
@@ -99,12 +110,28 @@ def main(argv: list[str]) -> int:
     p.add_argument("--attempts", type=int, default=2)
     p.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT_S,
                    help="seconds before a probe child is declared hung")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable verdict on stdout (one object: "
+                        "ok, kind, detail, elapsed_s, exit_code)")
     args = p.parse_args(argv)
     t0 = time.perf_counter()
     verdict = device_preflight(args.attempts, args.timeout)
     dt = time.perf_counter() - t0
     if verdict is None:
-        print(f"device preflight OK ({dt:.1f}s)")
-        return 0
-    print(f"device preflight FAILED ({dt:.1f}s): {verdict}")
-    return 1
+        if args.json:
+            print(json.dumps({"ok": True, "kind": "ok", "detail": "",
+                              "elapsed_s": round(dt, 3),
+                              "exit_code": EXIT_OK}))
+        else:
+            print(f"device preflight OK ({dt:.1f}s)")
+        return EXIT_OK
+    diag = classify(verdict)
+    rc = EXIT_CODES.get(diag["kind"], EXIT_CODES["other"])
+    if args.json:
+        print(json.dumps({"ok": False, "kind": diag["kind"],
+                          "detail": diag["detail"],
+                          "elapsed_s": round(dt, 3), "exit_code": rc}))
+    else:
+        print(f"device preflight FAILED ({dt:.1f}s) "
+              f"[{diag['kind']}]: {verdict}")
+    return rc

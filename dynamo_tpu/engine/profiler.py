@@ -178,6 +178,15 @@ class StepRecorder:
     def recorded(self) -> int:
         return self._recorded
 
+    def last_dispatch_pc(self) -> float:
+        """perf_counter of the last dispatch's end, 0.0 before the first
+        record (or after clear()). The dispatch watchdog
+        (engine/watchdog.py) polls this from its monitor thread to tell
+        "no dispatch has finished for N seconds with work pending" —
+        i.e. a wedged jitted call — from an idle engine."""
+        with self._lock:
+            return self._last_end_pc
+
     def summary(self) -> dict:
         """Aggregate attribution: cumulative per-entry totals (exact for
         the whole run), per-(entry, shape) padding table + dispatch-gap

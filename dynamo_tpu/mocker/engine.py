@@ -125,6 +125,14 @@ class MockEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self._progress = 0  # scheduler forward-progress token (canary)
+        # seeded chaos seam (runtime/faults.py kind=dispatch_wedge): the
+        # scheduler loop consults this once per iteration and parks when
+        # a wedge rule fires — the chip-free model of a jitted device
+        # call that never returns, for the dispatch watchdog to catch.
+        # None (the default, no DYN_FAULTS) costs one attribute check.
+        from dynamo_tpu.runtime.faults import FaultInjector
+
+        self.fault_injector = FaultInjector.from_env()
 
     # -- engine contract ---------------------------------------------------
 
@@ -202,6 +210,16 @@ class MockEngine:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
+            inj = self.fault_injector
+            if inj is not None and inj.on_dispatch(
+                    f"dispatch.{self.config.worker_id}") is not None:
+                # injected wedge: park with work pending, exactly like a
+                # hung device dispatch; only close() (cancel) frees us,
+                # so recovery MUST come from watchdog → quarantine
+                logger.error("[fault] dispatch wedge: scheduler parked "
+                             "with %d running / %d waiting",
+                             len(self._running), len(self._waiting))
+                await asyncio.Event().wait()
             self._admit()
             progressed = await self._prefill_new()
             progressed |= await self._decode_iter()

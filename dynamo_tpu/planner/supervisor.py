@@ -65,6 +65,20 @@ class SupervisorConfig:
     mock_default_max_tokens: int = 16
     # subprocess mode: extra args appended to every worker CLI
     worker_extra_args: list = field(default_factory=list)
+    # self-healing (docs/robustness.md "Watchdog & self-healing"): the
+    # health loop notices dead workers — subprocess exits (rc 44 =
+    # quarantined by the dispatch watchdog, 42/43 = engine/canary death)
+    # or task-mode engines flagged `_quarantined` / with a crashed
+    # scheduler task — and respawns them with exponential backoff. The
+    # crash-loop budget gives up after `crash_loop_budget` respawns
+    # inside `crash_loop_window_s` (a worker that wedges instantly every
+    # time needs an operator, not a supervisor hammering it).
+    respawn: bool = True
+    respawn_backoff_base: float = 0.2
+    respawn_backoff_max: float = 10.0
+    crash_loop_budget: int = 5
+    crash_loop_window_s: float = 60.0
+    health_poll_s: float = 0.25
 
 
 @dataclass
@@ -75,6 +89,7 @@ class _Worker:
     handle: object = None
     proc: object = None     # asyncio subprocess in subprocess mode
     started_at: float = 0.0
+    watchdog: object = None  # task-mode DispatchWatchdog (when armed)
 
 
 class FleetSupervisor:
@@ -93,10 +108,15 @@ class FleetSupervisor:
         self.scale_events: list[dict] = []
         self._watch = None
         self._task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
         self._ids = itertools.count(1)
         self._lock = asyncio.Lock()
         self._closed = False
         self.publisher = None
+        # pool → monotonic timestamps of recent respawns (crash-loop
+        # budget window); pools the budget has written off
+        self._respawns: dict[tuple[str, str], list[float]] = {}
+        self._given_up: set[tuple[str, str]] = set()
         # fleet gauges on the process registry (→ /metrics and, via the
         # telemetry publisher, /fleet/status)
         m = runtime.metrics
@@ -129,6 +149,9 @@ class FleetSupervisor:
             poll_interval=self.config.poll_interval)
         self._task = asyncio.get_running_loop().create_task(
             self._watch_loop())
+        if self.config.respawn:
+            self._health_task = asyncio.get_running_loop().create_task(
+                self._health_loop())
         if self.runtime.config.telemetry_interval > 0:
             from dynamo_tpu.runtime.telemetry import TelemetryPublisher
 
@@ -146,6 +169,8 @@ class FleetSupervisor:
             self._watch.cancel()
         if self._task is not None:
             self._task.cancel()
+        if self._health_task is not None:
+            self._health_task.cancel()
         if self.publisher is not None:
             await self.publisher.stop()
         async with self._lock:
@@ -209,9 +234,14 @@ class FleetSupervisor:
         while len(workers) < desired:
             workers.append(await self._spawn(comp, sub))
         while len(workers) > desired:
-            # newest-first teardown keeps the longest-lived (warmest
-            # prefix caches) instances serving
-            await self._drain(pool, workers.pop())
+            # prefer corpses (quarantined / crashed, not yet reaped by
+            # the health loop) — removing capacity must never tear down
+            # a healthy replica while a dead one still holds a slot;
+            # among the healthy, newest-first keeps the longest-lived
+            # (warmest prefix caches) instances serving
+            victim = self._pick_drain_victim(workers)
+            workers.remove(victim)
+            await self._drain(pool, victim)
         self._g_replicas.set(len(workers), pool=f"{comp}/{sub}")
         self._c_events.inc(direction=direction)
         self.scale_events.append({
@@ -219,6 +249,138 @@ class FleetSupervisor:
             "from": have, "to": desired, "revision": revision,
             "direction": direction,
         })
+
+    def _pick_drain_victim(self, workers: list[_Worker]) -> _Worker:
+        for w in workers:
+            if self._death_cause(w) is not None:
+                return w
+        return workers[-1]
+
+    # -- health loop: death detection + respawn ------------------------------
+
+    def _death_cause(self, worker: _Worker) -> Optional[str]:
+        """None while the worker looks alive; otherwise why it died."""
+        if worker.proc is not None:
+            rc = worker.proc.returncode
+            if rc is None:
+                return None
+            from dynamo_tpu.worker.quarantine import QUARANTINE_EXIT_CODE
+
+            if rc == QUARANTINE_EXIT_CODE:
+                return "quarantined"
+            if rc == 42:
+                return "engine-death"
+            if rc == 43:
+                return "canary"
+            return f"crashed rc={rc}"
+        engine = worker.engine
+        if engine is None:
+            return None
+        if getattr(engine, "_quarantined", False):
+            return "quarantined"
+        t = getattr(engine, "_loop_task", None)
+        if t is not None and t.done() and not t.cancelled() \
+                and t.exception() is not None:
+            return "scheduler-crash"
+        return None
+
+    async def _health_loop(self) -> None:
+        try:
+            while not self._closed:
+                await asyncio.sleep(self.config.health_poll_s)
+                dead: list[tuple[tuple[str, str], _Worker, str]] = []
+                async with self._lock:
+                    for pool, workers in list(self.pools.items()):
+                        for w in list(workers):
+                            cause = self._death_cause(w)
+                            if cause is None:
+                                continue
+                            workers.remove(w)
+                            comp, sub = pool
+                            self._g_replicas.set(len(workers),
+                                                 pool=f"{comp}/{sub}")
+                            dead.append((pool, w, cause))
+                for pool, w, cause in dead:
+                    logger.warning(
+                        "supervisor: worker %x in %s/%s is dead (%s)",
+                        w.instance_id, pool[0], pool[1], cause)
+                    await self._reap(w)
+                    try:
+                        await self._respawn(pool, w, cause)
+                    except Exception:
+                        # a failed respawn must not kill the health loop;
+                        # the attempt still counted against the budget
+                        logger.exception(
+                            "supervisor: respawn failed for %s/%s",
+                            pool[0], pool[1])
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("supervisor health loop died")
+
+    async def _reap(self, worker: _Worker) -> None:
+        """Collect what the death left behind. Everything is best-effort:
+        quarantine already deregistered and the process/loop is gone."""
+        if worker.proc is not None:
+            await worker.proc.wait()
+            return
+        if worker.watchdog is not None:
+            worker.watchdog.stop()
+        for closer in (getattr(worker.handle, "stop", None),
+                       getattr(worker.engine, "close", None)):
+            if closer is None:
+                continue
+            try:
+                await closer()
+            except Exception:
+                pass
+
+    async def _respawn(self, pool: tuple[str, str], dead: _Worker,
+                       cause: str) -> None:
+        cfg = self.config
+        comp, sub = pool
+        now = time.monotonic()
+        window = [t for t in self._respawns.get(pool, [])
+                  if now - t <= cfg.crash_loop_window_s]
+        if len(window) >= cfg.crash_loop_budget:
+            self._respawns[pool] = window
+            if pool not in self._given_up:
+                self._given_up.add(pool)
+                logger.error(
+                    "supervisor: crash-loop budget exhausted for %s/%s "
+                    "(%d respawns in %.0fs) — giving up; operator "
+                    "attention required", comp, sub, len(window),
+                    cfg.crash_loop_window_s)
+                self._c_events.inc(direction="giveup")
+                self.scale_events.append({
+                    "at": time.time(), "pool": f"{comp}/{sub}",
+                    "direction": "giveup", "cause": cause,
+                    "respawns_in_window": len(window),
+                })
+            return
+        window.append(now)
+        self._respawns[pool] = window
+        backoff = min(cfg.respawn_backoff_base * (2 ** (len(window) - 1)),
+                      cfg.respawn_backoff_max)
+        await asyncio.sleep(backoff)
+        async with self._lock:
+            if self._closed:
+                return
+            replacement = await self._spawn(comp, sub)
+            workers = self.pools.setdefault(pool, [])
+            workers.append(replacement)
+            self._g_replicas.set(len(workers), pool=f"{comp}/{sub}")
+        self._c_events.inc(direction="respawn")
+        self.scale_events.append({
+            "at": time.time(), "pool": f"{comp}/{sub}",
+            "direction": "respawn", "cause": cause,
+            "dead_instance": dead.instance_id,
+            "new_instance": replacement.instance_id,
+            "backoff_s": round(backoff, 3),
+        })
+        logger.info("supervisor: respawned %s/%s %x -> %x after %.2fs "
+                    "(%s)", comp, sub, dead.instance_id,
+                    replacement.instance_id, backoff, cause)
 
     # -- worker spawn/drain -------------------------------------------------
 
@@ -257,9 +419,30 @@ class FleetSupervisor:
                                            instance_id)
         handle = await serve_engine(self.runtime, engine, card,
                                     instance_id=instance_id)
-        return _Worker(instance_id=instance_id, component=component,
-                       engine=engine, handle=handle,
-                       started_at=time.time())
+        worker = _Worker(instance_id=instance_id, component=component,
+                         engine=engine, handle=handle,
+                         started_at=time.time())
+        # task-mode workers get their dispatch watchdog here (subprocess
+        # workers arm their own in worker/main.py): on trip, quarantine
+        # in-process — deregister, abort streams into Migration, flag
+        # `_quarantined` — and let the health loop respawn. None unless
+        # DYN_WATCHDOG_STALL_S is set (off-by-default).
+        from dynamo_tpu.engine.watchdog import watchdog_from_env
+
+        def _on_trip(event: dict, w: _Worker = worker) -> None:
+            from dynamo_tpu.worker.quarantine import quarantine_worker
+
+            asyncio.get_running_loop().create_task(quarantine_worker(
+                self.runtime, w.handle, w.engine,
+                reason=f"watchdog: {event.get('cause')}",
+                exit_process=False, watchdog=w.watchdog))
+
+        worker.watchdog = watchdog_from_env(
+            engine, runtime=self.runtime, instance=f"{instance_id:x}",
+            on_trip=_on_trip)
+        if worker.watchdog is not None:
+            worker.watchdog.start()
+        return worker
 
     async def _spawn_subprocess(self, component: str, sub: str,
                                 instance_id: int) -> _Worker:
@@ -305,6 +488,12 @@ class FleetSupervisor:
         """Graceful scale-down: deregister → drain → stop. A stream the
         grace period cuts off raises the transport's stream-error on the
         client side, which Migration replays on a surviving instance."""
+        if self._death_cause(worker) is not None:
+            # already a corpse: nothing to drain, just collect it
+            await self._reap(worker)
+            return
+        if worker.watchdog is not None:
+            worker.watchdog.stop()
         if worker.proc is not None:
             worker.proc.terminate()   # SIGTERM → run_until_signal drain
             try:

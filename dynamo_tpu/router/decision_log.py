@@ -113,6 +113,11 @@ class RouterMetrics:
             "dynamo_router_kv_event_gaps_total",
             "KV events missed per worker (event_id discontinuities — the "
             "prefix index silently diverged from that worker's cache)")
+        self.index_resyncs = c(
+            "dynamo_router_index_resyncs_total",
+            "full per-worker prefix-index rebuilds after an event gap "
+            "(gap_resync: drop the worker's blocks, replay the retained "
+            "event tail)")
         self.index_blocks = Gauge(
             "dynamo_router_index_blocks",
             "cached blocks in the prefix index per worker")
@@ -131,7 +136,8 @@ class RouterMetrics:
                   self.load_error, self.events, self.events_dropped,
                   self.snapshot_save, self.snapshot_restore,
                   self.snapshot_failures, self.kv_event_gaps,
-                  self.index_blocks, self.index_workers):
+                  self.index_resyncs, self.index_blocks,
+                  self.index_workers):
             registry.register(m)
         if index_stats is not None:
             def update() -> None:
@@ -352,6 +358,7 @@ def router_payload(push_router, limit: int = 256) -> dict:
             "events_dropped": _by_label(m.events_dropped, "stream"),
             "snapshot_failures": m.snapshot_failures.get(),
             "kv_event_gaps": _by_label(m.kv_event_gaps, "worker"),
+            "index_resyncs": _by_label(m.index_resyncs, "worker"),
         },
         "load_error": {
             "count": m.load_error.count,

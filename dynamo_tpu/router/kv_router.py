@@ -88,6 +88,11 @@ class KvRouterConfig:
     # for offline replay through `doctor router`; the DYN_KV_RECORD env
     # applies when unset here (KvPushRouter.start).
     kv_record_path: Optional[str] = None
+    # Escalate KV-event gaps from counting to repair: drop the gapped
+    # worker's blocks and rebuild its index slice by replaying the event
+    # bus's retained tail (docs/robustness.md "Degraded control plane").
+    # Off by default: counting-only, current behavior byte-for-byte.
+    gap_resync: bool = False
 
 
 class KvRouter:
@@ -119,6 +124,9 @@ class KvRouter:
         # churn out. Count per worker; log once per worker so a lossy bus
         # doesn't flood the log.
         self._gap_logged: set[WorkerKey] = set()
+        # set by KvPushRouter when config.gap_resync: callable(worker)
+        # that schedules a full per-worker index rebuild
+        self.request_resync = None
         if config.use_kv_events:
             self.indexer.on_gap = self._on_event_gap
 
@@ -132,6 +140,8 @@ class KvRouter:
                 "churn (logged once; further gaps only count in "
                 "dynamo_router_kv_event_gaps_total)",
                 worker_label(worker), missed)
+        if self.config.gap_resync and self.request_resync is not None:
+            self.request_resync(worker)
 
     def register_metrics(self, registry) -> None:
         """Adopt the router metrics into a runtime registry; the prefix-
@@ -296,6 +306,9 @@ class KvPushRouter:
         # consumer crash-proofing: first failure per stream logs with a
         # traceback, the rest only count in events_dropped
         self._logged_streams: set[str] = set()
+        # workers with an index resync in flight (gap_resync): a gapped
+        # stream keeps gapping while the rebuild runs — one at a time
+        self._resyncing: set[WorkerKey] = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -318,6 +331,8 @@ class KvPushRouter:
             # router wins a name (same contract as EngineMetrics)
             self.router.register_metrics(reg)
         await self._restore_snapshot()
+        if self.config.gap_resync and self.config.use_kv_events:
+            self.router.request_resync = self._schedule_resync
         loop = asyncio.get_running_loop()
         if self.config.use_kv_events:
             sub = await self.bus.subscribe(
@@ -346,6 +361,63 @@ class KvPushRouter:
         else:
             self.router.add_worker(
                 inst.instance_id, inst.metadata.get("dp_size", 1))
+
+    # -- gap-triggered index resync (config.gap_resync) ----------------------
+
+    def _schedule_resync(self, worker: WorkerKey) -> None:
+        """Called from inside apply_event (the gap was just detected):
+        must not block, must not recurse — schedule a task, one per
+        worker at a time."""
+        if worker in self._resyncing:
+            return
+        self._resyncing.add(worker)
+        self._tasks.append(asyncio.get_running_loop().create_task(
+            self._resync_worker(worker)))
+
+    async def _resync_worker(self, worker: WorkerKey) -> None:
+        """Rebuild one worker's slice of the prefix index from scratch:
+        drop its blocks (a gap means we no longer know which of them are
+        real), forget its event cursor, then replay the bus's retained
+        tail filtered to this worker. Bounded divergence: events older
+        than the retention window are gone, but so (overwhelmingly) are
+        the blocks they described."""
+        try:
+            idx = self.router.indexer
+            idx.remove_worker(worker)
+            idx._last_event_id.pop(worker, None)
+            sub = await self.bus.subscribe(
+                kv_events_subject(self._ns, self._component),
+                from_start=True)
+            applied = 0
+            try:
+                while True:
+                    try:
+                        msg = sub.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if msg is None:
+                        break
+                    try:
+                        ev = KvCacheEvent.from_dict(msg["payload"])
+                    except Exception:
+                        continue
+                    if (ev.worker_id, ev.dp_rank) != worker:
+                        continue
+                    self.router.apply_kv_event(ev)
+                    applied += 1
+            finally:
+                sub.cancel()
+            self.router.metrics.index_resyncs.inc(
+                worker=worker_label(worker))
+            logger.warning(
+                "prefix index for worker %s resynced from the retained "
+                "event tail (%d event(s) reapplied)",
+                worker_label(worker), applied)
+        except Exception:
+            logger.exception("index resync failed for worker %s",
+                             worker_label(worker))
+        finally:
+            self._resyncing.discard(worker)
 
     # -- background consumers ----------------------------------------------
     #

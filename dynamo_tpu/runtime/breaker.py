@@ -110,6 +110,16 @@ class CircuitBreaker:
             e.retry_at = self.clock() + self.cooldown
             self._transition(key, e, OPEN)
 
+    def reset(self, key: str) -> bool:
+        """Forget an instance's entry entirely. Called when the instance
+        deregisters (quarantine, scale-down, lease expiry): a respawned
+        worker that comes back under the same subject must start closed
+        with a zero failure count, not inherit the corpse's open breaker
+        and wait out a cooldown it never earned. Also keeps the entry
+        map bounded under instance churn. Returns True if an entry
+        existed. Lifetime transition counters are deliberately kept."""
+        return self._entries.pop(key, None) is not None
+
     # -- introspection -------------------------------------------------------
 
     def state(self, key: str) -> str:

@@ -182,6 +182,7 @@ class EndpointClient:
         }
         self._watch: Optional[Watch] = None
         self._watch_task: Optional[asyncio.Task] = None
+        self._revalidate_task: Optional[asyncio.Task] = None
         self._ready = asyncio.Event()
         if static_instances is not None:
             self._ready.set()
@@ -201,7 +202,47 @@ class EndpointClient:
             self._instances[inst.instance_id] = inst
         self._ready.set()
         self._watch_task = asyncio.get_running_loop().create_task(self._run())
+        interval = getattr(self.endpoint.runtime.config,
+                           "instance_revalidate_s", 0.0)
+        if interval > 0:
+            self._revalidate_task = asyncio.get_running_loop().create_task(
+                self._revalidate(interval))
         return self
+
+    async def _revalidate(self, interval: float) -> None:
+        """Stale-while-revalidate for the instance snapshot. The request
+        path always serves from `self._instances` (never touches the
+        store), so a dead coordinator cannot stop routing — this loop
+        just measures how stale that snapshot is: each tick re-reads the
+        prefix; success reconciles the dict and clears the runtime's
+        degradation flag, ConnectionError raises it (note_store_error)
+        and leaves the snapshot untouched."""
+        rt = self.endpoint.runtime
+        store = rt.store
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                kvs = await store.get_prefix(self.endpoint.instance_prefix)
+            except ConnectionError:
+                rt.note_store_error(
+                    f"revalidate {self.endpoint.instance_prefix}")
+                continue
+            except asyncio.CancelledError:
+                raise
+            rt.note_store_ok()
+            fresh = {}
+            for kv in kvs:
+                inst = Instance.from_json(kv.value)
+                fresh[inst.instance_id] = inst
+            for iid in list(self._instances):
+                if iid not in fresh:
+                    inst = self._instances.pop(iid)
+                    self._purge_breaker(inst)
+                    self._emit(DELETE, inst)
+            for iid, inst in fresh.items():
+                if iid not in self._instances:
+                    self._instances[iid] = inst
+                    self._emit(PUT, inst)
 
     async def _run(self) -> None:
         from dynamo_tpu.runtime.store import RESET
@@ -216,6 +257,7 @@ class EndpointClient:
                 iid = int(ev.key.rsplit("/", 1)[-1], 16)
                 inst = self._instances.pop(iid, None)
                 if inst is not None:
+                    self._purge_breaker(inst)
                     self._emit(DELETE, inst)
             elif ev.kind == RESET:
                 # coordinator restarted: the empty store will never send
@@ -223,8 +265,18 @@ class EndpointClient:
                 # whole view; the replay that follows rebuilds survivors
                 for inst in list(self._instances.values()):
                     self._instances.pop(inst.instance_id, None)
+                    self._purge_breaker(inst)
                     self._emit(DELETE, inst)
             self._ready.set()
+
+    def _purge_breaker(self, inst: Instance) -> None:
+        """A deregistered instance's breaker entry must not outlive it: a
+        respawn under the same subject starts closed instead of waiting
+        out the corpse's cooldown, and the entry map stays bounded under
+        instance churn (breaker.reset)."""
+        breaker = getattr(self.endpoint.runtime, "breaker", None)
+        if breaker is not None:
+            breaker.reset(inst.subject)
 
     def _emit(self, kind: str, inst: Instance) -> None:
         for fn in self._listeners:
@@ -250,3 +302,5 @@ class EndpointClient:
             self._watch.cancel()
         if self._watch_task is not None:
             self._watch_task.cancel()
+        if self._revalidate_task is not None:
+            self._revalidate_task.cancel()

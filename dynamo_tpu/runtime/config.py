@@ -62,6 +62,13 @@ class RuntimeConfig:
     # cooldown, seconds.
     breaker_fail_limit: int = 3
     breaker_cooldown: float = 5.0
+    # Stale-while-revalidate for instance discovery (component.py;
+    # docs/robustness.md "Degraded control plane"): > 0 makes each
+    # EndpointClient re-read its instance prefix every N seconds and
+    # raise/clear the runtime's store-degradation flag on failure/
+    # success. Routing always serves from the in-memory snapshot either
+    # way; 0 = no revalidation task, current behavior byte-for-byte.
+    instance_revalidate_s: float = 0.0
     # KVBM async offload/onboard pipeline (kvbm/manager.py;
     # docs/kvbm.md). All default to 0 = the synchronous in-scheduler
     # behavior, byte-for-byte. Queue bound (blocks) for evictions staged

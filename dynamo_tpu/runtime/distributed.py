@@ -84,6 +84,37 @@ class DistributedRuntime:
         self._reregisters: list = []
         if hasattr(store, "on_reconnect"):
             store.on_reconnect.append(self._on_store_reconnect)
+        # control-plane degradation: monotonic timestamp of the first
+        # store error of the current outage, or None when healthy.
+        # Routers keep serving from their last-known-instances snapshot
+        # (stale-while-revalidate); these just make the staleness visible.
+        self._store_degraded_since: Optional[float] = None
+        self._store_degraded_where = ""
+
+    def note_store_error(self, where: str = "") -> None:
+        """Record that a control-plane store op failed. First error of an
+        outage logs once; repeats only extend the staleness clock."""
+        if self._store_degraded_since is None:
+            self._store_degraded_since = time.monotonic()
+            self._store_degraded_where = where
+            logger.warning(
+                "control plane DEGRADED (store unreachable at %s): "
+                "serving from last-known instance snapshot", where or "?")
+
+    def note_store_ok(self) -> None:
+        if self._store_degraded_since is not None:
+            stale = time.monotonic() - self._store_degraded_since
+            self._store_degraded_since = None
+            self._store_degraded_where = ""
+            logger.warning(
+                "control plane RECOVERED after %.1fs of staleness", stale)
+
+    def store_staleness_s(self) -> float:
+        """Seconds the instance snapshot has been unrefreshable; 0 when
+        the store is healthy."""
+        if self._store_degraded_since is None:
+            return 0.0
+        return time.monotonic() - self._store_degraded_since
 
     def _on_breaker_transition(self, key: str, old: str,
                                new: str) -> None:
@@ -138,7 +169,11 @@ class DistributedRuntime:
         """Process-level failure-handling counters, merged into the
         `_sys.stats` scrape (service_stats.py picks them up per address)."""
         out = {"transport": dict(self.transport_client.stats),
-               "breaker": self.breaker.snapshot()}
+               "breaker": self.breaker.snapshot(),
+               "store": {
+                   "degraded": self._store_degraded_since is not None,
+                   "staleness_s": round(self.store_staleness_s(), 3),
+               }}
         if self._kvbm_manager is not None:
             out["kvbm"] = self._kvbm_manager.pipeline_stats()
         return out
@@ -153,6 +188,16 @@ class DistributedRuntime:
         open_g = self.metrics.gauge(
             "breaker_open_instances",
             "instances currently filtered from routing (open/half-open)")
+        degraded = self.metrics.gauge(
+            "store_degraded",
+            "1 while the control-plane store is unreachable and routing "
+            "serves from the last-known instance snapshot")
+        staleness = self.metrics.gauge(
+            "store_staleness_seconds",
+            "seconds since the instance snapshot could last be refreshed "
+            "(0 when the store is healthy)")
+        degraded.set(0)
+        staleness.set(0)
 
         def sync() -> None:
             for kind, v in self.transport_client.stats.items():
@@ -160,6 +205,9 @@ class DistributedRuntime:
             for state, n in self.breaker.transitions.items():
                 transitions.set(n, state=state)
             open_g.set(self.breaker.open_count())
+            stale = self.store_staleness_s()
+            degraded.set(1 if self._store_degraded_since is not None else 0)
+            staleness.set(stale)
 
         self.metrics.on_scrape(sync)
 

@@ -25,6 +25,15 @@ Spec grammar (``DYN_FAULTS`` env var, or `FaultInjector.from_spec`):
     kind=offload_stall     KVBM offload worker parks forever (stuck
                            pipeline; the bounded staging queue then
                            backpressures evictions into the inline path)
+    kind=dispatch_wedge    the engine scheduler loop parks mid-dispatch
+                           with work pending — the chip-free model of a
+                           wedged jitted device call (docs/ROUND4_NOTES).
+                           The dispatch watchdog (engine/watchdog.py)
+                           must detect it and quarantine the worker.
+    kind=store_outage      matching control-plane store ops raise
+                           ConnectionError — the coordinator is
+                           unreachable; routers must keep serving from
+                           their last-known-instances snapshot
 
     addr=<glob>            match the dialed/peer address   (default *)
     subject=<glob>         match the request subject       (default *)
@@ -72,9 +81,13 @@ ENGINE_STALL = "engine_stall"
 # KVBM pipeline fault kinds (kvbm/manager.py offload worker)
 OFFLOAD_DELAY = "offload_delay"
 OFFLOAD_STALL = "offload_stall"
+# self-healing fault kinds (engine/watchdog.py, runtime/store.py)
+DISPATCH_WEDGE = "dispatch_wedge"
+STORE_OUTAGE = "store_outage"
 
 _KINDS = {CONNECT_REFUSED, DISCONNECT, STALL, DELAY, ERR,
-          ENGINE_ERR, ENGINE_STALL, OFFLOAD_DELAY, OFFLOAD_STALL}
+          ENGINE_ERR, ENGINE_STALL, OFFLOAD_DELAY, OFFLOAD_STALL,
+          DISPATCH_WEDGE, STORE_OUTAGE}
 
 
 @dataclass
@@ -213,6 +226,36 @@ class FaultInjector:
         if r.kind == ENGINE_ERR:
             return ("err", r.error)
         return ("stall",)
+
+    def on_dispatch(self, subject: str) -> Optional[tuple]:
+        """Consulted by the engine scheduler loop once per iteration
+        (`subject` = "dispatch.<worker_id>"). ("wedge",): the loop must
+        park until cancelled — a wedged device dispatch with work
+        pending, exactly what the dispatch watchdog exists to catch."""
+        r = self._fire((DISPATCH_WEDGE,), None, subject)
+        if r is None:
+            return None
+        return ("wedge",)
+
+    def on_store_op(self, op: str, key: Optional[str] = None
+                    ) -> Optional[tuple]:
+        """Consulted by the control-plane store before each operation
+        (`subject` = "store.<op>", e.g. "store.put"). ("outage",): the
+        op must raise ConnectionError — the coordinator is unreachable.
+        `key` matches the rule's addr glob so a spec can target one
+        keyspace (addr=v1/instances/*)."""
+        r = self._fire((STORE_OUTAGE,), key, f"store.{op}")
+        if r is None:
+            return None
+        return ("outage",)
+
+    def outage_active(self) -> bool:
+        """True while any store_outage rule can still fire — the store's
+        lease reaper pauses expiry during an outage (a down coordinator
+        expires nothing; keepalives simply never arrive)."""
+        return any(r.kind == STORE_OUTAGE
+                   and (r.times is None or r.fired < r.times)
+                   for r in self.rules)
 
     def on_offload(self, point: str = "kvbm.offload") -> Optional[tuple]:
         """Consulted by the KVBM offload worker before each drained

@@ -94,6 +94,23 @@ class TransportServer:
         self.port = self._server.sockets[0].getsockname()[1]
         return self.address
 
+    def abort_streams(self) -> list[asyncio.Task]:
+        """Abort every in-flight handler WITHOUT cancelling its Context.
+
+        The cancellation handler in `run_request` distinguishes the two:
+        a cancelled task whose context is NOT cancelled means the server
+        (not the user) killed the stream, so it sends the
+        `STREAM_ERR_MSG` err frame on the still-open connection — the
+        exact error `Migration` replays on a surviving instance with the
+        accumulated tokens. This is the quarantine path's stream
+        handoff: in-flight work migrates instead of hanging until the
+        client's idle timeout. Returns the cancelled tasks so callers
+        can await the err frames flushing before tearing down."""
+        tasks = [t for t in self._conn_tasks if not t.done()]
+        for t in tasks:
+            t.cancel()
+        return tasks
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()

@@ -457,6 +457,31 @@ def main(argv=None) -> None:
                                     instance_id=instance_id)
         monitor = EngineDeathMonitor(engine)
         monitor.start()
+        # dispatch watchdog (None unless DYN_WATCHDOG_STALL_S): a wedged
+        # dispatch quarantines this process — deregister, abort streams
+        # into Migration, flush KVBM — and exits rc 44 so the supervisor
+        # respawns it. hard_exit covers the loop itself being wedged.
+        from dynamo_tpu.engine.watchdog import watchdog_from_env
+
+        watchdog = watchdog_from_env(engine, runtime=rt,
+                                     instance=f"{instance_id:x}",
+                                     hard_exit=True)
+        if watchdog is not None:
+            from dynamo_tpu.worker.quarantine import quarantine_worker
+
+            def _on_trip(event: dict) -> None:
+                asyncio.get_running_loop().create_task(quarantine_worker(
+                    rt, handle, engine,
+                    reason=f"watchdog: {event.get('cause')}",
+                    exit_process=True, watchdog=watchdog))
+
+            watchdog.on_trip = _on_trip
+            watchdog.start()
+
+            async def _stop_watchdog():
+                watchdog.stop()
+
+            extra.append(_Stoppable(_stop_watchdog))
         print(f"WORKER_READY {card.namespace}/{card.component}/"
               f"{card.endpoint}/{instance_id:x}", flush=True)
         return rt, engine, handle, extra, monitor

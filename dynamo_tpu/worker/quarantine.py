@@ -1,0 +1,96 @@
+"""Quarantine: take a wedged worker out of the fleet without losing streams.
+
+Invoked by the dispatch watchdog on trip (and usable directly by tests /
+operators). The ordering is the whole point:
+
+1. **Deregister** (`handle.stop()`): the instance key leaves the store,
+   every router's watch loop drops it from the candidate set, and the
+   breaker entry for its subject is purged (component.py DELETE branch)
+   so a respawn starts closed. Best-effort — if the store is also down,
+   the lease simply expires on its own.
+2. **Abort in-flight streams** (`TransportServer.abort_streams()`): each
+   handler task is cancelled WITHOUT cancelling its Context, which makes
+   the server's CancelledError handler send the "stream disconnected"
+   err frame on the still-open connection — the exact error `Migration`
+   replays. Clients resume elsewhere with their accumulated tokens
+   appended to the prompt; nothing generated so far is lost. We await
+   the cancelled tasks so the err frames actually flush before teardown.
+3. **Flush KVBM** (`flush_queued_offloads()`): queued offload batches
+   drain inline and their pins release, so blocks already captured for
+   the slow tiers survive into the respawned worker's cache.
+4. **Exit** with `QUARANTINE_EXIT_CODE` (subprocess workers) so the
+   supervisor can tell "quarantined, respawn me" (44) from engine death
+   (42) and canary failure (43). Task-mode workers set
+   `engine._quarantined` instead and let the supervisor's health loop
+   collect them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+# 42 = engine death (worker/monitor.py), 43 = canary unhealthy
+# (worker/main.py), 44 = quarantined by the dispatch watchdog: the
+# supervisor treats this as "respawn with backoff", not "operator error".
+QUARANTINE_EXIT_CODE = 44
+
+# how long we wait for aborted handlers to flush their err frames;
+# quarantine must never wedge on the thing it is escaping
+_ABORT_FLUSH_TIMEOUT_S = 2.0
+
+
+async def quarantine_worker(runtime, handle, engine, *,
+                            reason: str = "watchdog trip",
+                            exit_process: bool = True,
+                            watchdog=None) -> None:
+    logger.error("QUARANTINE (%s): deregistering, aborting streams, "
+                 "flushing kvbm", reason)
+    # 1. deregister — the store may be the thing that's broken, so any
+    # failure here just means the lease expires on its own schedule
+    if handle is not None:
+        # serve_engine handles expose stop(); bare ServedEndpoints
+        # (ep.serve) expose shutdown() — accept either
+        stop = getattr(handle, "stop", None) or getattr(
+            handle, "shutdown", None)
+        if stop is not None:
+            try:
+                await stop()
+            except Exception:
+                logger.exception("quarantine: deregistration failed "
+                                 "(lease will expire)")
+    # 2. hand streams off to migration
+    server = getattr(runtime, "transport_server", None)
+    if server is not None:
+        tasks = server.abort_streams()
+        if tasks:
+            done, pending = await asyncio.wait(
+                tasks, timeout=_ABORT_FLUSH_TIMEOUT_S)
+            if pending:
+                logger.warning("quarantine: %d stream abort(s) did not "
+                               "flush in %.1fs", len(pending),
+                               _ABORT_FLUSH_TIMEOUT_S)
+    # 3. drain queued offloads so captured blocks keep their pins honest
+    kvbm = getattr(engine, "kvbm", None)
+    if kvbm is not None:
+        try:
+            released = kvbm.flush_queued_offloads()
+            logger.info("quarantine: kvbm force-drain released %s page(s)",
+                        released)
+        except Exception:
+            logger.exception("quarantine: kvbm flush failed")
+    # 4. mark + exit
+    if engine is not None:
+        try:
+            engine._quarantined = True
+        except Exception:
+            pass
+    if watchdog is not None:
+        watchdog.quarantined.set()
+    if exit_process:
+        logger.error("quarantine complete; exiting rc=%d",
+                     QUARANTINE_EXIT_CODE)
+        os._exit(QUARANTINE_EXIT_CODE)

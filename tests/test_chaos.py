@@ -143,6 +143,208 @@ async def test_chaos_soak_all_streams_complete_token_identical():
             await w.close()
 
 
+class WedgableEngine:
+    """Counting engine (same token contract as `counting_engine`) whose
+    streams ALL park when a seeded `dispatch_wedge` rule fires — the
+    chip-free model of a jitted device call that never returns. Exposes
+    the surface the dispatch watchdog samples: `_running` (pending
+    work), `progress_token()` (forward progress), and a per-frame
+    injector consult, like the real scheduler loop."""
+
+    def __init__(self, worker_id: int, injector: FaultInjector) -> None:
+        self.worker_id = worker_id
+        self.injector = injector
+        self._wedged = asyncio.Event()
+        self._running: dict[int, dict] = {}
+        self._waiting: list = []
+        self._progress = 0
+        self._rid = 0
+
+    def progress_token(self) -> int:
+        return self._progress
+
+    async def generate(self, request, context):
+        self._rid += 1
+        rid = self._rid
+        self._running[rid] = request
+        try:
+            n = len(request["token_ids"])
+            for i in range(request["stop"]["max_tokens"]):
+                if self.injector.on_dispatch(
+                        f"dispatch.{self.worker_id}") is not None:
+                    self._wedged.set()
+                if self._wedged.is_set():
+                    # park with work pending; only the quarantine's
+                    # abort_streams (task cancel) frees us, so recovery
+                    # MUST come from the server side — the client idle
+                    # timeout is set far too high to save the day
+                    await asyncio.Event().wait()
+                yield {"token_ids": [n + i]}
+                self._progress += 1
+                await asyncio.sleep(TOKEN_INTERVAL_S)
+        finally:
+            self._running.pop(rid, None)
+
+
+async def test_chaos_wedge_mid_stream_watchdog_quarantines_and_migrates():
+    """Tentpole e2e (docs/robustness.md "Watchdog & self-healing"): a
+    worker wedges mid-stream under traffic. The dispatch watchdog must
+    trip, quarantine must deregister the worker and abort its streams
+    with the migration contract, and every stream must complete
+    token-identical on the survivor — with zero help from client-side
+    idle timeouts."""
+    from dynamo_tpu.engine.watchdog import (
+        WATCHDOG_EVENTS_SUBJECT,
+        DispatchWatchdog,
+    )
+    from dynamo_tpu.worker.quarantine import quarantine_worker
+
+    store = MemoryStore()
+    # w1 wedges after a few dispatched frames; w2 stays healthy
+    injector = FaultInjector.from_spec(
+        "kind=dispatch_wedge,subject=dispatch.1,after=4", seed=11)
+    w1_server = TransportServer()
+    await w1_server.start()
+    w1 = DistributedRuntime(_worker_config(), store, w1_server,
+                            await store.create_lease(60.0))
+    eng1 = WedgableEngine(1, injector)
+    ep1 = w1.namespace(NS).component(COMP).endpoint(EP)
+    served1 = await ep1.serve(eng1, instance_id=1)
+    w2 = await _spawn_worker(store, 2)
+
+    client_server = TransportServer()
+    await client_server.start()
+    crt = DistributedRuntime(
+        # idle timeout far above the test horizon: if recovery happens,
+        # it was the server-side abort frames, not a client timeout
+        RuntimeConfig(lease_ttl=60.0, stream_idle_timeout=30.0,
+                      request_deadline=60.0),
+        store, client_server, await store.create_lease(60.0))
+    ep = crt.namespace(NS).component(COMP).endpoint(EP)
+    client = await ep.client()
+    await client.start()
+    for _ in range(100):
+        if len(client.instances()) == 2:
+            break
+        await asyncio.sleep(0.02)
+    assert len(client.instances()) == 2
+    mig = Migration(migration_limit=4).link(PushRouter(client))
+
+    wd_events = await w1.events.subscribe(WATCHDOG_EVENTS_SUBJECT)
+    wd = DispatchWatchdog(eng1, 0.3, runtime=w1, instance="1")
+
+    def _on_trip(event: dict) -> None:
+        asyncio.get_running_loop().create_task(quarantine_worker(
+            w1, served1, eng1,
+            reason=f"watchdog: {event.get('cause')}",
+            exit_process=False, watchdog=wd))
+
+    wd.on_trip = _on_trip
+    wd.start()
+
+    async def run_one(prompt_len: int) -> list[int]:
+        req = {"token_ids": list(range(prompt_len)),
+               "stop": {"max_tokens": MAX_TOKENS}}
+        out: list[int] = []
+        async for frame in mig.generate(req, Context()):
+            out.extend(frame.get("token_ids", ()))
+        return out
+
+    try:
+        results = await asyncio.wait_for(
+            asyncio.gather(*(run_one(n + 1) for n in range(8))),
+            timeout=30.0)   # streams into the wedge must not hang
+        for n, tokens in enumerate(results):
+            prompt_len = n + 1
+            assert tokens == list(range(prompt_len,
+                                        prompt_len + MAX_TOKENS)), \
+                f"request {n}: got {tokens}"
+        # the wedge fired, the watchdog caught it, migration healed it
+        assert injector.fired.get("dispatch_wedge", 0) == 1
+        assert wd.tripped is not None
+        assert wd.tripped["pending"] >= 1
+        assert getattr(eng1, "_quarantined", False) is True
+        assert mig.stats["migrations"] >= 1
+        msg = await asyncio.wait_for(wd_events.queue.get(), 2.0)
+        assert msg["payload"] == wd.tripped
+        # the quarantined instance left the rotation
+        for _ in range(100):
+            if len(client.instances()) == 1:
+                break
+            await asyncio.sleep(0.02)
+        assert [i.instance_id for i in client.instances()] == [2]
+        await client.stop()
+    finally:
+        wd.stop()
+        await crt.close()
+        await w1.close()
+        await w2.close()
+
+
+async def test_chaos_store_outage_stale_snapshot_keeps_serving():
+    """Control-plane outage mid-run (docs/robustness.md "Degraded
+    control plane"): every store op fails, yet requests keep completing
+    from the last-known instance snapshot; the runtime flags DEGRADED
+    with a staleness clock and recovers when the store returns."""
+    store = MemoryStore()
+    w1 = await _spawn_worker(store, 1)
+    w2 = await _spawn_worker(store, 2)
+    client_server = TransportServer()
+    await client_server.start()
+    crt = DistributedRuntime(
+        RuntimeConfig(lease_ttl=60.0, instance_revalidate_s=0.05),
+        store, client_server, await store.create_lease(60.0))
+    ep = crt.namespace(NS).component(COMP).endpoint(EP)
+    client = await ep.client()
+    await client.start()
+    for _ in range(100):
+        if len(client.instances()) == 2:
+            break
+        await asyncio.sleep(0.02)
+    assert len(client.instances()) == 2
+    router = PushRouter(client)
+
+    async def run_one(prompt_len: int) -> None:
+        out: list[int] = []
+        async for frame in router.generate(
+                {"token_ids": list(range(prompt_len)),
+                 "stop": {"max_tokens": MAX_TOKENS}}, Context()):
+            out.extend(frame.get("token_ids", ()))
+        assert out == list(range(prompt_len, prompt_len + MAX_TOKENS))
+
+    try:
+        # coordinator goes dark: every op raises until further notice
+        injector = FaultInjector.from_spec("kind=store_outage,times=*",
+                                           seed=3)
+        store.fault_injector = injector
+        await asyncio.wait_for(
+            asyncio.gather(*(run_one(n + 1) for n in range(6))),
+            timeout=20.0)   # request path never touches the store
+        for _ in range(100):
+            if crt._store_degraded_since is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert crt._store_degraded_since is not None
+        assert crt.store_staleness_s() > 0.0
+        assert injector.fired.get("store_outage", 0) >= 1
+        assert len(client.instances()) == 2   # stale snapshot intact
+        # coordinator returns: staleness clears, traffic still clean
+        store.fault_injector = None
+        for _ in range(100):
+            if crt._store_degraded_since is None:
+                break
+            await asyncio.sleep(0.02)
+        assert crt._store_degraded_since is None
+        await asyncio.wait_for(
+            asyncio.gather(*(run_one(n + 1) for n in range(4))),
+            timeout=20.0)
+        await client.stop()
+    finally:
+        await crt.close()
+        await w1.close()
+        await w2.close()
+
+
 async def test_chaos_single_worker_stall_recovers_via_self_migration():
     """Degenerate rotation: one worker, its stream stalls once. The
     replay lands on the same (recovered) worker and must still produce

@@ -3,9 +3,9 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos heal-smoke kvbm-soak trace-smoke fleet-smoke \
-	autoscale-smoke profile-smoke router-smoke kv-smoke perf-gate \
-	perf-baseline
+.PHONY: tier0 tier1 chaos heal-smoke control-smoke kvbm-soak trace-smoke \
+	fleet-smoke autoscale-smoke profile-smoke router-smoke kv-smoke \
+	perf-gate perf-baseline
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -20,8 +20,8 @@ tier1:
 # (seeded — every run sees the same faults) + the chaos soak, which
 # kills/stalls/wedges workers mid-stream and requires 100% of requests
 # to complete token-identically — plus the self-healing suite
-# (heal-smoke). tier0-marked, < 60 s.
-chaos: heal-smoke
+# (heal-smoke) and the flight-control loop gate (control-smoke).
+chaos: heal-smoke control-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -33,6 +33,16 @@ chaos: heal-smoke
 # doctor preflight exit codes. Chip-free; off-by-default paths pinned.
 heal-smoke:
 	$(PYTEST) tests/test_healing.py
+
+# flight-control gate (docs/flight_control.md): off-by-default purity,
+# each controller against synthetic evidence, the seeded armed perf
+# pass (byte-identical twice, padded tokens down at equal goodput), and
+# the SLA-gated loop smoke — trafficgen replay over a live mock fleet
+# with every controller armed: no SLO fast-burn after warmup, zero
+# non-abandoned streams dropped, >=1 action per controller, every knob
+# change explainable via doctor control. Chip-free.
+control-smoke:
+	$(PYTEST) tests/test_control.py
 
 # KVBM pipeline soak (docs/kvbm.md): loop admission/eviction with the
 # offload worker fault-delayed on every batch — output must stay

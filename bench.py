@@ -1132,6 +1132,12 @@ def run_one_phase(name: str) -> None:
         # and the KV lifecycle ring (kvbm/lifecycle.py) so the same
         # records carry a kv_lifecycle memory-plane block
         os.environ.setdefault("DYN_KV_LIFECYCLE", "1")
+        # and the dispatch watchdog (engine/watchdog.py): these are the
+        # longest phases, where a wedged device op would otherwise eat
+        # the whole phase box silently; the stall bound stays far above
+        # any honest compile so a healthy run is unaffected
+        os.environ.setdefault("DYN_WATCHDOG_STALL_S", "120")
+        os.environ.setdefault("DYN_WATCHDOG_PREFLIGHT", "1")
     try:
         result = asyncio.run(PHASES[name]())
     except Exception as e:

@@ -266,6 +266,14 @@ GATE_THRESHOLDS = {
     "kv.tokens_saved":        GateSpec("higher", 0.02, "rel"),
     "kv.premature_pct":       GateSpec("lower", 0.5, "abs"),
     "router.tokens_saved":    GateSpec("higher", 0.02, "rel"),
+    # flight-control armed pass (bench/perf.py second run with the
+    # bucket autotuner on): the controller must keep acting, keep the
+    # padded-token win, and cost no goodput/completions
+    "control.bucket_actions": GateSpec("higher", 0.25, "rel"),
+    "control.padded_pct_armed": GateSpec("lower", 0.5, "abs"),
+    "control.padded_token_reduction_pct": GateSpec("higher", 0.5, "abs"),
+    "control.goodput_tokens_armed": GateSpec("higher", 0.02, "rel"),
+    "control.completed_armed": GateSpec("higher", 0.0, "rel"),
 }
 
 

@@ -25,6 +25,15 @@ never read.
 prefill bucket and decode width up to at least that power of two,
 inflating padded-token share exactly the way a lazy bucketing ladder
 would — the gate must catch it (tests/test_perf_ledger.py pins this).
+
+With ``control=True`` the same replay runs a second, independent world
+with the flight-control bucket autotuner armed (docs/flight_control.md):
+a real `ControlPlane` + `BucketAutotuner` ticked on the *virtual* clock
+proposes rungs from each worker's StepRecorder and the sim routes its
+buckets through the resulting `BucketLadder`s. `main` runs both passes
+and folds the armed deltas into `metrics.control`, which the perf gate
+holds against the baseline — the closed loop itself is under the same
+byte-deterministic regression guard as the serving counters.
 """
 
 from __future__ import annotations
@@ -86,8 +95,24 @@ class _Lane:
     emitted: int = 0
 
 
-def run_perf(cfg: PerfConfig) -> dict:
-    """One simulated replay → the scored perf record (pure given cfg)."""
+#: sim-seconds between control-plane ticks in the armed pass
+CONTROL_TICK_S = 2.0
+
+
+def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
+    """One simulated replay → the scored perf record (pure given cfg).
+
+    ``control=True`` arms the flight-control bucket autotuner over the
+    sim's StepRecorders, ticked on the virtual clock; the record gains a
+    top-level ``control_sim`` block ({events, final_rungs}) — itself
+    deterministic, so two armed runs serialize byte-identically.
+
+    The default (unarmed) call also runs the armed companion pass and
+    folds its deltas into ``metrics.control`` + ``control_sim``, so one
+    ``run_perf(cfg)`` yields the complete gated record — every
+    ``GATE_THRESHOLDS`` key, including the ``control.*`` family, exists
+    in it.
+    """
     tcfg = cfg.traffic
     schedule = build_schedule(tcfg)[:cfg.max_requests]
     floor = _pow2(max(cfg.bucket_floor, 1))
@@ -105,6 +130,23 @@ def run_perf(cfg: PerfConfig) -> dict:
                        temperature=0.0, block_size=cfg.block_size),
         rng=random.Random(cfg.seed))
     loads = MultiWorkerSequences(cfg.block_size)
+
+    # armed pass: a real ControlPlane + BucketAutotuner over engine shims
+    # that expose the sim's StepRecorders, ticked on the virtual clock
+    plane = None
+    shims: dict = {}
+    events: list = []
+    next_tick = CONTROL_TICK_S
+    if control:
+        from types import SimpleNamespace
+
+        from dynamo_tpu.control.controllers import BucketAutotuner
+        from dynamo_tpu.control.plane import ControlPlane
+        shims = {w: SimpleNamespace(
+            step_recorder=steps[w], bucket_ladder=None,
+            config=SimpleNamespace(worker_id=w[0])) for w in wkeys}
+        plane = ControlPlane({"bucket"})
+        plane.attach(BucketAutotuner(lambda: [shims[w] for w in wkeys]))
 
     shapes_seen: dict = {w: set() for w in wkeys}
     lanes: dict = {w: {} for w in wkeys}         # rid -> _Lane
@@ -143,6 +185,9 @@ def run_perf(cfg: PerfConfig) -> dict:
         loads.add_request(rid, w, uncached, req_blocks)
         # prefill dispatch, MockEngine cost model + bucket floor
         bucket = max(_pow2(max(uncached, 1)), floor)
+        if control and shims[w].bucket_ladder is not None:
+            bucket = shims[w].bucket_ladder.bucket_for(
+                max(uncached, 1), bucket, lo=floor)
         dt = bucket * cfg.prefill_us_per_token / 1e6
         shape = (1, bucket)
         fresh = shape not in shapes_seen[w]
@@ -157,6 +202,13 @@ def run_perf(cfg: PerfConfig) -> dict:
         vclock += dt                    # prefills serialize on the sim clock
 
     while arrivals or any(lanes[w] for w in wkeys):
+        if plane is not None:
+            while vclock >= next_tick:   # virtual-clock control ticks
+                events.extend(plane.tick(now=next_tick))
+                next_tick += CONTROL_TICK_S
+            for sh in shims.values():    # safe point: between dispatches
+                if sh.bucket_ladder is not None:
+                    sh.bucket_ladder.maybe_apply()
         if not any(lanes[w] for w in wkeys) and arrivals:
             vclock = max(vclock, arrivals[0].at)
         while arrivals and arrivals[0].at <= vclock:
@@ -167,8 +219,11 @@ def run_perf(cfg: PerfConfig) -> dict:
             runnable = lanes[w]
             if not runnable:
                 continue
-            width = min(max(_pow2(len(runnable)), floor),
-                        cfg.max_batch_size)
+            width = max(_pow2(len(runnable)), floor)
+            if control and shims[w].bucket_ladder is not None:
+                width = shims[w].bucket_ladder.bucket_for(
+                    len(runnable), width, lo=floor)
+            width = min(width, cfg.max_batch_size)
             shape = (width, 1)
             fresh = shape not in shapes_seen[w]
             shapes_seen[w].add(shape)
@@ -191,10 +246,45 @@ def run_perf(cfg: PerfConfig) -> dict:
                     completed += 1
         vclock += step_s
 
-    return _score(cfg, schedule, steps, kv_recs, decisions,
-                  completed=completed,
-                  admission_rejects=admission_rejects,
-                  append_fails=append_fails)
+    record = _score(cfg, schedule, steps, kv_recs, decisions,
+                    completed=completed,
+                    admission_rejects=admission_rejects,
+                    append_fails=append_fails)
+    if control:
+        record["control_sim"] = {
+            "events": events,
+            "final_rungs": {
+                f"w{w[0]}": (shims[w].bucket_ladder.state()
+                             if shims[w].bucket_ladder is not None else None)
+                for w in wkeys},
+        }
+    else:
+        _fold_armed_pass(cfg, record)
+    return record
+
+
+def _fold_armed_pass(cfg: PerfConfig, record: dict) -> None:
+    """Run the armed companion pass (same seed, bucket autotuner on) and
+    fold the padded-token delta at equal goodput into the record — the
+    ledger.GATE_THRESHOLDS "control.*" keys — plus the un-gated
+    ``control_sim`` evidence block for doctor/debug."""
+    armed = run_perf(cfg, control=True)
+    base_eng = record["metrics"]["engine"]
+    armed_eng = armed["metrics"]["engine"]
+    sim = armed["control_sim"]
+    record["metrics"]["control"] = {
+        "bucket_actions": sum(1 for e in sim["events"]
+                              if e["controller"] == "bucket"),
+        "rungs_applied": sum((r or {}).get("applied", 0)
+                            for r in sim["final_rungs"].values()),
+        "padded_pct_armed": armed_eng["padded_pct"],
+        "padded_token_reduction_pct": round(
+            base_eng["padded_pct"] - armed_eng["padded_pct"], 3),
+        "goodput_tokens_armed": armed_eng["goodput_tokens"],
+        "compiles_armed": armed_eng["compiles"],
+        "completed_armed": armed["completed"],
+    }
+    record["control_sim"] = sim
 
 
 def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
@@ -322,8 +412,7 @@ def main(argv=None) -> int:
                      bucket_floor=max(1, args.bucket_floor),
                      max_requests=max(1, args.requests))
     cfg.traffic.seed = args.seed
-    record = run_perf(cfg)
-    text = record_to_json(record)
+    text = record_to_json(run_perf(cfg))
     if args.out == "-":
         sys.stdout.write(text)
     else:

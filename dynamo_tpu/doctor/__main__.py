@@ -101,6 +101,9 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "request": ("request",
                 "join trace spans + router decision + step/KV "
                 "recorder windows for one request"),
+    "control": ("control",
+                "flight-control knob changes from /debug/control or an "
+                "events JSONL: timeline, trajectories, evidence"),
 }
 
 

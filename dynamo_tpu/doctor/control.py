@@ -1,0 +1,191 @@
+"""`python -m dynamo_tpu.doctor control <url-or-file>` — explain every
+knob the flight-control plane has moved (docs/flight_control.md).
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/control``;
+  * a ``.json`` capture of the same payload;
+  * a ``.jsonl`` file of action events, one per line — either raw
+    action records or ``control_events`` bus messages (the action in
+    ``payload``), so a subscriber's dump renders the same way.
+
+Renders the armed-controller header, per-knob trajectories (every value
+a knob has taken, in order), and the action timeline — each action with
+its before/after values, reason, and a one-line summary of the evidence
+window that justified it. Exit code 0 when anything was rendered, 1
+when the input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/control from a base url, read a JSON capture, or
+    fold a JSONL event dump into {"events": [...]}."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/control"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor control: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"doctor control: cannot read {source}: {e!r}")
+        return None
+    try:
+        body = json.loads(text)
+        if isinstance(body, dict):
+            return body
+        if isinstance(body, list):
+            return {"events": body}
+    except json.JSONDecodeError:
+        pass
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    if not events:
+        print(f"doctor control: {source} is neither a control payload "
+              f"nor an event JSONL")
+        return None
+    return {"events": events}
+
+
+def _normalize_events(body: dict) -> list[dict]:
+    """Action records from any capture shape: the /debug/control payload
+    (`events`), a perf record's `control_sim.events`, or bus messages
+    whose `payload` holds the action."""
+    raw = body.get("events")
+    if raw is None and isinstance(body.get("control_sim"), dict):
+        raw = body["control_sim"].get("events")
+    out = []
+    for ev in raw or []:
+        if not isinstance(ev, dict):
+            continue
+        if "controller" not in ev and isinstance(ev.get("payload"), dict):
+            ev = ev["payload"]
+        if "knob" in ev:
+            out.append(ev)
+    return out
+
+
+def _evidence_line(evidence) -> str:
+    """One line per evidence window, whatever the controller recorded."""
+    if not isinstance(evidence, dict):
+        return str(evidence)
+    parts = []
+    shapes = evidence.get("shapes")
+    if isinstance(shapes, list) and shapes:
+        worst = shapes[0]
+        parts.append(
+            f"{len(shapes)} shape(s), worst {worst.get('entry', '?')} "
+            f"{worst.get('shape', '?')}: count={worst.get('count', 0)} "
+            f"padded={worst.get('padded_tokens', 0)} "
+            f"({worst.get('padded_pct', 0)}%)")
+    window = evidence.get("window")
+    if isinstance(window, dict):
+        parts.append(" ".join(f"{k}={v}" for k, v in sorted(window.items())
+                              if v is not None))
+    scale = evidence.get("scale_events")
+    if isinstance(scale, list) and scale:
+        dirs = [str(e.get("direction", "?")) for e in scale]
+        parts.append(f"{len(scale)} scale event(s): {', '.join(dirs)}")
+    if not parts:
+        parts.append(" ".join(f"{k}={v}" for k, v in sorted(
+            evidence.items())))
+    return "; ".join(parts)
+
+
+def render(body: dict, *, limit: int = 0) -> bool:
+    events = _normalize_events(body)
+    enabled = body.get("enabled")
+    if enabled:
+        actions = body.get("actions") or {}
+        counts = " ".join(f"{k}={v}" for k, v in sorted(actions.items()))
+        print(f"flight control: {len(enabled)} controller(s) armed "
+              f"({', '.join(enabled)}), {body.get('ticks', 0)} tick(s)"
+              + (f", actions: {counts}" if counts else ""))
+    else:
+        print(f"flight control: event capture ({len(events)} action(s))")
+
+    ctls = body.get("controllers") or {}
+    for name, st in sorted(ctls.items()):
+        print(f"  {name}: " + json.dumps(st, sort_keys=True, default=str))
+
+    if not events:
+        print("  no actions recorded"
+              + ("" if enabled else " — nothing to explain"))
+        return bool(enabled)
+
+    # per-knob trajectory: every value the knob has taken, in order
+    trajectories: dict = {}
+    for ev in events:
+        knob = str(ev.get("knob", "?"))
+        row = trajectories.setdefault(
+            knob, {"controller": ev.get("controller", "?"),
+                   "values": [ev.get("from")], "changes": 0})
+        row["values"].append(ev.get("to"))
+        row["changes"] += 1
+    print(f"\nknob trajectories ({len(trajectories)} knob(s)):")
+    for knob in sorted(trajectories):
+        row = trajectories[knob]
+        path = " -> ".join(json.dumps(v, default=str)
+                           for v in row["values"])
+        print(f"  {knob} [{row['controller']}]: {path} "
+              f"({row['changes']} change(s))")
+
+    shown = events[-limit:] if limit and limit > 0 else events
+    print(f"\ntimeline ({len(events)} action(s)"
+          + (f", last {len(shown)}" if len(shown) < len(events) else "")
+          + "):")
+    for ev in shown:
+        at = ev.get("at")
+        at_s = f"{at:.3f}" if isinstance(at, (int, float)) else "?"
+        print(f"  t={at_s:<10} {str(ev.get('controller', '?')):<9} "
+              f"{ev.get('knob', '?')}: "
+              f"{json.dumps(ev.get('from'), default=str)} -> "
+              f"{json.dumps(ev.get('to'), default=str)}")
+        if ev.get("reason"):
+            print(f"    reason:   {ev['reason']}")
+        if ev.get("evidence") is not None:
+            print(f"    evidence: {_evidence_line(ev['evidence'])}")
+    return True
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor control",
+        description="explain flight-control knob changes "
+                    "(/debug/control, a saved payload, or an event JSONL)")
+    p.add_argument("source",
+                   help="frontend base url, control JSON capture, or "
+                        "events JSONL")
+    p.add_argument("--last", type=int, default=0,
+                   help="only show the last N timeline actions")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    return 0 if render(body, limit=args.last) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,6 +131,16 @@ def render(status: dict) -> int:
                   f"slow_burn={s.get('slow_burn', 0)} "
                   f"threshold={_ms(s.get('threshold_s'))} "
                   f"samples={s.get('samples', 0)}")
+    control = status.get("control")
+    if control:
+        enabled = control.get("enabled") or []
+        actions = control.get("actions") or {}
+        print(f"control: {len(enabled)} controller(s) armed "
+              f"({', '.join(enabled)}), {control.get('ticks', 0)} tick(s)"
+              f" — `doctor control <url>` for the action timeline")
+        for name, st in sorted((control.get("controllers") or {}).items()):
+            print(f"  {name}: actions={actions.get(name, 0)} "
+                  + json.dumps(st, sort_keys=True, default=str))
     return 0 if components else 1
 
 

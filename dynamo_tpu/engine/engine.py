@@ -656,6 +656,11 @@ class TpuEngine:
         # on `is not None`, so off means zero allocation and a
         # byte-identical step loop.
         self.step_recorder = recorder_from_env(self.metrics)
+        # runtime-resizable bucket rungs (engine/bucketing.py): installed
+        # by the flight-control bucket autotuner; None (the default) keeps
+        # the static _next_bucket ladder byte-identical. Applied only at
+        # the scheduler-loop safe point between dispatches.
+        self.bucket_ladder = None
         # KV lifecycle flight recorder (kvbm/lifecycle.py): same
         # contract — None unless DYN_KV_LIFECYCLE, metrics always-on.
         # The pool shares the recorder; KvbmManager picks it up (and
@@ -946,6 +951,10 @@ class TpuEngine:
                     await self._wake.wait()
                 continue
             try:
+                if self.bucket_ladder is not None:
+                    # safe point: between dispatches, before this
+                    # iteration picks its batch shapes
+                    self.bucket_ladder.maybe_apply()
                 self._reap_transfers()
                 self._admit()
                 if self.kvbm is not None and self._waiting:
@@ -1412,8 +1421,7 @@ class TpuEngine:
         cfg, mcfg = self.config, self.model_cfg
         bp = self._prefill_width(len(picks))
         chunk_lens = [caps[id(s)] for s in picks]
-        t_bucket = _next_bucket(max(chunk_lens), cfg.min_prefill_bucket,
-                                cfg.prefill_chunk, align=mcfg.page_size)
+        t_bucket = self._token_bucket(max(chunk_lens))
         ch_toks = np.zeros((bp, t_bucket), dtype=np.int32)
         ch_tables = np.zeros((bp, mcfg.max_pages_per_seq),
                              dtype=np.int32)
@@ -2136,6 +2144,22 @@ class TpuEngine:
             return min(bp, cfg.max_batch_size)
         return _next_pow2(n, 1, cfg.max_batch_size)
 
+    def _token_bucket(self, n: int, model_cfg=None) -> int:
+        """Prefill token bucket for an n-token chunk: the static
+        _next_bucket ladder, refined by any flight-control rungs the
+        bucket autotuner has applied (engine/bucketing.py). Unarmed
+        (bucket_ladder None, the default) this is exactly _next_bucket.
+        model_cfg defaults to the target model's (draft rounds pass the
+        draft model's, whose page size may differ)."""
+        cfg = self.config
+        mcfg = self.model_cfg if model_cfg is None else model_cfg
+        base = _next_bucket(n, cfg.min_prefill_bucket, cfg.prefill_chunk,
+                            align=mcfg.page_size)
+        if self.bucket_ladder is not None:
+            return self.bucket_ladder.bucket_for(
+                n, base, lo=cfg.min_prefill_bucket, align=mcfg.page_size)
+        return base
+
     def _chunk_round_once(self, params_, model_cfg, kc, vc, ready,
                           offsets, tokens_of, target_len_of, caps=None):
         """ONE batched prefill chunk round: group by page-alignment,
@@ -2160,10 +2184,7 @@ class TpuEngine:
                           cfg.prefill_chunk,
                           caps[id(s)] if caps else cfg.prefill_chunk)
                       for s in active]
-        t_bucket = _next_bucket(max(chunk_lens),
-                                cfg.min_prefill_bucket,
-                                cfg.prefill_chunk,
-                                align=model_cfg.page_size)
+        t_bucket = self._token_bucket(max(chunk_lens), model_cfg)
         toks = np.zeros((bp, t_bucket), dtype=np.int32)
         tables = np.zeros((bp, model_cfg.max_pages_per_seq),
                           dtype=np.int32)

@@ -19,8 +19,9 @@ StepRecorder/DecisionRecorder contract:
     stage/consume, onboard local/remote, KV-event emit) plus cumulative
     analytics that survive ring eviction: per-tier residency time,
     reuse-distance histogram (allocations between register/last-hit and
-    the next hit), premature evictions (block re-onboarded ≤N
-    allocations after leaving the device — the "we evicted the wrong
+    the next hit), premature evictions (block re-onboarded — or
+    re-registered from a recompute on single-tier deployments — ≤N
+    allocations after leaving the device: the "we evicted the wrong
     thing" signal), and a top-K prefix hotness table.
     **Off by default** (``DYN_KV_LIFECYCLE``): `recorder_from_env()`
     returns None and every allocator/KVBM hot-path touch is one
@@ -250,17 +251,31 @@ class KvLifecycleRecorder:
             m.events.inc(ev="allocate")
 
     def on_register(self, page_id: int, seq_hash: int) -> None:
+        # A hash re-registered shortly after a device eviction means the
+        # block was recomputed from scratch — on single-tier deployments
+        # (no host/disk to onboard from) that is the premature-eviction
+        # signal, same as a quick re-onboard on the tiered path. The
+        # tiered path pops _demoted_at in on_onboard first, so a block
+        # never counts twice.
         with self._lock:
+            premature = 0
+            at = self._demoted_at.pop(seq_hash, None)
+            if at is not None \
+                    and self._allocs - at <= self.premature_window:
+                premature = 1
+                self._premature += 1
             self._registered_at[seq_hash] = self._allocs
             self._registered_at.move_to_end(seq_hash)
             self._bound(self._registered_at)
             self._touch_hotness(seq_hash, tier="g1")
             self._enter_tier(seq_hash, "g1")
             self._record("register", page=page_id,
-                         seq_hash=_hex(seq_hash))
+                         seq_hash=_hex(seq_hash), premature=premature)
         m = self.metrics
         if m is not None:
             m.events.inc(ev="register")
+            if premature:
+                m.premature.inc(premature)
 
     def on_hit(self, seq_hash: int, tokens_saved: int) -> None:
         """One registered device page reused for a new sequence's

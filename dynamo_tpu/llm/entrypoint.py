@@ -38,6 +38,7 @@ class Frontend:
     publisher: object = None     # TelemetryPublisher when interval > 0
     slo: object = None           # SloMonitor when objectives configured
     _slo_task: object = None
+    control: object = None       # ControlPlane when DYN_CONTROL armed
 
     @property
     def url(self) -> str:
@@ -48,6 +49,8 @@ class Frontend:
             self._breaker_task.cancel()
         if self._slo_task is not None:
             self._slo_task.cancel()
+        if self.control is not None:
+            await self.control.stop()
         if self.publisher is not None:
             await self.publisher.stop()
         if self.collector is not None:
@@ -158,12 +161,30 @@ async def start_frontend(runtime: DistributedRuntime,
                                          SLO_EVENTS_SUBJECT, ev)
 
         slo_task = _asyncio.get_running_loop().create_task(_slo_loop())
-    http.fleet_status_provider = \
-        lambda: collector.fleet_status(slo=slo)
     # /debug/profile reads whatever engines serve_engine registered on
     # this runtime (late-bound: workers may start after the frontend)
     http.profile_engines = \
         lambda: list(getattr(runtime, "profile_engines", []))
+    # Flight control (docs/flight_control.md): DYN_CONTROL unset ⇒ None —
+    # no plane, no controllers, /debug/control 503s, behavior untouched.
+    # Armed, the plane observes whatever this process can reach: in-proc
+    # engines (the same late-bound list /debug/profile uses) and the
+    # kv-mode routers. The planner-side forecast controller is attached
+    # by whoever owns the Planner (tests / run scripts) via
+    # control_plane_from_env(planner=..., scale_events=...).
+    from dynamo_tpu.control.plane import control_plane_from_env
+
+    control = control_plane_from_env(
+        runtime,
+        engines=lambda: list(getattr(runtime, "profile_engines", [])),
+        routers=lambda: manager.kv_routers())
+    if control is not None:
+        control.start()
+        http.control_plane = control
+    http.fleet_status_provider = \
+        lambda: collector.fleet_status(
+            slo=slo,
+            control=(control.summary if control is not None else None))
     publisher = None
     if cfg.telemetry_interval > 0:
         publisher = TelemetryPublisher(
@@ -173,7 +194,7 @@ async def start_frontend(runtime: DistributedRuntime,
         publisher.start()
     return Frontend(runtime, manager, watcher, http, grpc_svc,
                     breaker_events, task, collector, publisher,
-                    slo, slo_task)
+                    slo, slo_task, control)
 
 
 @dataclass

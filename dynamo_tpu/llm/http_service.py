@@ -121,6 +121,7 @@ class HttpService:
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/router", self._debug_router),
             web.get("/debug/kv", self._debug_kv),
+            web.get("/debug/control", self._debug_control),
             web.get("/openapi.json", self._openapi),
         ])
         # request-lifecycle debug view: in-flight dicts keyed by request
@@ -162,6 +163,10 @@ class HttpService:
         # returning the local engine objects so /debug/profile can read
         # their StepRecorder rings. None on frontend-only processes.
         self.profile_engines = None        # Callable[[], list] | None
+        # Flight-control plane (dynamo_tpu/control): start_frontend wires
+        # the armed ControlPlane here when DYN_CONTROL enables any
+        # controller; None (the default) keeps /debug/control a 503.
+        self.control_plane = None          # ControlPlane | None
 
     def _observe_latency(self, kind: str, seconds: float) -> None:
         """One TTFT/ITL sample into both the histogram and (when
@@ -598,6 +603,13 @@ class HttpService:
                              is not None for e in engines or []),
                 "available": engines is not None,
             },
+            "/debug/control": {
+                "what": "flight-control plane: controller state + "
+                        "knob-change actions with evidence",
+                "arm": "DYN_CONTROL=all|bucket,kvbm,router,forecast",
+                "armed": self.control_plane is not None,
+                "available": self.control_plane is not None,
+            },
         }
         return web.json_response({"surfaces": surfaces})
 
@@ -691,6 +703,25 @@ class HttpService:
             "enabled": any(p.get("enabled") for p in payloads),
             "engines": payloads,
         })
+
+    async def _debug_control(self, request: web.Request) -> web.Response:
+        """Flight-control view (docs/flight_control.md): armed
+        controllers, tick/action counters, per-controller state, and the
+        action ring — every knob change with its before/after values and
+        the evidence window that justified it. `?limit=N` bounds the
+        event dump. 503 unless DYN_CONTROL armed a controller on this
+        process."""
+        if self.control_plane is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "flight control not armed "
+                           "(set DYN_CONTROL=all or a controller list)"},
+                status=503)
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            limit = 64
+        return web.json_response(self.control_plane.payload(limit))
 
     async def _debug_router(self, request: web.Request) -> web.Response:
         """Router decision flight-recorder view (docs/observability.md
@@ -818,6 +849,9 @@ class HttpService:
             "/debug/kv": ("KV lifecycle ring: tier occupancy, eviction "
                           "causes, reuse distance, prefix hotness "
                           "(?limit=N)", False),
+            "/debug/control": ("Flight-control state: armed controllers "
+                               "+ knob-change actions with evidence "
+                               "(?limit=N)", False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -108,6 +108,10 @@ class MockEngine:
         # steps record the same goodput/padding attribution the real
         # dispatch sites do, with _pow2 as the bucketing model
         self.step_recorder = recorder_from_env(self.metrics)
+        # runtime-resizable bucket rungs (engine/bucketing.py): installed
+        # by the flight-control bucket autotuner; None (the default) keeps
+        # the static _pow2 bucketing byte-identical
+        self.bucket_ladder = None
         # KV lifecycle flight recorder parity (kvbm/lifecycle.py): the
         # mock block pools record the same allocate/hit/evict/kv_event
         # transitions, so the lifecycle math is analytically checkable
@@ -210,6 +214,11 @@ class MockEngine:
                 self._wake.clear()
                 await self._wake.wait()
                 continue
+            lad = self.bucket_ladder
+            if lad is not None:
+                # safe point: between dispatches, before this iteration's
+                # bucketing math runs
+                lad.maybe_apply()
             inj = self.fault_injector
             if inj is not None and inj.on_dispatch(
                     f"dispatch.{self.config.worker_id}") is not None:
@@ -286,6 +295,8 @@ class MockEngine:
             if rec is not None:
                 good = max(uncached_tokens, 0)
                 bucket = _pow2(good)
+                if self.bucket_ladder is not None:
+                    bucket = self.bucket_ladder.bucket_for(good, bucket)
                 rec.record("prefill", (1, bucket),
                            (end_ns - t0_ns) / 1e9, good_tokens=good,
                            work_tokens=bucket, lanes=1, width=1)
@@ -359,7 +370,10 @@ class MockEngine:
             # decode goodput == emitted tokens (make profile-smoke
             # asserts the two counters agree); width is the pow2 lane
             # bucket the real engine would have dispatched
-            width = min(_pow2(len(runnable)), cfg.max_batch_size)
+            width = _pow2(len(runnable))
+            if self.bucket_ladder is not None:
+                width = self.bucket_ladder.bucket_for(len(runnable), width)
+            width = min(width, cfg.max_batch_size)
             rec.record("decode_burst", (width, 1), step_ns / 1e9,
                        good_tokens=emitted, work_tokens=width,
                        lanes=len(runnable), width=width,

@@ -114,11 +114,19 @@ class Planner:
         self.last_targets: tuple[int, int] = (0, 0)
         self._task: Optional[asyncio.Task] = None
         self.decode_replicas = config.min_endpoint  # for concurrency calc
+        # optional hook (flight control's scale-aware forecasting): maps an
+        # observed IntervalMetrics to a replacement (or None to keep it)
+        # before it reaches the predictors. None ⇒ behavior unchanged.
+        self.observation_guard = None
 
     # -- observe ------------------------------------------------------------
 
     async def observe_metrics(self) -> None:
         m = await self.metrics_source.interval_metrics()
+        if self.observation_guard is not None:
+            guarded = self.observation_guard(m)
+            if guarded is not None:
+                m = guarded
         self.last_metrics = m
         self.num_req_predictor.add_data_point(m.num_req)
         self.isl_predictor.add_data_point(m.isl)

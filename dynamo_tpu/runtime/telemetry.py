@@ -378,7 +378,11 @@ class TelemetryCollector:
         return merge_snapshots([p.get("metrics") or {}
                                 for p in self.live().values()])
 
-    def fleet_status(self, slo=None) -> dict[str, Any]:
+    def fleet_status(self, slo=None, control=None) -> dict[str, Any]:
+        """`control` is the local ControlPlane's summary — a dict or a
+        zero-arg callable returning one (or None) — surfaced verbatim as
+        the `control` block so /fleet/status and doctor fleet show which
+        controllers are armed and what they last did."""
         now = time.time()
         components = []
         fleet_tok_s = 0.0
@@ -424,6 +428,10 @@ class TelemetryCollector:
             out["fleet"]["kv"] = fleet_kv
         if slo is not None:
             out["slo"] = slo.status()
+        if control is not None:
+            c = control() if callable(control) else control
+            if c is not None:
+                out["control"] = c
         return out
 
     async def stop(self) -> None:

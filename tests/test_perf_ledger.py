@@ -146,11 +146,15 @@ def test_perf_two_runs_byte_identical():
 
 
 def test_perf_record_carries_no_wall_clock():
-    text = record_to_json(run_perf(_small_cfg()))
+    rec = json.loads(record_to_json(run_perf(_small_cfg())))
+    # control_sim action stamps ("at") are virtual-clock ticks, not wall
+    # time — byte-identity across runs pins that; scan everything else
+    scan = dict(rec)
+    scan.pop("control_sim", None)
+    text = record_to_json(scan)
     for leak in ('"at"', "wall_span", "dispatch_gap", "goodput_tok_s",
                  "mean_s", "residency"):
         assert leak not in text
-    rec = json.loads(text)
     assert is_perf_record(rec)
     m = rec["metrics"]
     assert m["engine"]["goodput_tokens"] > 0
@@ -274,7 +278,8 @@ async def test_debug_index_endpoint(monkeypatch):
                 assert r.status == 200
                 surfaces = (await r.json())["surfaces"]
             assert set(surfaces) == {"/debug/requests", "/debug/profile",
-                                     "/debug/router", "/debug/kv"}
+                                     "/debug/router", "/debug/kv",
+                                     "/debug/control"}
             # always-on ring vs env-armed recorders, with the knob named
             assert surfaces["/debug/requests"]["armed"] is True
             assert surfaces["/debug/requests"]["arm"] is None
@@ -283,6 +288,8 @@ async def test_debug_index_endpoint(monkeypatch):
                 "DYN_STEP_PROFILE=1"
             assert surfaces["/debug/kv"]["armed"] is False  # not armed
             assert surfaces["/debug/kv"]["arm"] == "DYN_KV_LIFECYCLE=1"
+            assert surfaces["/debug/control"]["armed"] is False
+            assert surfaces["/debug/control"]["arm"].startswith("DYN_CONTROL")
             # round-robin model → no kv router on this frontend
             assert surfaces["/debug/router"]["available"] is False
             async with s.get(f"{fe.url}/openapi.json") as r:

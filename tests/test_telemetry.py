@@ -351,8 +351,13 @@ async def test_fleet_smoke(tmp_path, capsys):
                     assert r.status == 200
                     status = await r.json()
                 roles = {c["role"] for c in status["components"]}
+                # the worker's ttft snapshot and the frontend's request
+                # counter land on independent publish intervals — wait
+                # for both so the assertions below see a settled merge
                 if roles >= {"worker", "frontend"} \
-                        and status["fleet"]["latency"].get("ttft"):
+                        and status["fleet"]["latency"].get("ttft") \
+                        and status["fleet"]["metrics"].get(
+                            "dynamo_http_requests_total", 0) >= 1:
                     break
                 await asyncio.sleep(0.02)
     finally:

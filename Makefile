@@ -3,9 +3,9 @@
 
 PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
-.PHONY: tier0 tier1 chaos heal-smoke control-smoke kvbm-soak trace-smoke \
-	fleet-smoke autoscale-smoke profile-smoke router-smoke kv-smoke \
-	perf-gate perf-baseline
+.PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
+	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
+	kv-smoke perf-gate perf-baseline
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -21,7 +21,7 @@ tier1:
 # kills/stalls/wedges workers mid-stream and requires 100% of requests
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
-chaos: heal-smoke control-smoke
+chaos: heal-smoke control-smoke mem-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -43,6 +43,18 @@ heal-smoke:
 # change explainable via doctor control. Chip-free.
 control-smoke:
 	$(PYTEST) tests/test_control.py
+
+# memory-ledger gate (docs/observability.md "Memory ledger"): arm
+# DYN_MEM_LEDGER over MockEngine's analytic HBM model — ledger classes
+# must reconcile against mock memory_stats() EXACTLY (residual == the
+# configured unattributed bytes), the unarmed path stays
+# byte-identical, the seeded oom fault dumps a forensic crash file
+# whose triggering dispatch joins the step-recorder tail and exits
+# rc 45 into the supervisor's oom death-cause, the bench headroom gate
+# shrinks a too-big KV pool, and GET /debug/memory + doctor memory
+# render end to end. Chip-free.
+mem-smoke:
+	$(PYTEST) tests/test_memory_ledger.py
 
 # KVBM pipeline soak (docs/kvbm.md): loop admission/eviction with the
 # offload worker fault-delayed on every batch — output must stay

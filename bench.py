@@ -115,6 +115,55 @@ def bench_cfg(max_pages_per_seq=64, page_size=16):
         page_size=page_size, max_pages_per_seq=max_pages_per_seq)
 
 
+# headroom-gate decision for the current phase process (each phase is
+# its own subprocess, so this is per-phase state); embedded in the
+# phase JSON as `memory_headroom` so a shrunken pool is a recorded
+# decision, not a silent config drift
+_HEADROOM_PLAN: Optional[dict] = None
+
+
+def _gated_pages(cfg, requested_pages: int, max_batch: int,
+                 prefill_chunk: int) -> int:
+    """Bench headroom gate (engine/memory.py): before the engine is
+    built, predict the peak footprint — weights + KV pool + max-bucket
+    compile workspace — against the live device capacity and shrink the
+    KV pool when it would not fit, instead of burning the round the way
+    r03's RESOURCE_EXHAUSTED cascade did. Chip-free runs (no device
+    memory_stats) and fitting configs return `requested_pages`
+    unchanged."""
+    global _HEADROOM_PLAN
+    from dynamo_tpu.engine.memory import (
+        device_memory_stats,
+        headroom_plan,
+        kv_page_bytes,
+        predict_weights_bytes,
+        predict_workspace_bytes,
+    )
+
+    dev = device_memory_stats()
+    if dev is None or not dev.get("bytes_limit"):
+        return requested_pages
+    page_b = kv_page_bytes(cfg)
+    plan = headroom_plan(
+        dev["bytes_limit"],
+        predict_weights_bytes(cfg, quantize=QUANTIZE),
+        requested_pages * page_b,
+        predict_workspace_bytes(cfg, max_batch,
+                                max(prefill_chunk, max_batch)),
+        page_b, requested_pages)
+    _HEADROOM_PLAN = plan
+    if plan["fits"]:
+        return requested_pages
+    pages = plan["num_pages_target"]
+    gib = 2.0 ** 30
+    print(f"bench: headroom gate shrank the KV pool "
+          f"{requested_pages} -> {pages} pages "
+          f"(-{plan['shrink_pct']:.0f}%): predicted peak "
+          f"{plan['predicted_peak_bytes'] / gib:.2f}GiB vs budget "
+          f"{plan['budget_bytes'] / gib:.2f}GiB", flush=True)
+    return pages
+
+
 async def engine_phase(mk_engine, body):
     """Build an engine, run `body(eng)`, and GUARANTEE the chip is clean
     afterwards — including when the CONSTRUCTOR raises after allocating
@@ -277,9 +326,10 @@ async def phase_short():
     from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
 
     cfg = bench_cfg()
+    pages = _gated_pages(cfg, 2048, BATCH, 128)
     return await engine_phase(
         lambda: TpuEngine(TpuEngineConfig(
-            model=cfg, num_pages=2048, max_batch_size=BATCH,
+            model=cfg, num_pages=pages, max_batch_size=BATCH,
             prefill_chunk=128, default_max_tokens=OSL,
             decode_steps_per_sync=K_STEPS, quantize=QUANTIZE)),
         lambda eng: _phase_short_body(cfg, eng))
@@ -318,6 +368,8 @@ async def _phase_short_body(cfg, eng):
         "ttft_ms_unloaded_p50": round(ttft, 1),
         "phase_tok_s": [round(r, 1) for r in rates],
     }
+    if _HEADROOM_PLAN is not None:
+        out["memory_headroom"] = _HEADROOM_PLAN
     del params
     return out
 
@@ -332,9 +384,10 @@ async def phase_wide():
     from dynamo_tpu.engine.engine import TpuEngine, TpuEngineConfig
 
     cfg = bench_cfg()
+    pages = _gated_pages(cfg, 2048, W_BATCH, 128)
     return await engine_phase(
         lambda: TpuEngine(TpuEngineConfig(
-            model=cfg, num_pages=2048, max_batch_size=W_BATCH,
+            model=cfg, num_pages=pages, max_batch_size=W_BATCH,
             prefill_chunk=128, default_max_tokens=W_OSL,
             decode_steps_per_sync=K_STEPS, quantize=QUANTIZE)),
         lambda eng: _phase_wide_body(cfg, eng))
@@ -374,6 +427,8 @@ async def _phase_wide_body(cfg, eng):
         "batch": W_BATCH,
         "quantize": QUANTIZE,
     }
+    if _HEADROOM_PLAN is not None:
+        out["memory_headroom"] = _HEADROOM_PLAN
     del params
     return out
 
@@ -394,9 +449,10 @@ async def phase_long():
     # budgeted chunked-prefill interleaving (engine._prefill_budgeted):
     # 0 = legacy phase-alternating scheduler for A/B runs
     budget = int(os.environ.get("DYN_BENCH_PREFILL_BUDGET", "512"))
+    pages = _gated_pages(cfg, 1536, L_BATCH, 512)
     return await engine_phase(
         lambda: TpuEngine(TpuEngineConfig(
-            model=cfg, num_pages=1536, max_batch_size=L_BATCH,
+            model=cfg, num_pages=pages, max_batch_size=L_BATCH,
             prefill_chunk=512, default_max_tokens=L_OSL,
             decode_steps_per_sync=K_STEPS, quantize=QUANTIZE,
             prefill_chunk_budget=budget)),
@@ -497,6 +553,16 @@ async def _phase_long_body(cfg, eng):
     kvl = kv_lifecycle_summary(eng)
     if kvl is not None:
         out["kv_lifecycle"] = kvl
+    # HBM ledger block (engine/memory.py): present when the phase ran
+    # with DYN_MEM_LEDGER — per-class occupancy vs device memory_stats,
+    # with the residual the ledger could not attribute
+    from dynamo_tpu.engine.memory import memory_ledger_summary
+
+    mem = memory_ledger_summary(eng)
+    if mem is not None:
+        out["memory"] = mem
+    if _HEADROOM_PLAN is not None:
+        out["memory_headroom"] = _HEADROOM_PLAN
     del params
     return out
 
@@ -1046,6 +1112,10 @@ async def phase_traffic():
                                    for e in engines) if sp is not None]
     kv_summaries = [kv for kv in (kv_lifecycle_summary(e)
                                   for e in engines) if kv is not None]
+    from dynamo_tpu.engine.memory import memory_ledger_summary
+
+    mem_summaries = [m for m in (memory_ledger_summary(e)
+                                 for e in engines) if m is not None]
     await fe.stop()
     for h in handles:
         await h.stop()
@@ -1086,6 +1156,22 @@ async def phase_traffic():
             # (bench/ledger.py kv_premature_pct)
             "premature_pct": round(100.0 * prem / allocs, 3)
             if allocs else 0.0,
+        }
+    if mem_summaries:
+        # fleet-level HBM attribution: sum the per-class bytes across
+        # workers; residual + workspace-shape detail stays in
+        # /debug/memory (the mock fleet's model is analytic, so these
+        # are exact, not sampled)
+        classes: dict = {}
+        for m in mem_summaries:
+            for name, nbytes in m["classes"].items():
+                classes[name] = classes.get(name, 0) + nbytes
+        out["memory"] = {
+            "classes": classes,
+            "workspace_bytes": sum(m["workspace_bytes"]
+                                   for m in mem_summaries),
+            "attributed_bytes": sum(m["attributed_bytes"]
+                                    for m in mem_summaries),
         }
     if summary["errors"]:
         out["error"] = f"{summary['errors']} replay errors: " \
@@ -1138,6 +1224,14 @@ def run_one_phase(name: str) -> None:
         # any honest compile so a healthy run is unaffected
         os.environ.setdefault("DYN_WATCHDOG_STALL_S", "120")
         os.environ.setdefault("DYN_WATCHDOG_PREFLIGHT", "1")
+        # and the HBM memory ledger (engine/memory.py) so the records
+        # carry a per-class `memory` block; DYN_OOM_EXIT turns a device
+        # RESOURCE_EXHAUSTED into rc 45 + a forensic crash file the
+        # parent attaches to the round record (oom_report)
+        os.environ.setdefault("DYN_MEM_LEDGER", "1")
+        os.environ.setdefault("DYN_OOM_EXIT", "1")
+        os.environ.setdefault(
+            "DYN_MEM_CRASH_DIR", os.environ.get("TMPDIR", "/tmp"))
     try:
         result = asyncio.run(PHASES[name]())
     except Exception as e:
@@ -1178,7 +1272,18 @@ def _spawn_phase(name: str) -> dict:
             except json.JSONDecodeError:
                 break   # truncated marker (child killed mid-write)
     tail = (proc.stderr or proc.stdout or "")[-300:]
-    return {"error": f"phase process rc={proc.returncode}: {tail}"}
+    result = {"error": f"phase process rc={proc.returncode}: {tail}"}
+    from dynamo_tpu.engine.memory import OOM_EXIT_CODE, latest_oom_report
+
+    if proc.returncode == OOM_EXIT_CODE:
+        # the child died on a device OOM with forensics armed
+        # (DYN_OOM_EXIT): attach the crash file so the record — and
+        # `doctor bench` — carries the ledger attribution instead of a
+        # bare RESOURCE_EXHAUSTED tail
+        report = latest_oom_report()
+        if report is not None:
+            result["oom_report"] = report
+    return result
 
 
 def _device_preflight(attempts: int = 2) -> Optional[str]:
@@ -1216,9 +1321,20 @@ def main():
             # string-matching the error.
             from dynamo_tpu.doctor.preflight import classify
 
+            diag = classify(pf)
             out.update({"value": None, "vs_baseline": None,
                         "skipped": True, "error": pf,
-                        "preflight": classify(pf)})
+                        "preflight": diag})
+            if diag.get("kind") == "oom":
+                # an OOM-classified outage may be explained by a
+                # forensic crash file a previous run's ledger dumped
+                # (engine/memory.py): attach it so `doctor bench`
+                # renders the attribution, not just the diagnosis
+                from dynamo_tpu.engine.memory import latest_oom_report
+
+                report = latest_oom_report()
+                if report is not None:
+                    out["oom_report"] = report
             # the chip-free phases still run on an outage round: the
             # perf gate must keep guarding regressions even when the
             # device is wedged
@@ -1243,6 +1359,10 @@ def main():
     out.update(short if "error" not in short and "skipped" not in short
                else {"value": 0.0, "vs_baseline": 0.0,
                      "short_error": short.get("error", "skipped")})
+    if "error" in short and short.get("oom_report"):
+        # hoist the forensic crash file to the top-level record where
+        # bench/ledger.py normalize_run picks it up
+        out["oom_report"] = short["oom_report"]
     out["wide"] = run("wide")
     out["long"] = run("long")
     out["ckpt"] = run("ckpt")

@@ -49,6 +49,11 @@ class RunRecord:
     errors: list = field(default_factory=list)    # every error string found
     diagnosis: Optional[dict] = None  # {"kind", "detail"} preflight classify
     raw: dict = field(default_factory=dict)       # the unwrapped parsed dict
+    # forensic OOM crash report (engine/memory.py dump_oom_report),
+    # attached by bench.py when a phase died rc 45 — doctor bench
+    # renders its attribution on the outage row instead of a bare
+    # RESOURCE_EXHAUSTED tail
+    oom_report: Optional[dict] = None
 
 
 @dataclass
@@ -185,9 +190,16 @@ def normalize_run(data: dict, label: str = "") -> RunRecord:
     if status == "outage":
         metrics.pop("tok_s_chip", None)
 
+    oom_report = None
+    for container in (data, parsed):
+        rep = container.get("oom_report")
+        if isinstance(rep, dict):
+            oom_report = rep
+            break
+
     return RunRecord(label=label, round=rnd, status=status, value=value,
                      metrics=metrics, errors=errors, diagnosis=diagnosis,
-                     raw=parsed)
+                     raw=parsed, oom_report=oom_report)
 
 
 def load_run(path: str) -> RunRecord:

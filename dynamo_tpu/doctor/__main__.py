@@ -92,6 +92,10 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "kv": ("kv",
            "KV-cache memory plane from /debug/kv: tiers, evictions, "
            "reuse distance, hotness"),
+    "memory": ("memory",
+               "HBM memory ledger from /debug/memory (or an OOM crash "
+               "file): occupancy by class, headroom, workspace "
+               "shapes, unattributed residual"),
     "preflight": ("preflight",
                   "probe the device backend from a child process "
                   "(axon-wedge diagnosis)"),

@@ -52,6 +52,16 @@ def render_trajectory(records: list) -> str:
             lines.append(f"{head}  no number this round")
             lines.append(f"        cause: {diag.get('kind', 'unknown')}"
                          f" — {diag.get('detail', '(no detail)')}")
+            if rec.oom_report:
+                # memory-ledger forensics (engine/memory.py): the r03
+                # fix — attribution instead of a bare
+                # RESOURCE_EXHAUSTED tail
+                from dynamo_tpu.engine.memory import \
+                    format_oom_attribution
+                lines.append("        oom attribution: "
+                             + format_oom_attribution(rec.oom_report)
+                             + "  (`doctor memory <crash file>` for "
+                             "the full ledger)")
             continue
         lines.append(f"{head}  {_fmt(rec.value)} tok/s/chip")
         shown = []

@@ -106,6 +106,25 @@ def _fmt_kv(ks: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_memory(ms: Optional[dict]) -> str:
+    """HBM occupancy (present only on workers that armed
+    DYN_MEM_LEDGER)."""
+    if not ms:
+        return ""
+    gib = 2.0 ** 30
+    parts = [f"hbm={ms.get('attributed_bytes', 0) / gib:.2f}GiB"]
+    pct = ms.get("in_use_pct")
+    if pct is not None:
+        parts.append(f"({pct:.0f}% of device)")
+    una = ms.get("unattributed_bytes")
+    if una is not None:
+        parts.append(f"unattr={una / gib:.2f}GiB")
+    head = ms.get("headroom_bytes")
+    if head is not None:
+        parts.append(f"headroom={head / gib:.2f}GiB")
+    return "  " + " ".join(parts)
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -116,12 +135,14 @@ def render(status: dict) -> int:
               f"{_fmt_latency(c.get('latency') or {})}"
               f"{_fmt_goodput(c.get('goodput'))}"
               f"{_fmt_router(c.get('router'))}"
-              f"{_fmt_kv(c.get('kv'))}")
+              f"{_fmt_kv(c.get('kv'))}"
+              f"{_fmt_memory(c.get('memory'))}")
     fleet = status.get("fleet") or {}
     print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
           f"{_fmt_goodput(fleet.get('goodput'))}"
           f"{_fmt_router(fleet.get('router'))}"
-          f"{_fmt_kv(fleet.get('kv'))}")
+          f"{_fmt_kv(fleet.get('kv'))}"
+          f"{_fmt_memory(fleet.get('memory'))}")
     slo = status.get("slo")
     if slo:
         print("slo:")

@@ -1,0 +1,210 @@
+"""`python -m dynamo_tpu.doctor memory <url-or-file>` — explain where
+HBM went.
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/memory``;
+  * a ``.json`` capture of the same payload (or a single-engine
+    `memory_payload` dict, or a forensic OOM crash file) — the same
+    render works offline on a saved dump.
+
+Renders, per engine: occupancy bars by allocation class (weights /
+kv_pool / kvbm_pinned / kvbm_staged / workspace) against the device
+limit, headroom, the top compile-workspace shapes with their
+attribution source, and the **unattributed residual** — the device
+in-use bytes the ledger could not explain, printed explicitly (and
+flagged when large) rather than balanced away. On an OOM crash file it
+additionally prints the triggering entry/shape and the step-recorder
+tail the attribution joins. Exit code 0 when at least one engine (or
+crash report) was rendered, 1 when the input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+from dynamo_tpu.engine.memory import format_oom_attribution
+
+_GIB = 2.0 ** 30
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/memory from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/memory"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor memory: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor memory: cannot read {source}: {e!r}")
+        return None
+
+
+def _engine_payloads(body: dict) -> list[dict]:
+    """Normalize: the frontend wraps payloads in `engines`; a raw
+    single-engine `memory_payload` capture is accepted as-is."""
+    if isinstance(body.get("engines"), list):
+        return [e for e in body["engines"] if isinstance(e, dict)]
+    if "summary" in body or "snapshots" in body or "enabled" in body:
+        return [body]
+    return []
+
+
+def _bar(frac: float, width: int = 40) -> str:
+    n = int(round(max(0.0, min(frac, 1.0)) * width))
+    return "#" * n + "." * (width - n)
+
+
+def _gib(n) -> str:
+    try:
+        return f"{float(n) / _GIB:.2f}GiB"
+    except (TypeError, ValueError):
+        return str(n)
+
+
+def render_crash(report: dict) -> bool:
+    """Render a forensic OOM crash file (engine/memory.py
+    dump_oom_report)."""
+    print("OOM crash report:")
+    print(f"  error: {report.get('error', '?')}")
+    print(f"  attribution: {format_oom_attribution(report)}")
+    trig = report.get("triggering") or {}
+    if trig:
+        print(f"  triggering dispatch: {trig.get('entry', '?')} "
+              f"shape=({trig.get('shape', '?')})"
+              + (" [first call — compiling]" if trig.get("compiled")
+                 else ""))
+    snap = report.get("last_snapshot")
+    if snap:
+        _render_snapshot(snap)
+    tail = report.get("step_tail") or []
+    if tail:
+        print(f"  step-recorder tail ({len(tail)} step(s) before "
+              f"death):")
+        for s in tail[-8:]:
+            print(f"    {s.get('entry', '?'):<14} "
+                  f"shape={s.get('shape', '?')} "
+                  f"{1e3 * s.get('elapsed_s', 0.0):.1f}ms")
+    return True
+
+
+def _render_snapshot(snap: dict, indent: str = "  ") -> None:
+    dev = snap.get("device") or {}
+    limit = dev.get("bytes_limit", 0)
+    classes = dict(snap.get("classes") or {})
+    classes["workspace"] = snap.get("workspace_bytes", 0)
+    for name, nbytes in sorted(classes.items(),
+                               key=lambda kv: -kv[1]):
+        if limit:
+            pct = 100.0 * nbytes / limit
+            print(f"{indent}{name:<12} {_bar(nbytes / limit)} "
+                  f"{_gib(nbytes):>10} ({pct:.1f}%)")
+        else:
+            print(f"{indent}{name:<12} {_gib(nbytes):>10}")
+    attributed = snap.get("attributed_bytes", 0)
+    if dev:
+        in_use = dev.get("bytes_in_use", 0)
+        print(f"{indent}device: {_gib(in_use)} in use of "
+              f"{_gib(limit)} (peak {_gib(dev.get('peak_bytes_in_use', 0))})")
+        una = snap.get("unattributed_bytes")
+        if una is not None:
+            flag = ""
+            if limit and abs(una) > 0.05 * limit:
+                flag = ("  WARN large residual — attribution is "
+                        "missing an allocator" if una > 0
+                        else "  WARN negative residual — classes "
+                             "over-attribute (double count?)")
+            print(f"{indent}unattributed: {_gib(una)}{flag}")
+        head = snap.get("headroom_bytes")
+        if head is not None:
+            print(f"{indent}headroom: {_gib(head)}")
+    else:
+        print(f"{indent}device: no memory_stats on this backend — "
+              f"attributed {_gib(attributed)}, residual UNKNOWN "
+              f"(not zero)")
+
+
+def render_engine(payload: dict, idx: int, *, top_shapes: int = 10
+                  ) -> bool:
+    """Print one engine's view; False only on an empty payload."""
+    wid = payload.get("worker_id")
+    name = f"engine[{idx}]" if wid is None else f"worker {wid}"
+    print(f"{name}:")
+    if not payload.get("enabled"):
+        hint = payload.get("hint", "set DYN_MEM_LEDGER=1")
+        print(f"  ledger: disabled ({hint})")
+        return True
+    if payload.get("oom"):
+        print("  WARN this engine recorded an OOM — see the forensic "
+              "crash file (DYN_MEM_CRASH_DIR)")
+
+    s = payload.get("summary") or {}
+    print(f"  ledger: {s.get('polls', 0)} poll(s) "
+          f"({s.get('in_ring', 0)} in ring, {s.get('evicted', 0)} "
+          f"evicted), {s.get('dispatches', 0)} dispatch(es) observed")
+    last = s.get("last")
+    if last:
+        _render_snapshot(last)
+
+    ws = s.get("workspace") or {}
+    shapes = ws.get("shapes") or []
+    if shapes:
+        print(f"  compile workspace: {_gib(ws.get('total_bytes', 0))} "
+              f"across {len(shapes)} shape(s):")
+        for row in shapes[:top_shapes]:
+            print(f"    {row.get('entry', '?'):<14} "
+                  f"shape=({row.get('shape', '?')}) "
+                  f"{_gib(row.get('bytes', 0)):>10} "
+                  f"[{row.get('source', '?')}]")
+        if len(shapes) > top_shapes:
+            print(f"    ... {len(shapes) - top_shapes} more shape(s)")
+
+    cur = s.get("current_dispatch")
+    if cur:
+        print(f"  last dispatch: {cur.get('entry', '?')} "
+              f"shape=({cur.get('shape', '?')})")
+    return True
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor memory",
+        description="explain HBM occupancy (/debug/memory, a saved "
+                    "dump, or an OOM crash file)")
+    p.add_argument("source",
+                   help="frontend base url, memory JSON capture, or "
+                        "dynamo-oom-*.json crash file")
+    p.add_argument("--top", type=int, default=10,
+                   help="workspace-shape rows to show per engine")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    if body.get("kind") == "oom":
+        return 0 if render_crash(body) else 1
+    payloads = _engine_payloads(body)
+    if not payloads:
+        print("doctor memory: no engine payloads in input")
+        return 1
+    rendered = 0
+    for i, payload in enumerate(payloads):
+        if render_engine(payload, i, top_shapes=args.top):
+            rendered += 1
+    return 0 if rendered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ import numpy as np
 
 from dynamo_tpu.engine.metrics import EngineMetrics
 from dynamo_tpu.engine.pages import PagePool
+from dynamo_tpu.engine.memory import is_resource_exhausted, record_oom
 from dynamo_tpu.engine.profiler import recorder_from_env
 from dynamo_tpu.engine.sampling import sample_tokens_lp
 from dynamo_tpu.llm.perf import itl_percentile
@@ -671,6 +672,31 @@ class TpuEngine:
         self.kv_metrics = KvbmMetrics()
         self.kv_lifecycle = kv_recorder_from_env(self.kv_metrics)
         self.pool.lifecycle = self.kv_lifecycle
+        # HBM memory ledger (engine/memory.py): same contract — None
+        # unless DYN_MEM_LEDGER, dynamo_memory_* gauges always-on. When
+        # armed, every allocation class the engine controls is seeded
+        # here; KvbmManager registers its pinned/staged providers when
+        # attached; the CompileTracker dispatch sites feed workspace
+        # attribution and the triggering-dispatch marker OOM forensics
+        # joins on.
+        from dynamo_tpu.engine.memory import (MemoryMetrics,
+                                              ledger_from_env)
+        self.memory_metrics = MemoryMetrics()
+        self.memory_ledger = ledger_from_env(self.memory_metrics)
+        self._oom = False
+        if self.memory_ledger is not None:
+            from dynamo_tpu.models.loader import params_footprint
+
+            self.memory_ledger.set_class(
+                "weights", params_footprint(self.params),
+                source="models/loader post-load footprint")
+            # provider, not a frozen number: k/v caches are donated and
+            # replaced every step, and quantized KV swaps the dtype
+            self.memory_ledger.provider(
+                "kv_pool",
+                lambda: sum(a.nbytes for a in self.k_cache)
+                + sum(a.nbytes for a in self.v_cache),
+                source="engine/pages.py PagePool reservation")
         # raw ITL samples (ms), capped FIFO — bench reads these for
         # exact percentiles; the wire carries only the histogram
         self.itl_samples: list[float] = []
@@ -997,7 +1023,13 @@ class TpuEngine:
                     self._progress += 1
                 else:
                     await asyncio.sleep(0.001)
-            except Exception:
+            except Exception as exc:
+                led = self.memory_ledger
+                if led is not None and is_resource_exhausted(exc):
+                    # OOM forensics (engine/memory.py): dump the ledger
+                    # ring + step tail + triggering dispatch to a crash
+                    # file; exits rc 45 when DYN_OOM_EXIT is armed
+                    record_oom(self, exc)
                 logger.exception("engine scheduler iteration failed")
                 self._fail_all()
 
@@ -1236,6 +1268,9 @@ class TpuEngine:
         tk = (self.TOPK_WIDTH
               if any(s.wants_topk for s in pending) else 0)
         trk = self.metrics.compile.track("sample_first", (width, tk))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
             sampled = sample_tokens_lp(
                 logits_stack,
@@ -1459,6 +1494,9 @@ class TpuEngine:
 
         trk = self.metrics.compile.track(
             "mixed_step", (bp, t_bucket, k_steps, int(aligned), tk))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
 
         def dispatch():
             with trk:
@@ -1566,6 +1604,9 @@ class TpuEngine:
             cached[i] = off
             seq_lens[i] = off + n
         trk = self.metrics.compile.track("pp_prefill", (b_pad, t_pad))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
             logits, self.k_cache, self.v_cache = pp_prefill_paged(
                 self.params, self.k_cache, self.v_cache,
@@ -1732,6 +1773,10 @@ class TpuEngine:
                 "spec_decode",
                 (b, cfg.spec_gamma, cfg.spec_iters_per_sync, tk,
                  *sorted(gkw)))
+            led = self.memory_ledger
+            if led is not None:
+                led.on_dispatch(trk.entry, trk.shape,
+                                compiled=trk.compiled)
 
             def run_spec_burst():
                 packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
@@ -1857,6 +1902,10 @@ class TpuEngine:
 
             trk = self.metrics.compile.track(
                 "pp_decode", (b, k_steps, tk, bool(ckw)))
+            led = self.memory_ledger
+            if led is not None:
+                led.on_dispatch(trk.entry, trk.shape,
+                                compiled=trk.compiled)
             async with self._device_lock:
                 with trk:
                     packed, self.k_cache, self.v_cache = \
@@ -1892,6 +1941,10 @@ class TpuEngine:
 
             trk = self.metrics.compile.track(
                 "decode_burst", (b, k_steps, tk))
+            led = self.memory_ledger
+            if led is not None:
+                led.on_dispatch(trk.entry, trk.shape,
+                                compiled=trk.compiled)
             async with self._device_lock:
                 with trk:
                     packed_dev, self.k_cache, self.v_cache = \
@@ -1948,6 +2001,9 @@ class TpuEngine:
         trk = self.metrics.compile.track(
             "decode_guided" if use_constrained else "decode_burst",
             (b, k_steps, tk))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         async with self._device_lock:
             with trk:
                 packed, self.k_cache, self.v_cache = \
@@ -2200,6 +2256,9 @@ class TpuEngine:
             "prefill_draft" if (self.draft_params is not None
                                 and params_ is self.draft_params)
             else "prefill", (bp, t_bucket, int(aligned)))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
             logits_b, kc, vc = prefill_batch(
                 params_, kc, vc,
@@ -2762,6 +2821,10 @@ class TpuEngine:
         with self._kv_buffer_lock:
             trk = self.metrics.compile.track("gather_kv",
                                              (len(page_ids),))
+            led = self.memory_ledger
+            if led is not None:
+                led.on_dispatch(trk.entry, trk.shape,
+                                compiled=trk.compiled)
             with trk:
                 out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
                 out.block_until_ready()
@@ -2812,6 +2875,10 @@ class TpuEngine:
         with self._kv_buffer_lock:
             trk = self.metrics.compile.track("write_kv",
                                              (len(page_ids),))
+            led = self.memory_ledger
+            if led is not None:
+                led.on_dispatch(trk.entry, trk.shape,
+                                compiled=trk.compiled)
             with trk:
                 self.k_cache, self.v_cache = _write_kv_pages_jit(
                     self.k_cache, self.v_cache, ids,

@@ -142,6 +142,23 @@ class KvbmManager:
         self.store.lifecycle = self.lifecycle
         engine.pool.evict_hook = self._on_evict
         engine.kvbm = self
+        # HBM memory ledger (engine/memory.py): book the device bytes
+        # the KVBM pipeline holds beyond the KV pool itself — pages
+        # pinned against the offload queue (still device-resident until
+        # the drain gathers them) and host-staged onboard bytes. Live
+        # providers, polled per ledger snapshot; None unless armed.
+        led = getattr(engine, "memory_ledger", None)
+        if led is not None:
+            led.provider(
+                "kvbm_pinned",
+                lambda: engine.pool.pending_offload_pages
+                * self._block_nbytes(),
+                source="pool.pending_offload_pages * block_nbytes")
+            led.provider(
+                "kvbm_staged",
+                lambda: self._staged_bytes
+                + self._offload_q_blocks * self._block_nbytes(),
+                source="staged onboard bytes + offload queue depth")
 
     # -- controller surface (reference block_manager/controller.rs) --------
 

@@ -255,6 +255,14 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
 
         km.register(runtime.metrics,
                     occupancy=lambda eng=core: tier_occupancy(eng))
+    # HBM memory ledger surface (engine/memory.py): the dynamo_memory_*
+    # gauges join the scrape; with an armed ledger each scrape triggers
+    # a fresh reconciliation poll (the ledger stays None unless
+    # DYN_MEM_LEDGER armed it at engine construction)
+    mm = getattr(core, "memory_metrics", None)
+    if mm is not None and hasattr(mm, "register"):
+        mm.register(runtime.metrics,
+                    ledger=getattr(core, "memory_ledger", None))
     # one-token greedy canary (vllm health_check.py builds the same shape);
     # only probed when the runtime's health manager is enabled + idle.
     # The extra.canary marker lets sinks/metrics tell probes from traffic.

@@ -121,6 +121,7 @@ class HttpService:
             web.get("/debug/profile", self._debug_profile),
             web.get("/debug/router", self._debug_router),
             web.get("/debug/kv", self._debug_kv),
+            web.get("/debug/memory", self._debug_memory),
             web.get("/debug/control", self._debug_control),
             web.get("/openapi.json", self._openapi),
         ])
@@ -603,6 +604,15 @@ class HttpService:
                              is not None for e in engines or []),
                 "available": engines is not None,
             },
+            "/debug/memory": {
+                "what": "HBM memory ledger: per-class occupancy vs "
+                        "device memory_stats, workspace shapes, "
+                        "unattributed residual",
+                "arm": "DYN_MEM_LEDGER=1",
+                "armed": any(getattr(e, "memory_ledger", None)
+                             is not None for e in engines or []),
+                "available": engines is not None,
+            },
             "/debug/control": {
                 "what": "flight-control plane: controller state + "
                         "knob-change actions with evidence",
@@ -698,6 +708,32 @@ class HttpService:
         except ValueError:
             limit = 256
         payloads = [kv_payload(e, limit)
+                    for e in list(self.profile_engines() or [])]
+        return web.json_response({
+            "enabled": any(p.get("enabled") for p in payloads),
+            "engines": payloads,
+        })
+
+    async def _debug_memory(self, request: web.Request) -> web.Response:
+        """HBM memory ledger view (docs/observability.md "Memory
+        ledger"): per-engine allocation classes reconciled against
+        device memory_stats — weights, KV pool, KVBM pinned/staged,
+        compile-workspace shapes — with the explicit unattributed
+        residual and headroom. `?limit=N` bounds each snapshot-ring
+        dump. 503 when no in-proc engine is wired (frontend-only
+        process — hit the worker's surface)."""
+        if self.profile_engines is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no in-proc engine wired for memory ledger"},
+                status=503)
+        from dynamo_tpu.engine.memory import memory_payload
+
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            limit = 64
+        payloads = [memory_payload(e, limit)
                     for e in list(self.profile_engines() or [])]
         return web.json_response({
             "enabled": any(p.get("enabled") for p in payloads),
@@ -849,6 +885,9 @@ class HttpService:
             "/debug/kv": ("KV lifecycle ring: tier occupancy, eviction "
                           "causes, reuse distance, prefix hotness "
                           "(?limit=N)", False),
+            "/debug/memory": ("HBM memory ledger: class occupancy vs "
+                              "device stats, workspace shapes, "
+                              "unattributed residual (?limit=N)", False),
             "/debug/control": ("Flight-control state: armed controllers "
                                "+ knob-change actions with evidence "
                                "(?limit=N)", False),

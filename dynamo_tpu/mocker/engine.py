@@ -63,6 +63,15 @@ class MockEngineConfig:
     dp_rank: int = 0
     default_max_tokens: int = 16
     vocab_size: int = 32000
+    # analytic HBM model (engine/memory.py MemoryLedger): the mock
+    # "device" is a closed-form byte budget so every ledger number —
+    # classes, workspace, residual, headroom — is exactly recomputable
+    # in tests, the same way _pow2 makes padding math checkable.
+    hbm_bytes: int = 16 << 30
+    weights_bytes: int = 4 << 30
+    kv_block_bytes: int = 1 << 20
+    workspace_bytes_per_token: int = 4096
+    unattributed_bytes: int = 0      # deliberate residual for tests
 
 
 @dataclass
@@ -137,6 +146,41 @@ class MockEngine:
         from dynamo_tpu.runtime.faults import FaultInjector
 
         self.fault_injector = FaultInjector.from_env()
+        # HBM memory ledger parity (engine/memory.py): None unless
+        # DYN_MEM_LEDGER. The mock engine IS its own "device" — its
+        # memory_stats() below is the analytic model the ledger
+        # reconciles against, so attribution/residual math is
+        # chip-free testable.
+        from dynamo_tpu.engine.memory import (MemoryMetrics,
+                                              ledger_from_env)
+        self.memory_metrics = MemoryMetrics()
+        self.memory_ledger = ledger_from_env(self.memory_metrics,
+                                             device=self)
+        self._oom = False
+        self._peak_bytes = 0
+        if self.memory_ledger is not None:
+            cfg = self.config
+            self.memory_ledger.set_class(
+                "weights", cfg.weights_bytes,
+                source="MockEngineConfig.weights_bytes (analytic)")
+            self.memory_ledger.set_class(
+                "kv_pool", cfg.total_kv_blocks * cfg.kv_block_bytes,
+                source="total_kv_blocks * kv_block_bytes (analytic)")
+
+    def memory_stats(self) -> dict:
+        """The analytic stand-in for ``jax.Device.memory_stats()``:
+        in-use = every class the ledger books plus the configured
+        deliberate residual — so a test can assert the ledger's
+        unattributed_bytes equals cfg.unattributed_bytes EXACTLY."""
+        cfg = self.config
+        led = self.memory_ledger
+        ws = led.workspace_total() if led is not None else 0
+        in_use = (cfg.weights_bytes
+                  + cfg.total_kv_blocks * cfg.kv_block_bytes
+                  + ws + cfg.unattributed_bytes)
+        self._peak_bytes = max(self._peak_bytes, in_use)
+        return {"bytes_in_use": in_use, "bytes_limit": cfg.hbm_bytes,
+                "peak_bytes_in_use": self._peak_bytes}
 
     # -- engine contract ---------------------------------------------------
 
@@ -220,15 +264,35 @@ class MockEngine:
                 # bucketing math runs
                 lad.maybe_apply()
             inj = self.fault_injector
-            if inj is not None and inj.on_dispatch(
-                    f"dispatch.{self.config.worker_id}") is not None:
-                # injected wedge: park with work pending, exactly like a
-                # hung device dispatch; only close() (cancel) frees us,
-                # so recovery MUST come from watchdog → quarantine
-                logger.error("[fault] dispatch wedge: scheduler parked "
-                             "with %d running / %d waiting",
-                             len(self._running), len(self._waiting))
-                await asyncio.Event().wait()
+            if inj is not None:
+                action = inj.on_dispatch(
+                    f"dispatch.{self.config.worker_id}")
+                if action is not None and action[0] == "oom":
+                    # injected OOM: the chip-free model of a jitted
+                    # dispatch dying with RESOURCE_EXHAUSTED — runs the
+                    # same forensic path the real engine's scheduler
+                    # loop does (crash file, engine._oom, rc 45 when
+                    # DYN_OOM_EXIT is armed), errors out in-flight
+                    # streams, then kills the loop task so the
+                    # supervisor's task-mode _death_cause fires
+                    exc = RuntimeError(
+                        "[fault] RESOURCE_EXHAUSTED: out of memory "
+                        "(injected oom)")
+                    from dynamo_tpu.engine.memory import record_oom
+
+                    if self.memory_ledger is not None:
+                        record_oom(self, exc)
+                    self._fail_all(exc)
+                    raise exc
+                if action is not None:
+                    # injected wedge: park with work pending, exactly
+                    # like a hung device dispatch; only close()
+                    # (cancel) frees us, so recovery MUST come from
+                    # watchdog → quarantine
+                    logger.error("[fault] dispatch wedge: scheduler "
+                                 "parked with %d running / %d waiting",
+                                 len(self._running), len(self._waiting))
+                    await asyncio.Event().wait()
             self._admit()
             progressed = await self._prefill_new()
             progressed |= await self._decode_iter()
@@ -284,6 +348,12 @@ class MockEngine:
                 # cannot fit even after eviction: preempt or requeue
                 self._preempt(r)
                 continue
+            led = self.memory_ledger
+            if led is not None:
+                b = _pow2(max(uncached_tokens, 0))
+                led.on_dispatch(
+                    "prefill", (1, b),
+                    nbytes=b * cfg.workspace_bytes_per_token)
             t0_ns = time.time_ns()
             await self._sleep(max(uncached_tokens, 0)
                               * cfg.prefill_us_per_token / 1e6)
@@ -315,6 +385,11 @@ class MockEngine:
         runnable = [r for r in self._running if r.prefilled]
         if not runnable:
             return False
+        led = self.memory_ledger
+        if led is not None:
+            w = min(_pow2(len(runnable)), cfg.max_batch_size)
+            led.on_dispatch("decode_burst", (w, 1),
+                            nbytes=w * cfg.workspace_bytes_per_token)
         t0_ns = time.time_ns()
         await self._sleep(cfg.decode_ms_per_iter / 1e3)
         step_ns = time.time_ns() - t0_ns
@@ -407,6 +482,20 @@ class MockEngine:
             r.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=reason).to_dict())
         r.queue.put_nowait(None)
+
+    def _fail_all(self, exc) -> None:
+        """Error out every in-flight stream (TpuEngine._fail_all
+        analog) so callers see FINISH_ERROR instead of hanging on a
+        dead scheduler loop."""
+        for r in self._running + self._waiting:
+            if r.trace is not None:
+                r.trace.end(status="ERROR", finish_reason=FINISH_ERROR)
+            r.queue.put_nowait(EngineOutput(
+                token_ids=[], finish_reason=FINISH_ERROR,
+                extra={"error": str(exc)}).to_dict())
+            r.queue.put_nowait(None)
+        self._running.clear()
+        self._waiting.clear()
 
     def _preempt(self, r: _MockRequest) -> None:
         """Push a running request back to the head of the waiting queue,

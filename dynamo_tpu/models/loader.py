@@ -525,7 +525,24 @@ def _load_device_body(cfg, idx, pf, names, p, dense, throttle, state,
     # throttle only syncs every _SYNC_EVERY tensors)
     if state["last"] is not None:
         state["last"].block_until_ready()
+    _log.info("post-load device footprint: %.1f MiB",
+              params_footprint(params) / 2 ** 20)
     return params
+
+
+def params_footprint(params) -> int:
+    """Resident bytes of a (possibly quantized) param pytree — the
+    number the memory ledger books as the ``weights`` class. QTensor
+    leaves flatten to their q/s arrays under jax.tree, so int8/int4
+    footprints come out right without special-casing."""
+    try:
+        import jax
+
+        return int(sum(
+            int(getattr(x, "nbytes", 0) or 0)
+            for x in jax.tree.leaves(params)))
+    except Exception:
+        return 0
 
 
 def load_model(name_or_path: str, **cfg_overrides: Any

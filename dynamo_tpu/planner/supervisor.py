@@ -117,6 +117,10 @@ class FleetSupervisor:
         # budget window); pools the budget has written off
         self._respawns: dict[tuple[str, str], list[float]] = {}
         self._given_up: set[tuple[str, str]] = set()
+        # last death cause per pool: consecutive OOMs short-circuit the
+        # crash-loop budget (respawning into the same HBM footprint can
+        # only OOM again; the forensic crash file is the fix path)
+        self._last_cause: dict[tuple[str, str], str] = {}
         # fleet gauges on the process registry (→ /metrics and, via the
         # telemetry publisher, /fleet/status)
         m = runtime.metrics
@@ -264,6 +268,7 @@ class FleetSupervisor:
             rc = worker.proc.returncode
             if rc is None:
                 return None
+            from dynamo_tpu.engine.memory import OOM_EXIT_CODE
             from dynamo_tpu.worker.quarantine import QUARANTINE_EXIT_CODE
 
             if rc == QUARANTINE_EXIT_CODE:
@@ -272,12 +277,19 @@ class FleetSupervisor:
                 return "engine-death"
             if rc == 43:
                 return "canary"
+            if rc == OOM_EXIT_CODE:
+                return "oom"
             return f"crashed rc={rc}"
         engine = worker.engine
         if engine is None:
             return None
         if getattr(engine, "_quarantined", False):
             return "quarantined"
+        # checked before the loop-task exception: an OOM'd scheduler
+        # loop ALSO dies with an exception, but the forensic marker is
+        # the more specific cause
+        if getattr(engine, "_oom", False):
+            return "oom"
         t = getattr(engine, "_loop_task", None)
         if t is not None and t.done() and not t.cancelled() \
                 and t.exception() is not None:
@@ -342,15 +354,25 @@ class FleetSupervisor:
         now = time.monotonic()
         window = [t for t in self._respawns.get(pool, [])
                   if now - t <= cfg.crash_loop_window_s]
-        if len(window) >= cfg.crash_loop_budget:
+        prev_cause = self._last_cause.get(pool)
+        self._last_cause[pool] = cause
+        if (cause == "oom" and prev_cause == "oom") \
+                or len(window) >= cfg.crash_loop_budget:
             self._respawns[pool] = window
             if pool not in self._given_up:
                 self._given_up.add(pool)
-                logger.error(
-                    "supervisor: crash-loop budget exhausted for %s/%s "
-                    "(%d respawns in %.0fs) — giving up; operator "
-                    "attention required", comp, sub, len(window),
-                    cfg.crash_loop_window_s)
+                if cause == "oom" and prev_cause == "oom":
+                    logger.error(
+                        "supervisor: %s/%s OOMed twice in a row — "
+                        "giving up without burning the crash-loop "
+                        "budget (same footprint would OOM again); see "
+                        "the forensic crash file", comp, sub)
+                else:
+                    logger.error(
+                        "supervisor: crash-loop budget exhausted for "
+                        "%s/%s (%d respawns in %.0fs) — giving up; "
+                        "operator attention required", comp, sub,
+                        len(window), cfg.crash_loop_window_s)
                 self._c_events.inc(direction="giveup")
                 self.scale_events.append({
                     "at": time.time(), "pool": f"{comp}/{sub}",

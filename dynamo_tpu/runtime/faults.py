@@ -30,6 +30,11 @@ Spec grammar (``DYN_FAULTS`` env var, or `FaultInjector.from_spec`):
                            wedged jitted device call (docs/ROUND4_NOTES).
                            The dispatch watchdog (engine/watchdog.py)
                            must detect it and quarantine the worker.
+    kind=oom               a matching dispatch raises a synthetic
+                           RESOURCE_EXHAUSTED — the chip-free model of
+                           bench r03's death. The memory ledger's OOM
+                           forensics (engine/memory.py) must dump a
+                           crash file and exit rc 45 when armed.
     kind=store_outage      matching control-plane store ops raise
                            ConnectionError — the coordinator is
                            unreachable; routers must keep serving from
@@ -84,10 +89,12 @@ OFFLOAD_STALL = "offload_stall"
 # self-healing fault kinds (engine/watchdog.py, runtime/store.py)
 DISPATCH_WEDGE = "dispatch_wedge"
 STORE_OUTAGE = "store_outage"
+# OOM forensics fault kind (engine/memory.py)
+OOM = "oom"
 
 _KINDS = {CONNECT_REFUSED, DISCONNECT, STALL, DELAY, ERR,
           ENGINE_ERR, ENGINE_STALL, OFFLOAD_DELAY, OFFLOAD_STALL,
-          DISPATCH_WEDGE, STORE_OUTAGE}
+          DISPATCH_WEDGE, STORE_OUTAGE, OOM}
 
 
 @dataclass
@@ -231,11 +238,13 @@ class FaultInjector:
         """Consulted by the engine scheduler loop once per iteration
         (`subject` = "dispatch.<worker_id>"). ("wedge",): the loop must
         park until cancelled — a wedged device dispatch with work
-        pending, exactly what the dispatch watchdog exists to catch."""
-        r = self._fire((DISPATCH_WEDGE,), None, subject)
+        pending, exactly what the dispatch watchdog exists to catch.
+        ("oom",): the loop must raise a synthetic RESOURCE_EXHAUSTED —
+        the memory ledger's forensic path catches it."""
+        r = self._fire((DISPATCH_WEDGE, OOM), None, subject)
         if r is None:
             return None
-        return ("wedge",)
+        return ("oom",) if r.kind == OOM else ("wedge",)
 
     def on_store_op(self, op: str, key: Optional[str] = None
                     ) -> Optional[tuple]:

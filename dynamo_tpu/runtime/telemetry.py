@@ -262,6 +262,47 @@ def kv_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     return out
 
 
+def _gauge_by_label(snapshot: dict[str, dict], name: str,
+                    label: str) -> dict[str, float]:
+    m = snapshot.get(name)
+    if not m or m.get("type") != "gauge":
+        return {}
+    out: dict[str, float] = {}
+    for lbl, v in m.get("values", []):
+        out[dict(lbl).get(label, "")] = v
+    return out
+
+
+def memory_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """HBM occupancy from the memory ledger's gauges
+    (engine/memory.py). None when the component never armed
+    `DYN_MEM_LEDGER` — the fleet view stays unchanged for unledgered
+    workers. The unattributed residual rides along verbatim: the fleet
+    plane must show the same honest number /debug/memory does."""
+    classes = _gauge_by_label(snapshot, "dynamo_memory_class_bytes",
+                              "class")
+    if not classes:
+        return None
+    out: dict[str, Any] = {
+        "classes": {k: int(v) for k, v in sorted(classes.items())},
+        "attributed_bytes": int(sum(classes.values())),
+    }
+    dev = _gauge_by_label(snapshot, "dynamo_memory_device_bytes", "kind")
+    if dev:
+        out["device"] = {k: int(v) for k, v in sorted(dev.items())}
+        limit = dev.get("limit", 0.0)
+        if limit:
+            out["in_use_pct"] = round(
+                100.0 * dev.get("in_use", 0.0) / limit, 2)
+    una = snapshot.get("dynamo_memory_unattributed_bytes")
+    if una and una.get("values"):
+        out["unattributed_bytes"] = int(una["values"][0][1])
+    head = snapshot.get("dynamo_memory_headroom_bytes")
+    if head and head.get("values"):
+        out["headroom_bytes"] = int(head["values"][0][1])
+    return out
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -407,6 +448,9 @@ class TelemetryCollector:
             ks = kv_summary(metrics)
             if ks is not None:
                 entry["kv"] = ks
+            ms = memory_summary(metrics)
+            if ms is not None:
+                entry["memory"] = ms
             components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
@@ -426,6 +470,9 @@ class TelemetryCollector:
         fleet_kv = kv_summary(merged)
         if fleet_kv is not None:
             out["fleet"]["kv"] = fleet_kv
+        fleet_mem = memory_summary(merged)
+        if fleet_mem is not None:
+            out["fleet"]["memory"] = fleet_mem
         if slo is not None:
             out["slo"] = slo.status()
         if control is not None:

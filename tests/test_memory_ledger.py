@@ -1,0 +1,692 @@
+"""Memory ledger (engine/memory.py): ring semantics, byte-identical
+off path, analytic reconciliation against MockEngine's HBM model, OOM
+forensics (crash file + rc 45 + supervisor death cause), the bench
+headroom gate, doctor memory rendering, the fleet memory block, and
+the /debug/memory surface."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.engine.memory import (
+    OOM_EXIT_CODE,
+    MemoryLedger,
+    MemoryMetrics,
+    format_oom_attribution,
+    headroom_plan,
+    is_resource_exhausted,
+    kv_page_bytes,
+    latest_oom_report,
+    ledger_from_env,
+    memory_ledger_summary,
+    memory_payload,
+    predict_weights_bytes,
+    predict_workspace_bytes,
+)
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
+from dynamo_tpu.protocols import FINISH_ERROR
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.faults import FaultInjector
+
+pytestmark = pytest.mark.tier0
+
+
+def make_request(tokens, max_tokens=8):
+    return {"token_ids": tokens, "model": "m",
+            "stop": {"max_tokens": max_tokens}, "sampling": {}}
+
+
+async def run_tokens(eng, tokens=None, max_tokens=8):
+    out: list[int] = []
+    fin = None
+    req = make_request(tokens or list(range(16)), max_tokens)
+    async for o in eng.generate(req, Context()):
+        out.extend(o.get("token_ids", ()))
+        fin = o.get("finish_reason") or fin
+    return out, fin
+
+
+# -- ring semantics / env gating --------------------------------------------
+
+
+def test_env_gate_and_capacity():
+    assert ledger_from_env(env={}) is None
+    assert ledger_from_env(env={"DYN_MEM_LEDGER": "0"}) is None
+    led = ledger_from_env(env={"DYN_MEM_LEDGER": "1",
+                               "DYN_MEM_LEDGER_RING": "64"})
+    assert led is not None and led.capacity == 64
+    # junk ring size falls back to the default rather than raising
+    led = ledger_from_env(env={"DYN_MEM_LEDGER": "yes",
+                               "DYN_MEM_LEDGER_RING": "nope"})
+    assert led is not None and led.capacity == 256
+    # capacity floor
+    assert MemoryLedger(capacity=1).capacity == 16
+
+
+def test_ring_bound_and_eviction():
+    led = MemoryLedger(capacity=16)
+    led.set_class("weights", 100)
+    for _ in range(40):
+        led.poll()
+    s = led.summary()
+    assert s["polls"] == 40
+    assert s["in_ring"] == 16
+    assert s["capacity"] == 16
+    assert s["evicted"] == 24
+    assert len(led.snapshot()) == 16
+    assert len(led.snapshot(limit=4)) == 4
+    led.clear()
+    assert led.summary()["in_ring"] == 0
+
+
+# -- workspace attribution sources ------------------------------------------
+
+
+def test_workspace_attribution_sources():
+    led = MemoryLedger(capacity=16)
+    # analytic: first dispatch per (entry, shape) key wins, repeats
+    # are free no-ops
+    led.on_dispatch("decode_burst", (8, 1), nbytes=8 * 4096)
+    led.on_dispatch("decode_burst", (8, 1), nbytes=999)
+    assert led.workspace_total() == 8 * 4096
+
+    # memory_analysis: an AOT executable beats everything
+    class _MA:
+        temp_size_in_bytes = 1000
+        output_size_in_bytes = 200
+        generated_code_size_in_bytes = 30
+
+    class _Exe:
+        def memory_analysis(self):
+            return _MA()
+
+    led.on_dispatch("prefill", (1, 128), compiled=True, executable=_Exe())
+    ws = led.summary()["workspace"]
+    rows = {(r["entry"], r["shape"]): r for r in ws["shapes"]}
+    assert rows[("prefill", "1x128")]["bytes"] == 1230
+    assert rows[("prefill", "1x128")]["source"] == "memory_analysis"
+
+    # no executable, no analytic bytes, no device stats: an honest
+    # zero-byte "unknown" placeholder, never an invented number
+    led2 = MemoryLedger(capacity=16)
+    led2.on_dispatch("sample_first", (4,), compiled=True)
+    row = led2.summary()["workspace"]["shapes"][0]
+    assert row["source"] == "unknown" and row["bytes"] == 0
+    assert led2.current_dispatch()["entry"] == "sample_first"
+    assert led2.current_dispatch()["compiled"] is True
+
+
+def test_workspace_device_delta_settles_at_next_hook():
+    class _Dev:
+        in_use = 1000
+
+        def memory_stats(self):
+            return {"bytes_in_use": self.in_use, "bytes_limit": 10_000,
+                    "peak_bytes_in_use": self.in_use}
+
+    dev = _Dev()
+    led = MemoryLedger(capacity=16, device=dev)
+    led.on_dispatch("mixed_step", (8, 256), compiled=True)
+    # the compile allocated workspace; the NEXT hook reads the delta
+    dev.in_use = 4000
+    led.on_dispatch("decode_burst", (8, 1), compiled=False)
+    rows = {(r["entry"], r["shape"]): r
+            for r in led.summary()["workspace"]["shapes"]}
+    assert rows[("mixed_step", "8x256")]["bytes"] == 3000
+    assert rows[("mixed_step", "8x256")]["source"] == "device-delta"
+
+
+# -- analytic reconciliation against the mock HBM model ---------------------
+
+
+async def test_mock_ledger_reconciles_exactly(monkeypatch):
+    monkeypatch.setenv("DYN_MEM_LEDGER", "1")
+    monkeypatch.delenv("DYN_OOM_EXIT", raising=False)
+    cfg = MockEngineConfig(speedup=500.0, unattributed_bytes=7 << 20)
+    eng = MockEngine(cfg)
+    try:
+        assert eng.memory_ledger is not None
+        toks, fin = await run_tokens(eng)
+        assert fin == "length" and toks
+        led = eng.memory_ledger
+        snap = led.poll()
+        kv_pool = cfg.total_kv_blocks * cfg.kv_block_bytes
+        assert snap["classes"]["weights"] == cfg.weights_bytes
+        assert snap["classes"]["kv_pool"] == kv_pool
+        assert snap["workspace_bytes"] == led.workspace_total() > 0
+        assert snap["attributed_bytes"] == (
+            cfg.weights_bytes + kv_pool + snap["workspace_bytes"])
+        # the residual is EXACTLY the configured unattributed bytes —
+        # the ledger reports it, never balances it away
+        assert snap["device"]["bytes_limit"] == cfg.hbm_bytes
+        assert snap["unattributed_bytes"] == 7 << 20
+        assert snap["headroom_bytes"] == (
+            cfg.hbm_bytes - snap["attributed_bytes"] - (7 << 20))
+        # the prefill/decode dispatch hooks booked the _pow2 buckets
+        rows = {(r["entry"], r["shape"]): r["bytes"]
+                for r in led.summary()["workspace"]["shapes"]}
+        assert rows[("prefill", "1x16")] == \
+            16 * cfg.workspace_bytes_per_token
+        assert rows[("decode_burst", "1x1")] == \
+            cfg.workspace_bytes_per_token
+        # bench's compact block agrees
+        mem = memory_ledger_summary(eng)
+        assert mem is not None
+        assert mem["unattributed_bytes"] == 7 << 20
+        assert mem["classes"]["weights"] == cfg.weights_bytes
+        # gauges carry the same numbers (fleet plane source)
+        assert eng.memory_metrics.class_bytes.get(
+            **{"class": "weights"}) == cfg.weights_bytes
+    finally:
+        await eng.close()
+
+
+async def test_unarmed_path_byte_identical(monkeypatch):
+    monkeypatch.delenv("DYN_MEM_LEDGER", raising=False)
+    off = MockEngine(MockEngineConfig(speedup=500.0))
+    assert off.memory_ledger is None
+    toks_off, fin_off = await run_tokens(off)
+    await off.close()
+    p = memory_payload(off)
+    assert p["enabled"] is False and "DYN_MEM_LEDGER" in p["hint"]
+    assert memory_ledger_summary(off) is None
+
+    monkeypatch.setenv("DYN_MEM_LEDGER", "1")
+    on = MockEngine(MockEngineConfig(speedup=500.0))
+    assert on.memory_ledger is not None
+    toks_on, fin_on = await run_tokens(on)
+    await on.close()
+    assert (toks_on, fin_on) == (toks_off, fin_off)
+
+
+# -- OOM forensics -----------------------------------------------------------
+
+
+def test_is_resource_exhausted():
+    assert is_resource_exhausted(RuntimeError("RESOURCE_EXHAUSTED: blah"))
+    assert is_resource_exhausted(RuntimeError("ran Out of Memory today"))
+    assert not is_resource_exhausted(ValueError("shape mismatch"))
+
+
+async def test_injected_oom_dumps_forensics(monkeypatch, tmp_path):
+    monkeypatch.setenv("DYN_MEM_LEDGER", "1")
+    monkeypatch.setenv("DYN_STEP_PROFILE", "1")
+    monkeypatch.setenv("DYN_MEM_CRASH_DIR", str(tmp_path))
+    monkeypatch.delenv("DYN_OOM_EXIT", raising=False)
+    eng = MockEngine(MockEngineConfig(speedup=500.0, worker_id=7))
+    eng.fault_injector = FaultInjector.from_spec("kind=oom,after=2")
+    try:
+        toks, fin = await run_tokens(eng, max_tokens=64)
+        # in-flight stream errored instead of hanging
+        assert fin == FINISH_ERROR
+        assert eng.fault_injector.fired["oom"] == 1
+        assert eng._oom is True
+        assert memory_payload(eng)["oom"] is True
+
+        files = sorted(tmp_path.glob("dynamo-oom-*.json"))
+        assert len(files) == 1
+        report = json.loads(files[0].read_text())
+        assert report["kind"] == "oom"
+        assert report["worker_id"] == 7
+        assert "RESOURCE_EXHAUSTED" in report["error"]
+        # the triggering dispatch marker names the entry/shape the
+        # last hook saw before death...
+        trig = report["triggering"]
+        assert trig["entry"] in ("prefill", "decode_burst")
+        # ...and joins the step-recorder ring on the same entry names
+        tail = report["step_tail"]
+        assert tail and any(s["entry"] == trig["entry"] for s in tail)
+        assert report["last_snapshot"]["classes"]["weights"] > 0
+        assert report["snapshots"]
+
+        picked = latest_oom_report(
+            env={"DYN_MEM_CRASH_DIR": str(tmp_path)})
+        assert picked is not None
+        assert picked["path"] == str(files[0])
+        assert picked["kind"] == "oom"
+    finally:
+        await eng.close()
+
+
+def test_oom_fault_spec_parses_and_fires():
+    inj = FaultInjector.from_spec("kind=oom,subject=dispatch.3")
+    assert inj.on_dispatch("dispatch.9") is None
+    assert inj.on_dispatch("dispatch.3") == ("oom",)
+    assert inj.on_dispatch("dispatch.3") is None        # times=1 default
+    wedge = FaultInjector.from_spec("kind=dispatch_wedge")
+    assert wedge.on_dispatch("dispatch.1") == ("wedge",)
+
+
+def test_oom_exit_rc45_in_subprocess(tmp_path):
+    """DYN_OOM_EXIT armed: the forensic path ends in os._exit(45), the
+    rc the supervisor and bench driver key on."""
+    code = (
+        "import asyncio\n"
+        "from dynamo_tpu.mocker.engine import MockEngine, "
+        "MockEngineConfig\n"
+        "from dynamo_tpu.runtime.context import Context\n"
+        "from dynamo_tpu.runtime.faults import FaultInjector\n"
+        "async def main():\n"
+        "    eng = MockEngine(MockEngineConfig(speedup=500.0))\n"
+        "    eng.fault_injector = FaultInjector.from_spec('kind=oom')\n"
+        "    req = {'token_ids': [1, 2, 3], 'model': 'm',\n"
+        "           'stop': {'max_tokens': 8}, 'sampling': {}}\n"
+        "    async for _ in eng.generate(req, Context()):\n"
+        "        pass\n"
+        "asyncio.run(main())\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DYN_MEM_LEDGER="1",
+               DYN_OOM_EXIT="1", DYN_MEM_CRASH_DIR=str(tmp_path))
+    env.pop("DYN_FAULTS", None)
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert p.returncode == OOM_EXIT_CODE == 45, (p.stdout, p.stderr)
+    assert list(tmp_path.glob("dynamo-oom-*.json"))
+
+
+def test_format_oom_attribution():
+    report = {
+        "error": "RuntimeError: RESOURCE_EXHAUSTED: out of memory",
+        "triggering": {"entry": "decode_burst", "shape": "8x4096"},
+        "last_snapshot": {
+            "classes": {"weights": 4 << 30, "kv_pool": int(12.5 * 2**30)},
+            "workspace_bytes": 1 << 30,
+            "device": {"bytes_in_use": 16 << 30, "bytes_limit": 16 << 30,
+                       "peak_bytes_in_use": 16 << 30},
+            "unattributed_bytes": 0,
+        },
+    }
+    s = format_oom_attribution(report)
+    assert "KV pool 78% + shape (8,4096) workspace" == s
+    # no snapshot at all: fall back to the raw error, never crash
+    assert "RESOURCE_EXHAUSTED" in format_oom_attribution(
+        {"error": "RuntimeError: RESOURCE_EXHAUSTED: out of memory"})
+
+
+# -- supervisor integration --------------------------------------------------
+
+
+def test_death_cause_maps_rc45_and_oom_flag():
+    from types import SimpleNamespace as NS
+
+    from dynamo_tpu.planner.supervisor import FleetSupervisor
+
+    dc = FleetSupervisor._death_cause
+    assert dc(None, NS(proc=NS(returncode=OOM_EXIT_CODE),
+                       engine=None)) == "oom"
+    assert dc(None, NS(proc=NS(returncode=None), engine=None)) is None
+    # task mode: the _oom marker wins over the loop-task exception
+    assert dc(None, NS(proc=None,
+                       engine=NS(_quarantined=False, _oom=True))) == "oom"
+
+
+async def test_supervisor_consecutive_oom_gives_up():
+    """One OOM respawns (cause 'oom'); a second consecutive OOM writes
+    the pool off even with a roomy crash-loop budget — the same HBM
+    footprint would only OOM again."""
+    from dynamo_tpu.planner.supervisor import (
+        FleetSupervisor,
+        SupervisorConfig,
+    )
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    sup = await FleetSupervisor(rt, SupervisorConfig(
+        mock_speedup=200.0, drain_grace_s=0.2, health_poll_s=0.03,
+        respawn_backoff_base=0.0, respawn_backoff_max=0.05,
+        crash_loop_budget=10, crash_loop_window_s=60.0)).start()
+    pool = ("backend", "decode")
+    try:
+        assert await sup.apply({"revision": 1, "targets": [
+            {"component": "backend", "sub_component_type": "decode",
+             "desired_replicas": 1}]})
+        for _ in range(400):
+            if any(e.get("direction") == "giveup"
+                   for e in sup.scale_events):
+                break
+            ws = sup.pools.get(pool, [])
+            if ws:
+                ws[0].engine._oom = True
+            await asyncio.sleep(0.02)
+        respawns = [e for e in sup.scale_events
+                    if e.get("direction") == "respawn"]
+        giveups = [e for e in sup.scale_events
+                   if e.get("direction") == "giveup"]
+        assert respawns and respawns[0]["cause"] == "oom"
+        assert giveups, sup.scale_events
+        assert giveups[0]["cause"] == "oom"
+        # short-circuited: far fewer respawns than the budget allows
+        assert giveups[0]["respawns_in_window"] < 10
+        assert sup.replicas("backend", "decode") == 0
+    finally:
+        await sup.stop()
+        await rt.close()
+
+
+# -- bench headroom gate -----------------------------------------------------
+
+
+def test_headroom_plan_fits_and_shrinks():
+    page_b = 1 << 20
+    fit = headroom_plan(16 << 30, 4 << 30, 512 * page_b, 1 << 30,
+                        page_b, 512)
+    assert fit["fits"] is True
+    assert fit["predicted_peak_bytes"] == (4 << 30) + (512 << 20) + (1 << 30)
+
+    plan = headroom_plan(8 << 30, 4 << 30, 4096 * page_b, 1 << 30,
+                         page_b, 4096)
+    assert plan["fits"] is False
+    target = plan["num_pages_target"]
+    assert 8 <= target < 4096
+    assert plan["shrink_pct"] > 0
+    # the shrunken pool actually fits the budget
+    assert (4 << 30) + target * page_b + (1 << 30) <= plan["budget_bytes"]
+    # pathological capacity still leaves the floor pool
+    tiny = headroom_plan(1 << 30, 4 << 30, 4096 * page_b, 1 << 30,
+                         page_b, 4096)
+    assert tiny["num_pages_target"] == 8
+
+
+def test_weight_and_workspace_predictors():
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+        page_size=32, max_pages_per_seq=64)
+    bf16 = predict_weights_bytes(cfg)
+    int8 = predict_weights_bytes(cfg, quantize="int8")
+    int4 = predict_weights_bytes(cfg, quantize="int4")
+    assert bf16 > int8 > int4 > 0
+    assert kv_page_bytes(cfg) == 2 * 16 * 8 * 32 * 128 * 2
+    assert predict_workspace_bytes(cfg, 32, 512) >= 512 * 32000 * 4
+
+
+def test_bench_gated_pages_noop_without_device_stats(monkeypatch):
+    """On a backend without memory_stats (CPU) the gate must be a
+    no-op: requested pages pass through untouched."""
+    import bench
+
+    monkeypatch.setattr(
+        "dynamo_tpu.engine.memory.device_memory_stats", lambda: None)
+    cfg = bench.bench_cfg()
+    assert bench._gated_pages(cfg, 2048, 16, 128) == 2048
+
+
+# -- doctor memory -----------------------------------------------------------
+
+
+def test_doctor_memory_renders_dump_and_crash(tmp_path, capsys):
+    from dynamo_tpu.doctor.__main__ import main as doctor_main
+    from dynamo_tpu.doctor.memory import main as mem_main
+
+    led = MemoryLedger(capacity=16)
+    led.set_class("weights", 4 << 30)
+    led.set_class("kv_pool", 2 << 30)
+    led.on_dispatch("decode_burst", (8, 1), nbytes=64 << 20)
+    led.poll()
+    payload = {"enabled": True, "engines": [
+        {"enabled": True, "worker_id": 3, "summary": led.summary(),
+         "snapshots": led.snapshot(), "oom": False}]}
+    dump = tmp_path / "memory.json"
+    dump.write_text(json.dumps(payload))
+    assert mem_main([str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "worker 3:" in out
+    assert "weights" in out and "kv_pool" in out
+    # no device stats on this ledger: the residual is declared unknown,
+    # never silently balanced to zero
+    assert "residual UNKNOWN" in out
+
+    # a crash file renders attribution + triggering dispatch + step tail
+    crash = {
+        "kind": "oom",
+        "error": "RuntimeError: RESOURCE_EXHAUSTED: out of memory",
+        "triggering": {"entry": "decode_burst", "shape": "8x1",
+                       "compiled": True},
+        "last_snapshot": {
+            "classes": {"weights": 4 << 30, "kv_pool": 12 << 30},
+            "workspace_bytes": 1 << 30,
+            "attributed_bytes": 17 << 30,
+            "device": {"bytes_in_use": 16 << 30,
+                       "bytes_limit": 16 << 30,
+                       "peak_bytes_in_use": 16 << 30},
+            "unattributed_bytes": -(1 << 30),
+            "headroom_bytes": 0,
+        },
+        "step_tail": [{"entry": "decode_burst", "shape": "8x1",
+                       "elapsed_s": 0.011}],
+    }
+    crash_f = tmp_path / "dynamo-oom-1-1.json"
+    crash_f.write_text(json.dumps(crash))
+    assert mem_main([str(crash_f)]) == 0
+    out = capsys.readouterr().out
+    assert "OOM crash report" in out
+    assert "triggering dispatch: decode_burst" in out
+    assert "step-recorder tail" in out
+    assert "WARN negative residual" in out
+
+    # disabled payload renders the arming hint; junk input exits 1;
+    # the doctor subcommand table dispatches here
+    off = tmp_path / "off.json"
+    off.write_text(json.dumps({"enabled": False,
+                               "hint": "set DYN_MEM_LEDGER=1"}))
+    assert mem_main([str(off)]) == 0
+    assert "disabled" in capsys.readouterr().out
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    assert mem_main([str(empty)]) == 1
+    assert doctor_main(["memory", str(tmp_path / "missing.json")]) == 1
+
+
+def test_doctor_memory_flags_large_positive_residual(tmp_path, capsys):
+    from dynamo_tpu.doctor.memory import main as mem_main
+
+    payload = {"enabled": True, "worker_id": 1, "oom": False,
+               "summary": {"polls": 1, "in_ring": 1, "evicted": 0,
+                           "dispatches": 0,
+                           "workspace": {"total_bytes": 0, "shapes": []},
+                           "last": {
+                               "classes": {"weights": 4 << 30},
+                               "workspace_bytes": 0,
+                               "attributed_bytes": 4 << 30,
+                               "device": {"bytes_in_use": 8 << 30,
+                                          "bytes_limit": 16 << 30,
+                                          "peak_bytes_in_use": 8 << 30},
+                               "unattributed_bytes": 4 << 30,
+                               "headroom_bytes": 8 << 30}},
+               "snapshots": []}
+    f = tmp_path / "p.json"
+    f.write_text(json.dumps(payload))
+    assert mem_main([str(f)]) == 0
+    out = capsys.readouterr().out
+    assert "WARN large residual" in out
+    assert "headroom" in out
+
+
+# -- bench ledger / doctor bench join ---------------------------------------
+
+
+def test_bench_record_carries_oom_report(capsys):
+    from dynamo_tpu.bench.ledger import normalize_run
+    from dynamo_tpu.doctor.bench import render_trajectory
+
+    crash = {
+        "kind": "oom",
+        "error": "RuntimeError: RESOURCE_EXHAUSTED: out of memory",
+        "triggering": {"entry": "decode_burst", "shape": "8x4096"},
+        "last_snapshot": {
+            "classes": {"kv_pool": int(12.5 * 2 ** 30)},
+            "workspace_bytes": 1 << 30,
+            "device": {"bytes_in_use": 16 << 30, "bytes_limit": 16 << 30,
+                       "peak_bytes_in_use": 16 << 30},
+            "unattributed_bytes": 0,
+        },
+    }
+    rec = normalize_run({
+        "n": 9, "rc": 45,
+        "parsed": {"value": None, "skipped": True,
+                   "error": "RESOURCE_EXHAUSTED",
+                   "preflight": {"kind": "oom", "detail": "rc 45"},
+                   "oom_report": crash}}, label="r09")
+    assert rec.status == "outage"
+    assert rec.oom_report == crash
+    text = render_trajectory([rec])
+    assert "oom attribution:" in text
+    assert "KV pool" in text and "(8,4096)" in text
+    # a clean record stays oom-free
+    ok = normalize_run({"value": 100.0}, label="ok")
+    assert ok.oom_report is None
+
+
+# -- fleet plane -------------------------------------------------------------
+
+
+def test_fleet_status_memory_block():
+    import time as _time
+
+    from dynamo_tpu.runtime.telemetry import TelemetryCollector
+
+    col = TelemetryCollector(bus=None)
+    col.ingest({
+        "component": "mock", "instance": "w1", "role": "worker",
+        "at": _time.time(),
+        "metrics": {
+            "dynamo_memory_class_bytes": {
+                "type": "gauge",
+                "values": [[{"class": "weights"}, 4 << 30],
+                           [{"class": "kv_pool"}, 2 << 30]]},
+            "dynamo_memory_device_bytes": {
+                "type": "gauge",
+                "values": [[{"kind": "in_use"}, 7 << 30],
+                           [{"kind": "limit"}, 16 << 30],
+                           [{"kind": "peak"}, 7 << 30]]},
+            "dynamo_memory_unattributed_bytes": {
+                "type": "gauge", "values": [[{}, 1 << 30]]},
+            "dynamo_memory_headroom_bytes": {
+                "type": "gauge", "values": [[{}, 9 << 30]]},
+        }})
+    status = col.fleet_status()
+    ms = status["components"][0]["memory"]
+    assert ms["classes"] == {"weights": 4 << 30, "kv_pool": 2 << 30}
+    assert ms["attributed_bytes"] == 6 << 30
+    assert ms["device"]["limit"] == 16 << 30
+    assert ms["in_use_pct"] == 43.75
+    assert ms["unattributed_bytes"] == 1 << 30
+    assert ms["headroom_bytes"] == 9 << 30
+    assert status["fleet"]["memory"]["attributed_bytes"] == 6 << 30
+    # unledgered workers keep the pre-memory payload shape
+    col2 = TelemetryCollector(bus=None)
+    col2.ingest({"component": "mock", "instance": "w2", "role": "worker",
+                 "at": _time.time(), "metrics": {}})
+    st2 = col2.fleet_status()
+    assert "memory" not in st2["components"][0]
+    assert "memory" not in st2["fleet"]
+
+
+def test_doctor_fleet_renders_memory(tmp_path, capsys):
+    from dynamo_tpu.doctor.fleet import main as fleet_main
+
+    status = {"components": [{"component": "mock", "instance": "w1",
+                              "role": "worker", "age_s": 1.0,
+                              "latency": {},
+                              "memory": {
+                                  "classes": {"weights": 4 << 30},
+                                  "attributed_bytes": 6 << 30,
+                                  "in_use_pct": 43.75,
+                                  "unattributed_bytes": 1 << 30,
+                                  "headroom_bytes": 9 << 30}}],
+              "fleet": {"latency": {}}}
+    f = tmp_path / "status.json"
+    f.write_text(json.dumps(status))
+    assert fleet_main([str(f)]) == 0
+    out = capsys.readouterr().out
+    assert "hbm=6.00GiB" in out
+    assert "(44% of device)" in out
+    assert "unattr=1.00GiB" in out
+    assert "headroom=9.00GiB" in out
+
+
+# -- /debug/memory surface (full stack, MockEngine) --------------------------
+
+
+async def test_debug_memory_endpoint(monkeypatch, tmp_path, capsys):
+    monkeypatch.setenv("DYN_MEM_LEDGER", "1")
+    monkeypatch.delenv("DYN_OOM_EXIT", raising=False)
+    import aiohttp
+
+    from dynamo_tpu.doctor.memory import main as mem_main
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
+    card = ModelDeploymentCard(
+        name="mock-model", namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path="mock-model",
+        router_mode="round_robin", migration_limit=1)
+    ev_sink, m_sink = wire_engine_events(rt, card)
+    eng = MockEngine(
+        MockEngineConfig(block_size=card.kv_block_size, worker_id=1,
+                         speedup=200.0, default_max_tokens=16),
+        event_sink=ev_sink, metrics_sink=m_sink)
+    assert eng.memory_ledger is not None
+    handle = await serve_engine(rt, eng, card, instance_id=1)
+    fe = await start_frontend(rt)
+    try:
+        for _ in range(100):
+            if "mock-model" in fe.manager.model_names():
+                break
+            await asyncio.sleep(0.01)
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 8,
+                    "messages": [{"role": "user", "content": "hi there"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=body) as r:
+                assert r.status == 200
+            async with s.get(f"{fe.url}/debug/memory") as r:
+                assert r.status == 200
+                data = await r.json()
+            assert data["enabled"] is True
+            p = data["engines"][0]
+            assert p["worker_id"] == 1
+            assert p["summary"]["dispatches"] > 0
+            assert p["snapshots"]
+            last = p["summary"]["last"]
+            assert last["classes"]["weights"] > 0
+            assert last["unattributed_bytes"] == 0
+            async with s.get(f"{fe.url}/debug/memory?limit=1") as r:
+                assert len((await r.json())["engines"][0]
+                           ["snapshots"]) == 1
+            # the /debug index advertises the surface and its arm knob
+            async with s.get(f"{fe.url}/debug") as r:
+                idx = await r.json()
+            row = idx["surfaces"]["/debug/memory"]
+            assert row["armed"] is True
+            assert "DYN_MEM_LEDGER" in row["arm"]
+            async with s.get(f"{fe.url}/openapi.json") as r:
+                spec = await r.json()
+            assert "/debug/memory" in spec["paths"]
+            # doctor memory renders from the live url (fetched off-loop)
+            # AND from a saved dump
+            assert await asyncio.to_thread(mem_main, [fe.url]) == 0
+            out = capsys.readouterr().out
+            assert "worker 1:" in out and "unattributed" in out
+            dump = tmp_path / "memory.json"
+            dump.write_text(json.dumps(data))
+            assert mem_main([str(dump)]) == 0
+            assert "weights" in capsys.readouterr().out
+    finally:
+        await fe.stop()
+        await handle.stop()
+        await eng.close()
+        await rt.close()

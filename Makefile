@@ -5,7 +5,7 @@ PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
 	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
-	kv-smoke perf-gate perf-baseline
+	kv-smoke perf-gate perf-baseline fairness-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -21,7 +21,7 @@ tier1:
 # kills/stalls/wedges workers mid-stream and requires 100% of requests
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
-chaos: heal-smoke control-smoke mem-smoke
+chaos: heal-smoke control-smoke mem-smoke fairness-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -126,6 +126,18 @@ perf-gate:
 perf-baseline:
 	JAX_PLATFORMS=cpu python -m dynamo_tpu.bench.perf \
 		--out benchmarks/perf_baseline.json
+
+# multi-tenant fairness gate (docs/multitenancy.md): quota/identity
+# parsing, token-bucket 429s with Retry-After at the frontend, the
+# deficit-weighted fair scheduler against hand-traced schedules,
+# per-tenant KV budgets, and the noisy-neighbor SLA smoke — a bursty
+# heavy tenant flooding a live mock fleet next to a quiet interactive
+# tenant, gated on weighted goodput split (±10%), quiet-tenant TTFT,
+# and token-identity vs an isolated replay. Also pins the unarmed
+# byte-identical contract (legacy admission order, schedule artifact
+# md5, clean /metrics). Chip-free.
+fairness-smoke:
+	$(PYTEST) tests/test_tenancy.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

@@ -108,6 +108,9 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "control": ("control",
                 "flight-control knob changes from /debug/control or an "
                 "events JSONL: timeline, trajectories, evidence"),
+    "tenants": ("tenants",
+                "per-tenant quotas, fair-share deficits, and goodput "
+                "from /debug/tenants"),
 }
 
 

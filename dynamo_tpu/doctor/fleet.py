@@ -125,6 +125,32 @@ def _fmt_memory(ms: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_tenants(ts: Optional[dict]) -> list[str]:
+    """Per-tenant fairness lines (present only on fleets that armed
+    DYN_TENANCY — untenanted fleets print nothing here)."""
+    if not ts:
+        return []
+    lines = []
+    for name, t in sorted(ts.items()):
+        parts = [f"admitted={t.get('admitted', 0)}"]
+        if t.get("rejected"):
+            parts.append(f"rejected={t['rejected']}")
+        parts.append(f"goodput={t.get('goodput_tokens', 0)}tok")
+        share = t.get("goodput_share")
+        if share is not None:
+            parts.append(f"({100.0 * share:.1f}%)")
+        if t.get("streams"):
+            parts.append(f"streams={t['streams']}")
+        if t.get("kv_blocks"):
+            parts.append(f"kv={t['kv_blocks']}blk")
+        if t.get("ttft_mean_s") is not None:
+            parts.append(f"ttft~{_ms(t['ttft_mean_s'])}")
+        if t.get("queue_wait_mean_s") is not None:
+            parts.append(f"wait~{_ms(t['queue_wait_mean_s'])}")
+        lines.append(f"    tenant {name}: " + " ".join(parts))
+    return lines
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -137,12 +163,16 @@ def render(status: dict) -> int:
               f"{_fmt_router(c.get('router'))}"
               f"{_fmt_kv(c.get('kv'))}"
               f"{_fmt_memory(c.get('memory'))}")
+        for line in _fmt_tenants(c.get("tenants")):
+            print(line)
     fleet = status.get("fleet") or {}
     print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
           f"{_fmt_goodput(fleet.get('goodput'))}"
           f"{_fmt_router(fleet.get('router'))}"
           f"{_fmt_kv(fleet.get('kv'))}"
           f"{_fmt_memory(fleet.get('memory'))}")
+    for line in _fmt_tenants(fleet.get("tenants")):
+        print(line)
     slo = status.get("slo")
     if slo:
         print("slo:")

@@ -1,0 +1,117 @@
+"""`python -m dynamo_tpu.doctor tenants <url-or-json>` — render the
+multi-tenant fairness view.
+
+Input is either a frontend base url (fetches ``/debug/tenants`` over
+HTTP) or a path to a JSON file holding the same payload. Prints each
+tenant's quota configuration against its live usage (streams, bucket
+level, admit/reject counts, client TTFT p90) and, per engine, the fair
+scheduler's view: queue depths, KV blocks held, and how far behind the
+weighted fair share each tenant is running. Exit code 0 when a tenancy
+view was rendered, 1 when the input was unusable or tenancy is unarmed
+(the frontend answers 503 without DYN_TENANCY).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_tenants(source: str) -> Optional[dict]:
+    """Fetch /debug/tenants from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.error
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/tenants"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                print("doctor tenants: tenancy not configured on this "
+                      "frontend (set DYN_TENANCY)")
+                return None
+            print(f"doctor tenants: fetch {url} failed: {e!r}")
+            return None
+        except Exception as e:
+            print(f"doctor tenants: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor tenants: cannot read {source}: {e!r}")
+        return None
+
+
+def _num(v, fmt: str = "{:.1f}") -> str:
+    try:
+        return fmt.format(float(v))
+    except (TypeError, ValueError):
+        return "-"
+
+
+def render(payload: dict) -> int:
+    if not payload.get("enabled"):
+        print("doctor tenants: tenancy not enabled in this capture")
+        return 1
+    tenants = payload.get("tenants") or {}
+    default = payload.get("default_tenant")
+    print(f"tenants: {len(tenants)} known"
+          + (f", default={default}" if default else ""))
+    for name, t in sorted(tenants.items()):
+        limits = []
+        if t.get("max_concurrent_streams"):
+            limits.append(f"streams<={t['max_concurrent_streams']}")
+        if t.get("token_rate"):
+            limits.append(f"rate={_num(t['token_rate'])}tok/s")
+        if t.get("kv_block_budget"):
+            limits.append(f"kv<={t['kv_block_budget']}blk")
+        print(f"  {name}: weight={t.get('weight', 1.0)} "
+              + (" ".join(limits) if limits else "unlimited"))
+        live = [f"live_streams={t.get('live_streams', 0)}",
+                f"admitted={t.get('admitted', 0)}",
+                f"rejected={t.get('rejected', 0)}"]
+        if t.get("bucket_level") is not None:
+            live.append(f"bucket={_num(t['bucket_level'])}tok")
+        ttft = t.get("ttft_p90_s")
+        if ttft:
+            live.append(f"ttft_p90={_num(float(ttft) * 1e3)}ms")
+        print("    " + " ".join(live))
+    for eng in payload.get("engines") or []:
+        wid = eng.get("worker_id", "?")
+        print(f"engine {wid}:")
+        for name, t in sorted((eng.get("tenants") or {}).items()):
+            parts = [f"waiting={t.get('waiting', 0)}",
+                     f"running={t.get('running', 0)}",
+                     f"kv={t.get('kv_blocks', 0)}blk"]
+            if t.get("service") is not None:
+                parts.append(f"service={_num(t['service'], '{:.2f}')}")
+            if t.get("weighted_deficit") is not None:
+                parts.append(
+                    f"deficit={_num(t['weighted_deficit'], '{:.2f}')}")
+            if t.get("goodput_tokens") is not None:
+                parts.append(f"goodput={t['goodput_tokens']:.0f}tok")
+            if t.get("queue_wait_mean_s") is not None:
+                parts.append(
+                    f"wait~{_num(float(t['queue_wait_mean_s']) * 1e3)}ms")
+            print(f"  {name}: " + " ".join(parts))
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m dynamo_tpu.doctor tenants "
+              "<frontend-url | tenants.json>")
+        return 1
+    payload = load_tenants(argv[0])
+    if payload is None:
+        return 1
+    return render(payload)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -247,6 +247,14 @@ class TpuEngineConfig:
     # (models/llama.py mixed_prefill_decode). 0 = disabled: the legacy
     # phase-alternating scheduler, bit-for-bit.
     prefill_chunk_budget: int = 0
+    # Bounded admission skip-ahead for the no-tenancy path: when the
+    # waiting head can't get pages, try up to this many requests behind
+    # it before giving up the round — a page-starved giant no longer
+    # parks smaller admissible work (head-of-line blocking). 0 = exact
+    # legacy head-only order, bit-for-bit (pinned by
+    # tests/test_tenancy.py). Ignored when DYN_TENANCY arms the fair
+    # scheduler, which scans tenant heads instead.
+    admit_lookahead: int = 0
 
 
 @dataclass
@@ -326,6 +334,10 @@ class _Seq:
     t_first_ns: int = 0
     trace: Optional[RequestTrace] = None
     decode_compiled: bool = False         # a decode burst compiled mid-flight
+    # tenancy (dynamo_tpu/tenancy): resolved tenant name when DYN_TENANCY
+    # is armed, else None — the fair scheduler and per-tenant metrics key
+    # off it; untenanted engines never read it
+    tenant: Optional[str] = None
 
     @property
     def pos(self) -> int:
@@ -684,6 +696,20 @@ class TpuEngine:
         self.memory_metrics = MemoryMetrics()
         self.memory_ledger = ledger_from_env(self.memory_metrics)
         self._oom = False
+        # Tenancy plane (dynamo_tpu/tenancy): same off-by-default
+        # contract — None unless DYN_TENANCY, in which case _admit
+        # drains per-tenant FIFO heads by weighted deficit instead of
+        # the single-FIFO head, per-tenant KV budgets cap page
+        # occupancy, and dynamo_tenant_* goodput/queue-wait/kv_blocks
+        # attribute by the propagated x-dyn-tenant header.
+        from dynamo_tpu.tenancy import tenancy_from_env
+        self.tenancy = tenancy_from_env()
+        self.fair = None
+        self.tenant_metrics = None
+        if self.tenancy is not None:
+            from dynamo_tpu.tenancy import FairScheduler, TenantMetrics
+            self.fair = FairScheduler(self.tenancy)
+            self.tenant_metrics = TenantMetrics()
         if self.memory_ledger is not None:
             from dynamo_tpu.models.loader import params_footprint
 
@@ -845,10 +871,16 @@ class TpuEngine:
             # ctx.headers traceparent) or the caller task's current span
             # (in-proc fast path). None when DYN_TRACE is off — the
             # scheduler never allocates a span for untraced requests.
+            attrs = {"request.id": context.request_id,
+                     "engine.worker_id": cfg.worker_id}
+            tenant = None
+            if self.tenancy is not None:
+                tenant = self.tenancy.tenant_of(
+                    getattr(context, "headers", None))
+                attrs["tenant"] = tenant
             trace = RequestTrace.begin(
                 "engine.request", getattr(context, "headers", None),
-                {"request.id": context.request_id,
-                 "engine.worker_id": cfg.worker_id})
+                attrs)
             seq = _Seq(
                 req=req, ctx=context, queue=asyncio.Queue(),
                 token_seq=TokenBlockSequence(mcfg.page_size),
@@ -863,6 +895,7 @@ class TpuEngine:
                 t_enqueue=time.perf_counter(),
                 t_enqueue_ns=time.time_ns(),
                 trace=trace,
+                tenant=tenant,
             )
             if trace is not None:
                 trace.event("enqueued", waiting=len(self._waiting),
@@ -1098,17 +1131,50 @@ class TpuEngine:
                 alloc = self.pool.allocate_sequence(hashes, prompt_len)
         return alloc
 
-    def _admit(self) -> None:
+    def _admission_order(self) -> list[int]:
+        """Candidate indexes into _waiting for one admission round.
+        Legacy (no tenancy, admit_lookahead=0): the head only — the
+        exact FIFO order this engine has always run, bit-for-bit.
+        admit_lookahead=N: the head plus up to N requests behind it,
+        so a page-starved giant can't park smaller admissible work.
+        Fair scheduler armed: one index per backlogged tenant (its
+        FIFO head), least weighted service first."""
+        if self.fair is not None:
+            return self.fair.candidate_indexes(
+                [s.tenant for s in self._waiting])
+        la = self.config.admit_lookahead
+        if la > 0:
+            return list(range(min(la + 1, len(self._waiting))))
+        return [0]
+
+    def _tenant_pages(self, tenant: Optional[str]) -> int:
+        """KV pages currently held by a tenant's running sequences."""
+        return sum(len(s.pages) for s in self._running
+                   if s.tenant == tenant)
+
+    def _admit_one(self) -> bool:
+        """Try one admission round over the candidate order; True when
+        the outer loop should keep going (admitted, or a cancelled
+        entry was reaped), False when nothing is admissible."""
         cfg = self.config
-        while self._waiting and len(self._running) < cfg.max_batch_size:
-            cand = self._waiting[0]
+        for idx in self._admission_order():
+            cand = self._waiting[idx]
             if cand.ctx.is_cancelled():
-                self._waiting.pop(0)
+                self._waiting.pop(idx)
                 self._finish(cand, FINISH_CANCELLED)
-                continue
+                return True
             hashes = cand.prompt_hashes
             need_pages = (len(cand.prompt) + self.model_cfg.page_size - 1) \
                 // self.model_cfg.page_size
+            if self.fair is not None:
+                # per-tenant KV budget nets into the admission check:
+                # a tenant at its page budget is not admissible this
+                # round, but other tenants' heads still are
+                budget = self.tenancy.get(cand.tenant).kv_block_budget
+                if (budget > 0 and self._running
+                        and self._tenant_pages(cand.tenant) + need_pages
+                        > budget):
+                    continue
             # pinned pages are HBM-occupied but free themselves without
             # any sequence finishing (the offload worker's gather lands);
             # netting them out keeps the watermark from refusing
@@ -1116,7 +1182,7 @@ class TpuEngine:
             occupied = self.pool.active_pages - self.pool.pending_offload_pages
             if (occupied + need_pages
                     > cfg.watermark * self.pool.capacity and self._running):
-                break
+                continue
             t_adm = time.perf_counter()
             if cand.import_kv is not None:
                 # disagg import: fresh pages only (remote KV overwrites
@@ -1125,14 +1191,14 @@ class TpuEngine:
                 if alloc is None:
                     self.metrics.admission_stall.observe(
                         time.perf_counter() - t_adm)
-                    break
+                    continue
                 cand.pages, cand.cached_len = alloc[0], cand.import_kv[1]
             else:
                 alloc = self._alloc_admission(hashes, len(cand.prompt))
                 if alloc is None:
                     self.metrics.admission_stall.observe(
                         time.perf_counter() - t_adm)
-                    break
+                    continue
                 cand.pages, cand.cached_len = alloc
                 if self.kvbm is not None:
                     # KVBM onboard: blocks past the device prefix hit that
@@ -1144,8 +1210,17 @@ class TpuEngine:
             # the async pipeline stages them ahead of time
             self.metrics.admission_stall.observe(
                 time.perf_counter() - t_adm)
-            self.metrics.queue_wait.observe(
-                max(time.perf_counter() - cand.t_enqueue, 0.0))
+            wait_s = max(time.perf_counter() - cand.t_enqueue, 0.0)
+            self.metrics.queue_wait.observe(wait_s)
+            if self.fair is not None:
+                self.fair.on_admit(
+                    cand.tenant, len(cand.prompt) + cand.max_tokens)
+                tm = self.tenant_metrics
+                if tm is not None and cand.tenant is not None:
+                    tm.observe_queue_wait(cand.tenant, wait_s)
+                    tm.kv_blocks.set(
+                        self._tenant_pages(cand.tenant) + len(cand.pages),
+                        tenant=cand.tenant)
             if cand.trace is not None:
                 now_ns = time.time_ns()
                 cand.trace.stage(
@@ -1158,8 +1233,16 @@ class TpuEngine:
             # budgeted prefill resumes from here; legacy prefill keys its
             # offsets off cached_len directly and ignores the cursor
             cand.prefill_pos = cand.cached_len
-            self._waiting.pop(0)
+            self._waiting.pop(idx)
             self._running.append(cand)
+            return True
+        return False
+
+    def _admit(self) -> None:
+        cfg = self.config
+        while self._waiting and len(self._running) < cfg.max_batch_size:
+            if not self._admit_one():
+                break
 
     # -- prefill ------------------------------------------------------------
 
@@ -2736,6 +2819,8 @@ class TpuEngine:
             seq.next_token = t
         seq.generated += n_emit
         self.metrics.tokens_emitted.inc(n_emit)
+        if self.tenant_metrics is not None and seq.tenant is not None:
+            self.tenant_metrics.goodput.inc(n_emit, tenant=seq.tenant)
         out = EngineOutput(token_ids=emit_toks, finish_reason=finish)
         if lps is not None:
             out.log_probs = [float(x) for x in lps[:n_emit]]
@@ -2793,6 +2878,9 @@ class TpuEngine:
             else:
                 self.pool.release_sequence(seq.pages)
         seq.pages = []
+        if self.tenant_metrics is not None and seq.tenant is not None:
+            self.tenant_metrics.kv_blocks.set(
+                self._tenant_pages(seq.tenant), tenant=seq.tenant)
         if emit:
             seq.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=reason).to_dict())

@@ -263,6 +263,12 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
     if mm is not None and hasattr(mm, "register"):
         mm.register(runtime.metrics,
                     ledger=getattr(core, "memory_ledger", None))
+    # Tenancy fairness surface (dynamo_tpu/tenancy): engine-role
+    # dynamo_tenant_* series (goodput, queue wait, admissions, kv_blocks)
+    # join the scrape when DYN_TENANCY armed the engine's fair scheduler
+    tm = getattr(core, "tenant_metrics", None)
+    if tm is not None and hasattr(tm, "register"):
+        tm.register(runtime.metrics, role="engine")
     # one-token greedy canary (vllm health_check.py builds the same shape);
     # only probed when the runtime's health manager is enabled + idle.
     # The extra.canary marker lets sinks/metrics tell probes from traffic.

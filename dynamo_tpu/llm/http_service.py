@@ -123,8 +123,24 @@ class HttpService:
             web.get("/debug/kv", self._debug_kv),
             web.get("/debug/memory", self._debug_memory),
             web.get("/debug/control", self._debug_control),
+            web.get("/debug/tenants", self._debug_tenants),
             web.get("/openapi.json", self._openapi),
         ])
+        # Tenancy quota plane (dynamo_tpu/tenancy, docs/multitenancy.md):
+        # None unless DYN_TENANCY — over-quota requests 429 with
+        # Retry-After HERE, before any engine work, and the resolved
+        # tenant rides ctx.headers[x-dyn-tenant] to the workers so the
+        # fair scheduler and every recorder attribute by the same name.
+        from dynamo_tpu.tenancy import tenancy_from_env
+
+        self.tenancy = tenancy_from_env()
+        self.quota = None
+        if self.tenancy is not None:
+            from dynamo_tpu.tenancy import QuotaGate, TenantMetrics
+
+            tm = TenantMetrics()
+            tm.register(manager.runtime.metrics, role="frontend")
+            self.quota = QuotaGate(self.tenancy, tm)
         # request-lifecycle debug view: in-flight dicts keyed by request
         # id plus a bounded ring of finished ones, served verbatim by
         # /debug/requests (per-stage timings, status, trace id)
@@ -202,6 +218,35 @@ class HttpService:
                 and t.get("max_completion_tokens") is not None:
             body["max_tokens"] = t["max_completion_tokens"]
 
+    def _tenant_gate(self, request: web.Request, body,
+                     endpoint: str):
+        """Resolve tenant identity and enforce quotas BEFORE any engine
+        work. Returns (tenant_name, None) when admitted — the caller
+        owes exactly one `quota.release(tenant_name)` — or
+        (tenant_name, 429 response) when over quota. (None, None) when
+        tenancy is unarmed."""
+        if self.quota is None:
+            return None, None
+        from dynamo_tpu.tenancy import (estimate_request_tokens,
+                                        retry_after_header)
+        from dynamo_tpu.tenancy.config import TENANT_HEADER
+
+        tenant = self.tenancy.resolve(
+            request.headers.get(TENANT_HEADER),
+            request.headers.get("Authorization"))
+        tokens = estimate_request_tokens(
+            body if isinstance(body, dict) else {})
+        ok, reason, retry = self.quota.try_admit(tenant, tokens)
+        if ok:
+            return tenant.name, None
+        self._req_counter.inc(endpoint=endpoint, status="429")
+        err = OpenAIError(
+            f"tenant {tenant.name!r} over {reason} quota",
+            status=429, err_type="rate_limit_exceeded")
+        return tenant.name, web.json_response(
+            err.body(), status=429,
+            headers={"Retry-After": retry_after_header(retry)})
+
     def _audit_begin(self, request_id: str, endpoint: str, body):
         if self.audit is None:
             return None
@@ -259,7 +304,14 @@ class HttpService:
             return self._error("embeddings", OpenAIError(
                 f"model {model!r} not found", status=404,
                 err_type="model_not_found"))
+        tenant, reject = self._tenant_gate(request, body, "embeddings")
+        if reject is not None:
+            return reject
         ctx = Context(request_id=new_request_id("embd"))
+        if tenant is not None:
+            from dynamo_tpu.tenancy.config import TENANT_HEADER
+
+            ctx.headers[TENANT_HEADER] = tenant
         start = time.perf_counter()
         self._inflight.add(1)
         try:
@@ -278,6 +330,8 @@ class HttpService:
             raise
         finally:
             self._inflight.add(-1)
+            if tenant is not None:
+                self.quota.release(tenant)
 
     async def _responses(self, request: web.Request) -> web.StreamResponse:
         """/v1/responses (openai.rs:766): typed-event SSE or unary fold."""
@@ -291,8 +345,15 @@ class HttpService:
             return self._error("responses", OpenAIError(
                 f"model {model!r} not found", status=404,
                 err_type="model_not_found"))
+        tenant, reject = self._tenant_gate(request, body, "responses")
+        if reject is not None:
+            return reject
         request_id = new_request_id("resp")
         ctx = Context(request_id=request_id)
+        if tenant is not None:
+            from dynamo_tpu.tenancy.config import TENANT_HEADER
+
+            ctx.headers[TENANT_HEADER] = tenant
         events = engine.generate(
             {"_kind": KIND_RESPONSES, "body": body,
              "request_id": request_id}, ctx)
@@ -326,6 +387,9 @@ class HttpService:
                         if first_token_at is None:
                             first_token_at = now
                             self._observe_latency("ttft", now - start)
+                            if self.quota is not None and tenant:
+                                self.quota.metrics.observe_ttft(
+                                    tenant, now - start)
                         elif last_token_at is not None:
                             self._observe_latency("itl", now - last_token_at)
                         last_token_at = now
@@ -352,6 +416,8 @@ class HttpService:
             return resp
         finally:
             self._inflight.add(-1)
+            if tenant is not None:
+                self.quota.release(tenant)
 
     def _observe_usage_responses(self, usage: Optional[dict]) -> None:
         if not usage:
@@ -437,10 +503,19 @@ class HttpService:
             return self._error(endpoint, OpenAIError(
                 f"model {model!r} not found", status=404,
                 err_type="model_not_found"))
+        # quota gate before ANY engine work: over-quota tenants cost
+        # the fleet one dict lookup and a 429, nothing downstream
+        tenant, reject = self._tenant_gate(request, body, endpoint)
+        if reject is not None:
+            return reject
         stream = bool(body.get("stream"))
         request_id = new_request_id(
             "chatcmpl" if kind == KIND_CHAT else "cmpl")
         ctx = Context(request_id=request_id)
+        if tenant is not None:
+            from dynamo_tpu.tenancy.config import TENANT_HEADER
+
+            ctx.headers[TENANT_HEADER] = tenant
         from dynamo_tpu.runtime.tracing import tracer
 
         pipeline_request = {"_kind": kind, "body": body,
@@ -463,7 +538,7 @@ class HttpService:
                         "request.id": request_id, "model": model})
         span.__enter__()
         rec = {"request_id": request_id, "endpoint": endpoint,
-               "model": model, "stream": stream,
+               "model": model, "stream": stream, "tenant": tenant,
                "received_at": time.time(),
                "trace_id": span.trace_id if tracer().enabled else None,
                "status": "in_flight", "first_token_s": None,
@@ -502,6 +577,8 @@ class HttpService:
         finally:
             span.end(_reset=True)
             self._inflight.add(-1)
+            if tenant is not None:
+                self.quota.release(tenant)
             rec["duration_s"] = round(time.perf_counter() - start, 6)
             self._dbg_inflight.pop(request_id, None)
             self._dbg_recent.append(rec)
@@ -523,6 +600,9 @@ class HttpService:
                     first_token_at = time.perf_counter()
                     self._observe_latency("ttft", first_token_at - start)
                     rec["first_token_s"] = round(first_token_at - start, 6)
+                    if self.quota is not None and rec.get("tenant"):
+                        self.quota.metrics.observe_ttft(
+                            rec["tenant"], first_token_at - start)
                 elif self._has_content(chunk) and last_token_at is not None:
                     self._observe_latency(
                         "itl", time.perf_counter() - last_token_at)
@@ -619,6 +699,13 @@ class HttpService:
                 "arm": "DYN_CONTROL=all|bucket,kvbm,router,forecast",
                 "armed": self.control_plane is not None,
                 "available": self.control_plane is not None,
+            },
+            "/debug/tenants": {
+                "what": "per-tenant quotas, streams, fair-share "
+                        "deficits, KV blocks, goodput",
+                "arm": "DYN_TENANCY=<path|inline json>",
+                "armed": self.quota is not None,
+                "available": True,
             },
         }
         return web.json_response({"surfaces": surfaces})
@@ -759,6 +846,27 @@ class HttpService:
             limit = 64
         return web.json_response(self.control_plane.payload(limit))
 
+    async def _debug_tenants(self, request: web.Request) -> web.Response:
+        """Multi-tenant fairness view (docs/multitenancy.md): per-tenant
+        quota config + live usage (streams, bucket level, admit/reject
+        counts, TTFT p90) from the frontend quota gate, plus each
+        in-proc engine's scheduler state — queue depths, KV blocks held,
+        and fair-share service/deficit per tenant. 503 unless
+        DYN_TENANCY armed tenancy on this process."""
+        if self.quota is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "tenancy not configured (set DYN_TENANCY)"},
+                status=503)
+        from dynamo_tpu.tenancy import tenant_state
+
+        body = {"enabled": True, **self.quota.payload()}
+        engines = list(self.profile_engines() or []) \
+            if self.profile_engines is not None else []
+        body["engines"] = [st for st in (tenant_state(e) for e in engines)
+                           if st]
+        return web.json_response(body)
+
     async def _debug_router(self, request: web.Request) -> web.Response:
         """Router decision flight-recorder view (docs/observability.md
         "Router observability"): per-model decision counters, index
@@ -891,6 +999,9 @@ class HttpService:
             "/debug/control": ("Flight-control state: armed controllers "
                                "+ knob-change actions with evidence "
                                "(?limit=N)", False),
+            "/debug/tenants": ("Per-tenant quotas, live streams, "
+                               "fair-share deficits, KV blocks, goodput",
+                               False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

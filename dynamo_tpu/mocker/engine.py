@@ -72,6 +72,10 @@ class MockEngineConfig:
     kv_block_bytes: int = 1 << 20
     workspace_bytes_per_token: int = 4096
     unattributed_bytes: int = 0      # deliberate residual for tests
+    # bounded admission skip-ahead for the no-tenancy path (same knob
+    # as TpuEngineConfig.admit_lookahead): 0 = exact legacy head-only
+    # order, bit-for-bit; ignored when DYN_TENANCY arms fair share
+    admit_lookahead: int = 0
 
 
 @dataclass
@@ -90,6 +94,9 @@ class _MockRequest:
     t_admit_ns: int = 0
     t_first_ns: int = 0
     t_last_ns: int = 0
+    # tenancy: resolved tenant name when DYN_TENANCY is armed, else None
+    # (same contract as TpuEngine._Seq.tenant)
+    tenant: Optional[str] = None
 
     @property
     def max_tokens(self) -> int:
@@ -156,6 +163,19 @@ class MockEngine:
         self.memory_metrics = MemoryMetrics()
         self.memory_ledger = ledger_from_env(self.memory_metrics,
                                              device=self)
+        # Tenancy plane parity with TpuEngine (dynamo_tpu/tenancy):
+        # None unless DYN_TENANCY — the fairness smoke runs its
+        # noisy-neighbor gate over mock fleets, so the mock scheduler
+        # gets the identical fair-share admission + per-tenant budgets.
+        from dynamo_tpu.tenancy import tenancy_from_env
+
+        self.tenancy = tenancy_from_env()
+        self.fair = None
+        self.tenant_metrics = None
+        if self.tenancy is not None:
+            from dynamo_tpu.tenancy import FairScheduler, TenantMetrics
+            self.fair = FairScheduler(self.tenancy)
+            self.tenant_metrics = TenantMetrics()
         self._oom = False
         self._peak_bytes = 0
         if self.memory_ledger is not None:
@@ -215,16 +235,22 @@ class MockEngine:
                 extra={"error": "prompt exceeds KV capacity"},
             ).to_dict()
             return
+        attrs = {"request.id": context.request_id,
+                 "engine.worker_id": self.config.worker_id,
+                 "engine.kind": "mocker"}
+        tenant = None
+        if self.tenancy is not None:
+            tenant = self.tenancy.tenant_of(
+                getattr(context, "headers", None))
+            attrs["tenant"] = tenant
         trace = RequestTrace.begin(
-            "engine.request", getattr(context, "headers", None),
-            {"request.id": context.request_id,
-             "engine.worker_id": self.config.worker_id,
-             "engine.kind": "mocker"})
+            "engine.request", getattr(context, "headers", None), attrs)
         mreq = _MockRequest(
             req=req, ctx=context, queue=asyncio.Queue(),
             seq=TokenBlockSequence(self.config.block_size, req.token_ids),
             arrival=self._arrivals,
             trace=trace, t_enqueue_ns=time.time_ns(),
+            tenant=tenant,
         )
         self._arrivals += 1
         if trace is not None:
@@ -304,39 +330,83 @@ class MockEngine:
                 # space): yield the event loop instead of spinning.
                 await asyncio.sleep(0.001 / self.config.speedup)
 
-    def _admit(self) -> None:
+    def _admission_order(self) -> list[int]:
+        """Candidate indexes for one admission round (TpuEngine
+        _admission_order contract): legacy head-only, bounded
+        skip-ahead when admit_lookahead > 0, per-tenant heads by
+        weighted deficit when DYN_TENANCY arms the fair scheduler."""
+        if self.fair is not None:
+            return self.fair.candidate_indexes(
+                [r.tenant for r in self._waiting])
+        la = self.config.admit_lookahead
+        if la > 0:
+            return list(range(min(la + 1, len(self._waiting))))
+        return [0]
+
+    def _tenant_blocks(self, tenant: Optional[str]) -> int:
+        """KV blocks currently held by a tenant's running sequences."""
+        return sum(len(r.seq.seq_hashes()) for r in self._running
+                   if r.tenant == tenant)
+
+    def _admit_one(self) -> bool:
         cfg = self.config
-        while self._waiting and len(self._running) < cfg.max_batch_size:
-            cand = self._waiting[0]
+        for idx in self._admission_order():
+            cand = self._waiting[idx]
             if cand.ctx.is_cancelled():
-                self._waiting.pop(0)
+                self._waiting.pop(idx)
                 if cand.trace is not None:
                     cand.trace.end(status="ERROR",
                                    finish_reason=FINISH_CANCELLED)
                 cand.queue.put_nowait(EngineOutput(
                     token_ids=[], finish_reason=FINISH_CANCELLED).to_dict())
                 cand.queue.put_nowait(None)
-                continue
+                return True
             new_active = self.kv.blocks_to_activate(cand.seq)
+            if self.fair is not None:
+                budget = self.tenancy.get(cand.tenant).kv_block_budget
+                if (budget > 0 and self._running
+                        and self._tenant_blocks(cand.tenant) + new_active
+                        > budget):
+                    continue  # tenant at its KV budget this round
             if (self.kv.active_blocks + new_active
                     > cfg.watermark * cfg.total_kv_blocks
                     and self._running):
-                break  # watermark: wait for space unless batch is empty
+                continue  # watermark: wait for space unless batch is empty
             if not self.kv.can_allocate(new_active):
-                break
-            self._waiting.pop(0)
+                continue
+            self._waiting.pop(idx)
             self._running.append(cand)
             now_ns = time.time_ns()
             if not cand.t_admit_ns:  # re-admits after preempt: events only
-                self.metrics.queue_wait.observe(
-                    (now_ns - cand.t_enqueue_ns) / 1e9)
+                wait_s = (now_ns - cand.t_enqueue_ns) / 1e9
+                self.metrics.queue_wait.observe(wait_s)
+                tm = self.tenant_metrics
+                if tm is not None and cand.tenant is not None:
+                    tm.observe_queue_wait(cand.tenant, wait_s)
                 if cand.trace is not None:
                     cand.trace.stage("engine.queue_wait", cand.t_enqueue_ns,
                                      now_ns,
                                      prompt_tokens=len(cand.req.token_ids))
+            if self.fair is not None:
+                self.fair.on_admit(
+                    cand.tenant,
+                    len(cand.req.token_ids) + cand.max_tokens)
+                tm = self.tenant_metrics
+                if tm is not None and cand.tenant is not None:
+                    # cand is already in _running, so this counts it
+                    tm.kv_blocks.set(self._tenant_blocks(cand.tenant),
+                                     tenant=cand.tenant)
             if cand.trace is not None:
                 cand.trace.event("admitted", running=len(self._running))
             cand.t_admit_ns = now_ns
+            return True
+        return False
+
+    def _admit(self) -> None:
+        cfg = self.config
+        while self._waiting and len(self._running) < cfg.max_batch_size:
+            if not self._admit_one():
+                break
 
     async def _prefill_new(self) -> bool:
         cfg = self.config
@@ -430,6 +500,8 @@ class MockEngine:
                 self.metrics.itl.observe((now_ns - r.t_last_ns) / 1e6)
             r.t_last_ns = now_ns
             self.metrics.tokens_emitted.inc()
+            if self.tenant_metrics is not None and r.tenant is not None:
+                self.tenant_metrics.goodput.inc(tenant=r.tenant)
             emitted += 1
             finish = None
             if r.req.stop.stop_token_ids and token in r.req.stop.stop_token_ids:
@@ -478,6 +550,9 @@ class MockEngine:
         if r in self._waiting:  # finished in the same iter it was preempted
             self._waiting.remove(r)
         self.kv.free_sequence(r.seq.seq_hashes())
+        if self.tenant_metrics is not None and r.tenant is not None:
+            self.tenant_metrics.kv_blocks.set(
+                self._tenant_blocks(r.tenant), tenant=r.tenant)
         if emit:
             r.queue.put_nowait(EngineOutput(
                 token_ids=[], finish_reason=reason).to_dict())

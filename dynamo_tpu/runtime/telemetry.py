@@ -303,6 +303,57 @@ def memory_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     return out
 
 
+def tenant_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """Per-tenant fairness view from the `dynamo_tenant_*` series
+    (dynamo_tpu/tenancy, docs/multitenancy.md). None when the component
+    never armed `DYN_TENANCY` — untenanted fleets see no new block. The
+    mergeable *_seconds_total / count counter pairs let the fleet-wide
+    entry show honest mean TTFT and queue wait across components."""
+    admitted = _counter_by_label(
+        snapshot, "dynamo_tenant_admitted_total", "tenant")
+    goodput = _counter_by_label(
+        snapshot, "dynamo_tenant_goodput_tokens_total", "tenant")
+    if not admitted and not goodput:
+        return None
+    rejected = _counter_by_label(
+        snapshot, "dynamo_tenant_rejected_total", "tenant")
+    streams = _gauge_by_label(snapshot, "dynamo_tenant_streams", "tenant")
+    kv = _gauge_by_label(snapshot, "dynamo_tenant_kv_blocks", "tenant")
+    ttft_sum = _counter_by_label(
+        snapshot, "dynamo_tenant_ttft_seconds_total", "tenant")
+    ttft_n = _counter_by_label(
+        snapshot, "dynamo_tenant_first_tokens_total", "tenant")
+    wait_sum = _counter_by_label(
+        snapshot, "dynamo_tenant_queue_wait_seconds_total", "tenant")
+    wait_n = _counter_by_label(
+        snapshot, "dynamo_tenant_admissions_total", "tenant")
+    names = (set(admitted) | set(goodput) | set(rejected) | set(streams)
+             | set(kv)) - {""}
+    total_goodput = sum(goodput.values()) or 0.0
+    out: dict[str, Any] = {}
+    for name in sorted(names):
+        t: dict[str, Any] = {
+            "admitted": int(admitted.get(name, 0)),
+            "rejected": int(rejected.get(name, 0)),
+            "goodput_tokens": int(goodput.get(name, 0)),
+        }
+        if total_goodput:
+            t["goodput_share"] = round(
+                goodput.get(name, 0.0) / total_goodput, 4)
+        if name in streams:
+            t["streams"] = int(streams[name])
+        if name in kv:
+            t["kv_blocks"] = int(kv[name])
+        if ttft_n.get(name):
+            t["ttft_mean_s"] = round(
+                ttft_sum.get(name, 0.0) / ttft_n[name], 6)
+        if wait_n.get(name):
+            t["queue_wait_mean_s"] = round(
+                wait_sum.get(name, 0.0) / wait_n[name], 6)
+        out[name] = t
+    return out or None
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -451,6 +502,9 @@ class TelemetryCollector:
             ms = memory_summary(metrics)
             if ms is not None:
                 entry["memory"] = ms
+            ts = tenant_summary(metrics)
+            if ts is not None:
+                entry["tenants"] = ts
             components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
@@ -473,6 +527,9 @@ class TelemetryCollector:
         fleet_mem = memory_summary(merged)
         if fleet_mem is not None:
             out["fleet"]["memory"] = fleet_mem
+        fleet_ten = tenant_summary(merged)
+        if fleet_ten is not None:
+            out["fleet"]["tenants"] = fleet_ten
         if slo is not None:
             out["slo"] = slo.status()
         if control is not None:

@@ -40,6 +40,7 @@ class RequestResult:
     sent_at: float = 0.0     # offset from replay start (schedule clock)
     text: str = ""           # concatenated deltas (token-identity gate)
     finish_reason: str = ""
+    tenant: str = ""         # tenant this request rode in as ("" = none)
 
     @property
     def completed(self) -> bool:
@@ -50,7 +51,8 @@ async def _replay_one(session, url: str, model: str,
                       req: ScheduledRequest, cfg: TrafficConfig,
                       t0: float) -> RequestResult:
     res = RequestResult(index=req.index, status="error:unsent",
-                        sent_at=round(time.monotonic() - t0, 6))
+                        sent_at=round(time.monotonic() - t0, 6),
+                        tenant=req.tenant)
     body = {
         "model": model,
         "stream": True,
@@ -58,13 +60,16 @@ async def _replay_one(session, url: str, model: str,
         "messages": [{"role": "user",
                       "content": prompt_text(req, cfg)}],
     }
+    # tenanted schedules ride the identity header the quota gate and
+    # fair scheduler key on (tenancy/config.py TENANT_HEADER)
+    headers = {"x-dyn-tenant": req.tenant} if req.tenant else None
     start = time.monotonic()
     last_token_at = None
     itls: list[float] = []
     parts: list[str] = []
     try:
         async with session.post(f"{url}/v1/chat/completions",
-                                json=body) as resp:
+                                json=body, headers=headers) as resp:
             if resp.status != 200:
                 detail = (await resp.text())[:200]
                 res.status = f"error:http_{resp.status}:{detail}"
@@ -177,3 +182,15 @@ def summarize_results(results: list[RequestResult]) -> dict:
         "itl_mean_p50_s": round(_percentile(itls, 0.50), 6),
         "itl_mean_p99_s": round(_percentile(itls, 0.99), 6),
     }
+
+
+def summarize_by_tenant(results: list[RequestResult]) -> dict:
+    """`summarize_results` split by tenant — {} when the replay carried
+    no tenant headers. The fairness smoke compares these goodput splits
+    against the configured weights."""
+    by: dict[str, list[RequestResult]] = {}
+    for r in results:
+        if r is not None and r.tenant:
+            by.setdefault(r.tenant, []).append(r)
+    return {name: summarize_results(rs)
+            for name, rs in sorted(by.items())}

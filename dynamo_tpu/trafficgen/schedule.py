@@ -27,7 +27,7 @@ from __future__ import annotations
 import json
 import math
 import random
-from dataclasses import asdict, dataclass, fields
+from dataclasses import asdict, dataclass, field, fields
 
 PATTERNS = ("constant", "poisson", "diurnal", "bursty")
 
@@ -60,11 +60,22 @@ class TrafficConfig:
     prefix_len: int = 64
     # client behaviors
     abandon_fraction: float = 0.0
+    # multi-tenant mixes (docs/multitenancy.md): each entry is a dict
+    # {"name": ..., "share": relative arrival weight, and optional
+    # isl_mean/isl_sigma/isl_max/osl_mean/osl_sigma/osl_max overrides}
+    # so one schedule can interleave a bursty heavy tenant with a quiet
+    # interactive one. Empty (the default) draws nothing extra from the
+    # RNG and serializes byte-identically to pre-tenancy schedules.
+    tenants: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
             raise ValueError(
                 f"unknown pattern {self.pattern!r}; one of {PATTERNS}")
+        for t in self.tenants:
+            if not isinstance(t, dict) or not t.get("name"):
+                raise ValueError(
+                    f"tenant spec needs a 'name': {t!r}")
 
 
 @dataclass
@@ -75,6 +86,7 @@ class ScheduledRequest:
     osl: int             # max_tokens the client asks for
     prefix_id: int = -1  # shared system-prompt id; -1 = none
     abandon_after: int = 0  # cancel after this many tokens; 0 = read all
+    tenant: str = ""     # x-dyn-tenant header value; "" = untenanted
 
     @property
     def prompt_tokens(self) -> int:
@@ -138,13 +150,37 @@ def _arrival_times(cfg: TrafficConfig, rng: random.Random) -> list[float]:
         out.append(t)
 
 
+def _pick_tenant(tenants: list, rng: random.Random) -> dict:
+    total = sum(float(t.get("share", 1.0)) for t in tenants)
+    x = rng.random() * total
+    for t in tenants:
+        x -= float(t.get("share", 1.0))
+        if x < 0:
+            return t
+    return tenants[-1]
+
+
 def build_schedule(cfg: TrafficConfig) -> list[ScheduledRequest]:
     """The full deterministic schedule for one replay run."""
     rng = random.Random(cfg.seed)
     reqs: list[ScheduledRequest] = []
     for i, t in enumerate(_arrival_times(cfg, rng)):
-        isl = _lognormal_int(rng, cfg.isl_mean, cfg.isl_sigma, cfg.isl_max)
-        osl = _lognormal_int(rng, cfg.osl_mean, cfg.osl_sigma, cfg.osl_max)
+        # tenant draw comes first so an untenanted config consumes the
+        # RNG in exactly the legacy order (byte-identity pinned by test)
+        tenant = ""
+        isl_p = (cfg.isl_mean, cfg.isl_sigma, cfg.isl_max)
+        osl_p = (cfg.osl_mean, cfg.osl_sigma, cfg.osl_max)
+        if cfg.tenants:
+            spec = _pick_tenant(cfg.tenants, rng)
+            tenant = str(spec["name"])
+            isl_p = (spec.get("isl_mean", cfg.isl_mean),
+                     spec.get("isl_sigma", cfg.isl_sigma),
+                     spec.get("isl_max", cfg.isl_max))
+            osl_p = (spec.get("osl_mean", cfg.osl_mean),
+                     spec.get("osl_sigma", cfg.osl_sigma),
+                     spec.get("osl_max", cfg.osl_max))
+        isl = _lognormal_int(rng, isl_p[0], isl_p[1], isl_p[2])
+        osl = _lognormal_int(rng, osl_p[0], osl_p[1], osl_p[2])
         prefix_id = -1
         if cfg.prefix_fraction > 0 and rng.random() < cfg.prefix_fraction:
             prefix_id = rng.randrange(max(cfg.num_prefixes, 1))
@@ -153,7 +189,8 @@ def build_schedule(cfg: TrafficConfig) -> list[ScheduledRequest]:
             abandon_after = rng.randint(1, max(osl // 2, 1))
         reqs.append(ScheduledRequest(
             index=i, at=round(t, 6), isl=isl, osl=osl,
-            prefix_id=prefix_id, abandon_after=abandon_after))
+            prefix_id=prefix_id, abandon_after=abandon_after,
+            tenant=tenant))
     return reqs
 
 
@@ -192,9 +229,18 @@ def schedule_to_jsonl(cfg: TrafficConfig,
     """Header line (version + config) then one line per request. Keys
     are sorted and floats pre-rounded, so equal schedules serialize to
     equal bytes — the replayable artifact IS the determinism witness."""
+    cfg_d = asdict(cfg)
+    if not cfg_d.get("tenants"):
+        # untenanted schedules keep the pre-tenancy byte layout — the
+        # md5 pin in tests/test_tenancy.py holds across this feature
+        cfg_d.pop("tenants", None)
     lines = [json.dumps({"version": SCHEDULE_VERSION,
-                         "config": asdict(cfg)}, sort_keys=True)]
-    lines.extend(json.dumps(asdict(r), sort_keys=True) for r in reqs)
+                         "config": cfg_d}, sort_keys=True)]
+    for r in reqs:
+        d = asdict(r)
+        if not d.get("tenant"):
+            d.pop("tenant", None)
+        lines.append(json.dumps(d, sort_keys=True))
     return "\n".join(lines) + "\n"
 
 
@@ -227,3 +273,17 @@ def summarize(reqs: list[ScheduledRequest]) -> dict:
         "with_prefix": sum(1 for r in reqs if r.prefix_id >= 0),
         "abandons": sum(1 for r in reqs if r.abandon_after > 0),
     }
+
+
+def summarize_tenants(reqs: list[ScheduledRequest]) -> dict:
+    """Per-tenant request/token counts — {} for untenanted schedules."""
+    out: dict[str, dict] = {}
+    for r in reqs:
+        if not r.tenant:
+            continue
+        t = out.setdefault(r.tenant, {"requests": 0, "isl_tokens": 0,
+                                      "osl_tokens": 0})
+        t["requests"] += 1
+        t["isl_tokens"] += r.isl
+        t["osl_tokens"] += r.osl
+    return out

@@ -279,7 +279,8 @@ async def test_debug_index_endpoint(monkeypatch):
                 surfaces = (await r.json())["surfaces"]
             assert set(surfaces) == {"/debug/requests", "/debug/profile",
                                      "/debug/router", "/debug/kv",
-                                     "/debug/control", "/debug/memory"}
+                                     "/debug/control", "/debug/memory",
+                                     "/debug/tenants"}
             # always-on ring vs env-armed recorders, with the knob named
             assert surfaces["/debug/requests"]["armed"] is True
             assert surfaces["/debug/requests"]["arm"] is None
@@ -292,6 +293,8 @@ async def test_debug_index_endpoint(monkeypatch):
             assert surfaces["/debug/control"]["arm"].startswith("DYN_CONTROL")
             assert surfaces["/debug/memory"]["armed"] is False
             assert surfaces["/debug/memory"]["arm"] == "DYN_MEM_LEDGER=1"
+            assert surfaces["/debug/tenants"]["armed"] is False
+            assert surfaces["/debug/tenants"]["arm"].startswith("DYN_TENANCY")
             # round-robin model → no kv router on this frontend
             assert surfaces["/debug/router"]["available"] is False
             async with s.get(f"{fe.url}/openapi.json") as r:

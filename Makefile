@@ -5,7 +5,7 @@ PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
 	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
-	kv-smoke perf-gate perf-baseline fairness-smoke
+	kv-smoke perf-gate perf-baseline fairness-smoke ragged-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -21,7 +21,7 @@ tier1:
 # kills/stalls/wedges workers mid-stream and requires 100% of requests
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
-chaos: heal-smoke control-smoke mem-smoke fairness-smoke
+chaos: heal-smoke control-smoke mem-smoke fairness-smoke ragged-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -138,6 +138,16 @@ perf-baseline:
 # md5, clean /metrics). Chip-free.
 fairness-smoke:
 	$(PYTEST) tests/test_tenancy.py
+
+# ragged-attention gate (docs/scheduler.md "Ragged dispatch"):
+# interpret-mode Pallas kernel parity vs the XLA reference (GQA
+# groups, ragged lengths, zero-length padding lanes, multi-block
+# grids), the byte-identical ragged-off serving path, the strict
+# compile-shape reduction on the scripted mixed workload, the
+# head-dim fallback counter, and the BucketAutotuner ladder handoff.
+# Chip-free.
+ragged-smoke:
+	$(PYTEST) tests/test_ragged_attention.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

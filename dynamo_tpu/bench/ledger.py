@@ -286,6 +286,11 @@ GATE_THRESHOLDS = {
     "control.padded_token_reduction_pct": GateSpec("higher", 0.5, "abs"),
     "control.goodput_tokens_armed": GateSpec("higher", 0.02, "rel"),
     "control.completed_armed": GateSpec("higher", 0.0, "rel"),
+    # ragged armed pass: per-entry padded-token attribution — any growth
+    # in the flat-token entry's padding (a bucketing or dispatch-model
+    # regression) fails the gate outright
+    "control.padded_by_entry_armed.ragged_step":
+        GateSpec("lower", 0.0, "abs"),
 }
 
 

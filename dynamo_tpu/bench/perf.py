@@ -27,13 +27,18 @@ inflating padded-token share exactly the way a lazy bucketing ladder
 would — the gate must catch it (tests/test_perf_ledger.py pins this).
 
 With ``control=True`` the same replay runs a second, independent world
-with the flight-control bucket autotuner armed (docs/flight_control.md):
-a real `ControlPlane` + `BucketAutotuner` ticked on the *virtual* clock
-proposes rungs from each worker's StepRecorder and the sim routes its
-buckets through the resulting `BucketLadder`s. `main` runs both passes
-and folds the armed deltas into `metrics.control`, which the perf gate
-holds against the baseline — the closed loop itself is under the same
-byte-deterministic regression guard as the serving counters.
+with the ragged attention path armed (engine/ragged.py): prefills and
+decode rounds dispatch the flat-token ``ragged_step`` entry, bucketing
+on total tokens alone via the mocker's `_ragged_bucket` family instead
+of the legacy pow2 rectangles. The armed pass still runs a real
+`ControlPlane` + `BucketAutotuner` ticked on the *virtual* clock — the
+engine shims expose ``ragged_active=True``, so the autotuner's output is
+its one-per-engine ladder-retirement handoff action rather than rung
+proposals (docs/flight_control.md). `main` runs both passes and folds
+the armed deltas into `metrics.control`, which the perf gate holds
+against the baseline — including the per-entry padded-token attribution
+(``control.padded_by_entry_armed.ragged_step``), so a padding
+regression in the ragged dispatch model fails the gate.
 """
 
 from __future__ import annotations
@@ -46,7 +51,7 @@ from dataclasses import asdict, dataclass, field
 
 from dynamo_tpu.engine.profiler import StepRecorder
 from dynamo_tpu.kvbm.lifecycle import KvLifecycleRecorder
-from dynamo_tpu.mocker.engine import _pow2
+from dynamo_tpu.mocker.engine import _pow2, _ragged_bucket
 from dynamo_tpu.mocker.kv_manager import MockKvManager
 from dynamo_tpu.router.decision_log import DecisionRecorder
 from dynamo_tpu.router.scheduler import (
@@ -144,6 +149,7 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
         from dynamo_tpu.control.plane import ControlPlane
         shims = {w: SimpleNamespace(
             step_recorder=steps[w], bucket_ladder=None,
+            ragged_active=True,
             config=SimpleNamespace(worker_id=w[0])) for w in wkeys}
         plane = ControlPlane({"bucket"})
         plane.attach(BucketAutotuner(lambda: [shims[w] for w in wkeys]))
@@ -183,16 +189,19 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
             tokens_saved=result.overlap_blocks * cfg.block_size,
             n_tokens=len(ids))
         loads.add_request(rid, w, uncached, req_blocks)
-        # prefill dispatch, MockEngine cost model + bucket floor
-        bucket = max(_pow2(max(uncached, 1)), floor)
-        if control and shims[w].bucket_ladder is not None:
-            bucket = shims[w].bucket_ladder.bucket_for(
-                max(uncached, 1), bucket, lo=floor)
+        # prefill dispatch, MockEngine cost model + bucket floor; the
+        # armed pass runs the ragged flat-token model — one total-token
+        # bucket, no width axis
+        if control:
+            bucket = max(_ragged_bucket(max(uncached, 1)), floor)
+            entry, shape = "ragged_step", (bucket,)
+        else:
+            bucket = max(_pow2(max(uncached, 1)), floor)
+            entry, shape = "prefill", (1, bucket)
         dt = bucket * cfg.prefill_us_per_token / 1e6
-        shape = (1, bucket)
         fresh = shape not in shapes_seen[w]
         shapes_seen[w].add(shape)
-        steps[w].record("prefill", shape, dt, good_tokens=uncached,
+        steps[w].record(entry, shape, dt, good_tokens=uncached,
                         work_tokens=bucket, lanes=1, width=1,
                         compiled=fresh)
         if not kv[w].allocate_sequence(seq):
@@ -219,15 +228,18 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
             runnable = lanes[w]
             if not runnable:
                 continue
-            width = max(_pow2(len(runnable)), floor)
-            if control and shims[w].bucket_ladder is not None:
-                width = shims[w].bucket_ladder.bucket_for(
-                    len(runnable), width, lo=floor)
-            width = min(width, cfg.max_batch_size)
-            shape = (width, 1)
+            if control:
+                # ragged decode round: one flat row per lane, padded to
+                # the total-token bucket
+                width = max(_ragged_bucket(len(runnable)), floor)
+                entry, shape = "ragged_step", (width,)
+            else:
+                width = max(_pow2(len(runnable)), floor)
+                width = min(width, cfg.max_batch_size)
+                entry, shape = "decode_burst", (width, 1)
             fresh = shape not in shapes_seen[w]
             shapes_seen[w].add(shape)
-            steps[w].record("decode_burst", shape, step_s,
+            steps[w].record(entry, shape, step_s,
                             good_tokens=len(runnable), work_tokens=width,
                             lanes=len(runnable), width=width,
                             tokens=len(runnable), compiled=fresh)
@@ -264,9 +276,10 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
 
 
 def _fold_armed_pass(cfg: PerfConfig, record: dict) -> None:
-    """Run the armed companion pass (same seed, bucket autotuner on) and
-    fold the padded-token delta at equal goodput into the record — the
-    ledger.GATE_THRESHOLDS "control.*" keys — plus the un-gated
+    """Run the armed companion pass (same seed, ragged dispatch model +
+    flight control on) and fold the padded-token delta at equal goodput
+    into the record — the ledger.GATE_THRESHOLDS "control.*" keys,
+    including the per-entry padded-token attribution — plus the un-gated
     ``control_sim`` evidence block for doctor/debug."""
     armed = run_perf(cfg, control=True)
     base_eng = record["metrics"]["engine"]
@@ -283,6 +296,12 @@ def _fold_armed_pass(cfg: PerfConfig, record: dict) -> None:
         "goodput_tokens_armed": armed_eng["goodput_tokens"],
         "compiles_armed": armed_eng["compiles"],
         "completed_armed": armed["completed"],
+        # per-entry padded-token attribution of the armed pass: the
+        # gate pins control.padded_by_entry_armed.ragged_step so a
+        # padding regression inside the ragged model fails rc 1
+        "padded_by_entry_armed": {
+            entry: row["padded_tokens"]
+            for entry, row in sorted(armed_eng["by_entry"].items())},
     }
     record["control_sim"] = sim
 

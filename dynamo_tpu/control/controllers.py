@@ -63,6 +63,12 @@ class BucketAutotuner:
     next safe point between dispatches.  Once a rung lands, new
     dispatches use the tighter shape, the old row decays out of the
     ring, and the proposal naturally stops recurring.
+
+    Engines running the ragged attention path (`eng.ragged_active`) are
+    skipped: the flat-token entry buckets on total tokens alone, so the
+    padding this ladder tunes no longer exists. The handoff is announced
+    ONCE per engine as an explainable `control_events` action instead of
+    silently going quiet.
     """
 
     name = "bucket"
@@ -72,6 +78,7 @@ class BucketAutotuner:
         self.config = config or BucketTunerConfig()
         self._order: dict[str, list[int]] = {}   # rung FIFO per engine
         self._last: dict[str, dict] = {}         # last action per engine
+        self._handoff: set[str] = set()          # ragged handoff announced
 
     def _proposals(self, shapes: list[dict]) -> list[tuple[float, int, dict]]:
         cfg = self.config
@@ -108,6 +115,23 @@ class BucketAutotuner:
             if rec is None:
                 continue
             label = _label(eng, i, "e")
+            if getattr(eng, "ragged_active", False):
+                if label not in self._handoff:
+                    self._handoff.add(label)
+                    prev = getattr(eng, "bucket_ladder", None)
+                    action = {
+                        "knob": f"bucket_ladder/{label}",
+                        "from": sorted(prev.rungs) if prev else [],
+                        "to": "retired",
+                        "reason": ("ragged attention active: the "
+                                   "flat-token entry buckets on total "
+                                   "tokens, deleting the padding this "
+                                   "ladder tunes"),
+                        "evidence": {"ragged_active": True},
+                    }
+                    self._last[label] = action
+                    actions.append(action)
+                continue
             ladder = getattr(eng, "bucket_ladder", None)
             if ladder is None:
                 ladder = BucketLadder(max_rungs=cfg.max_rungs)

@@ -14,20 +14,40 @@ Cache layout (both paths): K/V pages per layer are
 from __future__ import annotations
 
 import functools
+import logging
+import os
 
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.runtime.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
-# Global switch: "auto" | "xla" | "pallas". Trace-time constant.
-_impl = "auto"
+_IMPLS = ("auto", "xla", "pallas", "ragged")
+
+# Global switch: "auto" | "xla" | "pallas" | "ragged". Trace-time
+# constant. "ragged" arms the flat-token dispatch path (engine/ragged.py
+# + the engine's ragged_step entry); kernel-vs-XLA selection within it
+# still follows the "auto" backend logic. Seeded from DYN_ATTENTION_IMPL
+# so deployments flip it without code.
+_impl = os.environ.get("DYN_ATTENTION_IMPL", "auto").strip().lower()
+if _impl not in _IMPLS:
+    _impl = "auto"
 
 
 def set_attention_impl(impl: str) -> None:
     global _impl
-    assert impl in ("auto", "xla", "pallas"), impl
+    assert impl in _IMPLS, impl
     _impl = impl
+
+
+def ragged_enabled() -> bool:
+    """True when the engine should route batches through the flat-token
+    ragged entry instead of the prefill/decode/mixed shape zoo."""
+    return _impl == "ragged"
 
 
 def use_pallas() -> bool:
@@ -35,12 +55,58 @@ def use_pallas() -> bool:
         return True
     if _impl == "xla":
         return False
-    # auto: honour an explicit jax_default_device override (tests pin CPU
-    # while the process-default backend stays TPU under the axon tunnel)
+    # auto/ragged: honour an explicit jax_default_device override (tests
+    # pin CPU while the process-default backend stays TPU under the axon
+    # tunnel)
     dev = jax.config.jax_default_device
     if dev is not None:
         return dev.platform == "tpu"
     return jax.default_backend() == "tpu"
+
+
+# Fallback attribution: the kernel path can silently decline a dispatch
+# (unaligned head_dim, ragged-ineligible geometry) and the profiler needs
+# to know the slow path ran. Incremented at TRACE time — once per
+# compiled shape that fell back, which is the actionable signal (every
+# execution of that shape falls back). EngineMetrics.register adopts it
+# into /metrics.
+attention_fallbacks = Counter(
+    "dynamo_attention_fallback_total",
+    "attention dispatches that fell back to the XLA path, by reason "
+    "(counted at trace time, once per compiled shape)")
+_warned_reasons: set[str] = set()
+
+
+def _note_fallback(reason: str) -> None:
+    attention_fallbacks.inc(reason=reason)
+    if reason not in _warned_reasons:
+        _warned_reasons.add(reason)
+        logger.warning(
+            "attention falling back to the XLA path (reason=%s) — "
+            "logged once; see dynamo_attention_fallback_total", reason)
+
+
+@functools.lru_cache(maxsize=None)
+def block_choice(max_pages: int, page_size: int) -> int:
+    """Pages per compute block for the paged-attention kernels.
+
+    Measured on v5e (batch 32, ctx 1152): tiny blocks are grid-overhead-
+    bound — pages_per_compute_block=8 ran the fused step at 26 ms vs
+    16 ms at 32 pages/block (and 12 ms with 32-token pages). Bigger
+    blocks also read more padding past each lane's length, which hurts
+    short contexts (b16 ctx128: 6.8 ms at 256-token blocks vs 7.5 ms at
+    512). Target: ~1/4 of max context, at least 256 tokens, snapped to
+    the largest divisor of max_pages (the kernels need the block count
+    to tile the page table exactly). Shared by `_pallas_decode` and
+    `ragged.ragged_paged_attention`; cached — the geometry set is tiny.
+    """
+    want_tokens = max(256, (max_pages * page_size) // 4)
+    want = max(1, want_tokens // page_size)
+    ppcb = 1
+    for cand in range(1, max_pages + 1):
+        if max_pages % cand == 0 and cand <= want:
+            ppcb = cand
+    return ppcb
 
 
 def _repeat_kv(x: jax.Array, groups: int, axis: int) -> jax.Array:
@@ -120,8 +186,11 @@ def paged_attention_decode(q: jax.Array, k_pages: jax.Array,
     """
     # Mosaic tiling constraint: last dims must align to (8, 128) lanes —
     # head_dim must be a multiple of 128 for the kernel's block specs.
-    if use_pallas() and q.shape[-1] % 128 == 0:
-        return _pallas_decode(q, k_pages, v_pages, lengths, page_tables)
+    if use_pallas():
+        if q.shape[-1] % 128 == 0:
+            return _pallas_decode(q, k_pages, v_pages, lengths,
+                                  page_tables)
+        _note_fallback("head_dim")
     return _xla_decode(q, k_pages, v_pages, lengths, page_tables)
 
 
@@ -156,23 +225,33 @@ def _pallas_paged_attention():
 
 def _pallas_decode(q, k_pages, v_pages, lengths, page_tables):
     kernel = _pallas_paged_attention()
-    max_pages = page_tables.shape[1]
-    page_size = k_pages.shape[2]
-    # Block-size heuristic, measured on v5e (batch 32, ctx 1152): tiny
-    # blocks are grid-overhead-bound — pages_per_compute_block=8 ran the
-    # fused step at 26 ms vs 16 ms at 32 pages/block (and 12 ms with
-    # 32-token pages). Bigger blocks also read more padding past each
-    # lane's length, which hurts short contexts (b16 ctx128: 6.8 ms at
-    # 256-token blocks vs 7.5 ms at 512). Target: ~1/4 of max context,
-    # at least 256 tokens, snapped to the largest divisor of max_pages.
-    want_tokens = max(256, (max_pages * page_size) // 4)
-    want = max(1, want_tokens // page_size)
-    ppcb = 1
-    for cand in range(1, max_pages + 1):
-        if max_pages % cand == 0 and cand <= want:
-            ppcb = cand
     return kernel(
         q, k_pages, v_pages, lengths.astype(jnp.int32),
         page_tables.astype(jnp.int32),
-        pages_per_compute_block=ppcb,
+        pages_per_compute_block=block_choice(page_tables.shape[1],
+                                             k_pages.shape[2]),
     )
+
+
+def ragged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                     token_qpos: jax.Array, token_lanes: jax.Array,
+                     lane_tables: jax.Array, page_size: int) -> jax.Array:
+    """Flat-token ragged paged attention — THE attention entry for the
+    engine's ragged dispatch path (decode lanes, prefill chunk tokens,
+    and mixed batches all ride it as rows of one (T, H, D) array).
+
+    q: (T, H, D); token_qpos: (T,) absolute position each row attends
+    up to, -1 for padding rows; token_lanes: (T,) row into lane_tables;
+    lane_tables: (L, max_pages). Routes to the pallas kernel on TPU when
+    the geometry tiles (engine/ragged.py), else the XLA flat reference —
+    noting the fallback so the profiler can attribute the slow path.
+    """
+    from dynamo_tpu.engine import ragged
+
+    if use_pallas():
+        if ragged.ragged_supported(page_size, q.shape[-1]):
+            return ragged.ragged_paged_attention(
+                q, k_pages, v_pages, token_qpos, token_lanes, lane_tables)
+        _note_fallback("ragged_ineligible")
+    return ragged.ragged_attention_xla(
+        q, k_pages, v_pages, token_qpos, token_lanes, lane_tables)

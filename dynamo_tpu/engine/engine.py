@@ -37,6 +37,7 @@ from dynamo_tpu.engine.memory import is_resource_exhausted, record_oom
 from dynamo_tpu.engine.profiler import recorder_from_env
 from dynamo_tpu.engine.sampling import sample_tokens_lp
 from dynamo_tpu.llm.perf import itl_percentile
+from dynamo_tpu.engine.attention import ragged_enabled
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     decode_multi_step,
@@ -44,6 +45,7 @@ from dynamo_tpu.models.llama import (
     init_params,
     mixed_prefill_decode,
     prefill_batch,
+    ragged_prefill_decode,
 )
 from dynamo_tpu.protocols import (
     FINISH_CANCELLED,
@@ -1536,6 +1538,8 @@ class TpuEngine:
         chunk sub-batch and the decode burst share the device dispatch
         (and each layer's weight stream). Decode lanes' tokens emit from
         this step exactly as a plain burst's would."""
+        if self._ragged_active():
+            return await self._ragged_mixed(picks, offsets, caps, batch)
         cfg, mcfg = self.config, self.model_cfg
         bp = self._prefill_width(len(picks))
         chunk_lens = [caps[id(s)] for s in picks]
@@ -1789,6 +1793,11 @@ class TpuEngine:
         # top-k alternatives ride the packed burst only when some lane
         # asked (separate compiled variant; hot path unaffected)
         tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
+        if (self._ragged_active()
+                and not any(s.needs_constrained for s in batch)):
+            # flat one-row-per-lane round; constrained lanes keep the
+            # guided burst (grammar masks/penalties live in that entry)
+            return await self._ragged_decode(batch, tk)
         if any(not s.prefilled for s in self._running):
             # decode progressed while some prompt's prefill is still
             # mid-flight — the interleaving the budgeted scheduler
@@ -2299,6 +2308,196 @@ class TpuEngine:
                 n, base, lo=cfg.min_prefill_bucket, align=mcfg.page_size)
         return base
 
+    # -- ragged dispatch ----------------------------------------------------
+
+    def _ragged_active(self) -> bool:
+        """True when this engine routes batches through the flat-token
+        ragged entry (`DYN_ATTENTION_IMPL=ragged` / set_attention_impl).
+        Spec (draft) and pipeline-parallel engines keep their dedicated
+        entries — their burst structure is the feature, not padding."""
+        return (ragged_enabled() and self.config.pp_mesh is None
+                and self.draft_params is None)
+
+    @property
+    def ragged_active(self) -> bool:
+        """Controller-facing alias (control/controllers.py gates the
+        BucketAutotuner off a `ragged_active` attribute so the perf-sim
+        shims and MockEngine can expose the same signal)."""
+        return self._ragged_active()
+
+    def _ragged_bucket(self, n: int) -> int:
+        """Total-token bucket for a ragged round. Below
+        min_prefill_bucket the bucket is plain pow2 — decode-tail
+        rounds (a few lanes, no chunks) match the legacy width family
+        instead of padding one lane to a 16-row floor. Above it, the
+        {lo·2^k, lo·3·2^(k-1)} ladder with NO page alignment (flat rows
+        scatter per-row KV, so a misaligned Tb disables nothing) and no
+        prefill_chunk cap (the round may also carry up to
+        max_batch_size decode rows)."""
+        lo = self.config.min_prefill_bucket
+        if n < lo:
+            return _next_pow2(n, 1, lo)
+        return _next_bucket(n, lo, 1 << 30)
+
+    def _ragged_core(self, kc, vc, picks: list[_Seq], offsets,
+                     chunk_lens: list[int], tokens_of,
+                     batch: list[_Seq], tk: int):
+        """Build + dispatch ONE flat-token ragged round (device-blocking
+        — call under the device lock, in a thread): each pick's capped
+        chunk becomes `chunk_lens[i]` flat rows; when decode lanes ride
+        the round they occupy a FIXED block of max_batch_size rows
+        (invalid rows mark empty lanes) — the decode-lane count spans a
+        tiny bounded range where a recompile costs far more than the
+        padded rows (the same trade the legacy fixed-width burst makes),
+        while chunk tokens, the unbounded axis, stay exact-length.
+        Padding rows fill to the total-token bucket. The compile shape
+        is `(t_bucket, tk)` — lane-table width, ch_rows and the
+        sampling arrays are fixed at max_batch_size, so decode width,
+        chunk count, k_steps and alignment all vanish from the shape
+        zoo (tk stays: top-k logprobs change the packed output width,
+        a genuinely different program). Registers the dispatch with the
+        memory ledger (the kernel workspace + caches attribute to the
+        `ragged_step` entry).
+        Returns (packed np (2+2tk, 1, bmax), ch_logits (device, row i =
+        pick i's last chunk token), kc, vc)."""
+        cfg, mcfg = self.config, self.model_cfg
+        P = mcfg.page_size
+        bmax = cfg.max_batch_size
+        total = sum(chunk_lens) + (bmax if batch else 0)
+        tb = self._ragged_bucket(total)
+        toks = np.zeros(tb, dtype=np.int32)
+        poss = np.zeros(tb, dtype=np.int32)
+        pages = np.zeros(tb, dtype=np.int32)
+        offs = np.zeros(tb, dtype=np.int32)
+        valid = np.zeros(tb, dtype=bool)
+        lanes = np.zeros(tb, dtype=np.int32)
+        # lane-table rows 0..bmax-1 = chunk picks, bmax..2*bmax-1 =
+        # decode lanes; the width is a constant so it never buckets
+        lane_tables = np.zeros((2 * bmax, mcfg.max_pages_per_seq),
+                               dtype=np.int32)
+        ch_rows = np.zeros(bmax, dtype=np.int32)
+        d_rows = np.zeros(bmax, dtype=np.int32)
+        seeds = np.zeros(bmax, dtype=np.uint32)
+        steps = np.zeros(bmax, dtype=np.uint32)
+        temps = np.zeros(bmax, dtype=np.float32)
+        top_ps = np.ones(bmax, dtype=np.float32)
+        top_ks = np.zeros(bmax, dtype=np.int32)
+        r = 0
+        for i, s in enumerate(picks):
+            off, n = offsets[id(s)], chunk_lens[i]
+            seq_pages = np.asarray(s.pages, dtype=np.int32)
+            lane_tables[i, :len(s.pages)] = seq_pages
+            p_arr = np.arange(off, off + n, dtype=np.int32)
+            toks[r:r + n] = tokens_of(s)[off:off + n]
+            poss[r:r + n] = p_arr
+            pages[r:r + n] = seq_pages[p_arr // P]
+            offs[r:r + n] = p_arr % P
+            valid[r:r + n] = True
+            lanes[r:r + n] = i
+            r += n
+            ch_rows[i] = r - 1
+        if batch:
+            # fixed decode block: row r+j is lane j, valid only for the
+            # lanes actually present; d_rows for empty slots point at
+            # their own (masked, zero-output) padding row
+            d_rows[:] = r + np.arange(bmax, dtype=np.int32)
+        for j, s in enumerate(batch):
+            li = bmax + j
+            rj = r + j
+            lane_tables[li, :len(s.pages)] = s.pages
+            toks[rj] = s.next_token
+            poss[rj] = s.pos
+            pages[rj] = s.pages[s.pos // P]
+            offs[rj] = s.pos % P
+            valid[rj] = True
+            lanes[rj] = li
+            seeds[j] = s.seed
+            steps[j] = s.generated
+            temps[j] = s.req.sampling.temperature
+            top_ps[j] = s.req.sampling.top_p
+            top_ks[j] = s.req.sampling.top_k
+
+        trk = self.metrics.compile.track("ragged_step", (tb, tk))
+        led = self.memory_ledger
+        if led is not None:
+            led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
+        with trk:
+            packed, ch_logits, kc, vc = ragged_prefill_decode(
+                self.params, kc, vc,
+                jax.numpy.asarray(toks), jax.numpy.asarray(poss),
+                jax.numpy.asarray(pages), jax.numpy.asarray(offs),
+                jax.numpy.asarray(valid), jax.numpy.asarray(lanes),
+                jax.numpy.asarray(lane_tables),
+                jax.numpy.asarray(ch_rows), jax.numpy.asarray(d_rows),
+                jax.numpy.asarray(seeds), jax.numpy.asarray(steps),
+                jax.numpy.asarray(temps), jax.numpy.asarray(top_ps),
+                jax.numpy.asarray(top_ks), mcfg, tk)
+            # ONE host sync; chunk logits stay on device for the
+            # first-token sampler
+            packed = np.asarray(packed)
+        if picks:
+            self.metrics.prefill_chunk.observe(trk.elapsed_s)
+        rec = self.step_recorder
+        if rec is not None:
+            # the whole point: work is the total-token bucket, not a
+            # (width x steps) + (bp x t_bucket) rectangle — padding is
+            # the bucket tail plus any empty decode-block slots
+            rec.record("ragged_step", trk.shape, trk.elapsed_s,
+                       good_tokens=sum(chunk_lens) + len(batch),
+                       work_tokens=tb,
+                       lanes=len(picks) + len(batch), width=len(batch),
+                       tokens=len(batch), compiled=trk.compiled)
+        self._mark_decode_compile(batch, trk)
+        if picks:
+            self._trace_chunk(picks, chunk_lens, trk, mixed=bool(batch))
+        return packed, ch_logits, kc, vc
+
+    async def _ragged_mixed(self, picks: list[_Seq], offsets, caps,
+                            batch: list[_Seq]) -> bool:
+        """The ragged replacement for `_mixed_step`: chunk rows + the
+        fixed decode block in ONE flat dispatch. Decode lanes advance
+        one token per round (the scheduler loop supplies the cadence) —
+        vs the fused k_steps burst this trades more dispatches for a
+        compile shape that varies only with the chunk-token total."""
+        chunk_lens = [caps[id(s)] for s in picks]
+        tk = self.TOPK_WIDTH if any(s.wants_topk for s in batch) else 0
+
+        def dispatch():
+            return self._ragged_core(
+                self.k_cache, self.v_cache, picks, offsets, chunk_lens,
+                lambda s: s.prompt, batch, tk)
+
+        async with self._device_lock:
+            packed, ch_logits, self.k_cache, self.v_cache = \
+                await asyncio.to_thread(dispatch)
+        self.metrics.mixed_steps.inc()
+        self.metrics.decode_steps_during_prefill.inc(1)
+        done_logits: dict[int, Any] = {}
+        for i, s in enumerate(picks):
+            offsets[id(s)] += chunk_lens[i]
+            s.prefill_pos = offsets[id(s)]
+            if s.prefill_pos >= len(s.prompt):
+                done_logits[id(s)] = ch_logits[i]
+        self._emit_burst(batch, packed, 1, tk)
+        await self._finish_first_tokens(picks, done_logits)
+        return True
+
+    async def _ragged_decode(self, batch: list[_Seq], tk: int) -> bool:
+        """Decode-only ragged round: one flat row per lane, one token
+        per lane per dispatch."""
+        if any(not s.prefilled for s in self._running):
+            self.metrics.decode_steps_during_prefill.inc(1)
+
+        def dispatch():
+            return self._ragged_core(self.k_cache, self.v_cache, [], {},
+                                     [], None, batch, tk)
+
+        async with self._device_lock:
+            packed, _, self.k_cache, self.v_cache = \
+                await asyncio.to_thread(dispatch)
+        self._emit_burst(batch, packed, 1, tk)
+        return True
+
     def _chunk_round_once(self, params_, model_cfg, kc, vc, ready,
                           offsets, tokens_of, target_len_of, caps=None):
         """ONE batched prefill chunk round: group by page-alignment,
@@ -2307,8 +2506,25 @@ class TpuEngine:
         bounds each sequence's chunk below cfg.prefill_chunk — the
         budgeted scheduler's token budget. Returns (kc, vc,
         {id(s): last-token logits} for sequences whose offset REACHED
-        target this round, tokens consumed)."""
+        target this round, tokens consumed). When the ragged path is
+        active (target model only — the draft keeps its entry), the
+        round dispatches flat rows instead: no alignment grouping, no
+        width/T-bucket rectangle."""
         cfg = self.config
+        if params_ is self.params and self._ragged_active():
+            active = ready[:cfg.max_batch_size]
+            chunk_lens = [min(target_len_of(s) - offsets[id(s)],
+                              cfg.prefill_chunk,
+                              caps[id(s)] if caps else cfg.prefill_chunk)
+                          for s in active]
+            packed_, ch_logits, kc, vc = self._ragged_core(
+                kc, vc, active, offsets, chunk_lens, tokens_of, [], 0)
+            done: dict[int, Any] = {}
+            for i, s in enumerate(active):
+                offsets[id(s)] += chunk_lens[i]
+                if offsets[id(s)] >= target_len_of(s):
+                    done[id(s)] = ch_logits[i]
+            return kc, vc, done, sum(chunk_lens)
         # rounds are grouped by page-alignment of the cached
         # offset: mid-page starts (disagg imports) need the row
         # write path — batching them with aligned lanes would

@@ -151,6 +151,10 @@ class EngineMetrics:
                   self.goodput_tokens, self.padded_tokens,
                   self.dispatch_gap):
             registry.register(m)
+        # module-owned: the attention impl switch predates any engine,
+        # but its fallback attribution belongs on the same scrape
+        from dynamo_tpu.engine.attention import attention_fallbacks
+        registry.register(attention_fallbacks)
         self.compile.register(registry)
 
     # -- legacy view ---------------------------------------------------------

@@ -58,7 +58,7 @@ from typing import Optional
 STEP_ENTRIES = (
     "decode_burst", "decode_guided", "spec_decode", "pp_decode",
     "pp_prefill", "prefill", "prefill_draft", "mixed_step",
-    "sample_first", "gather_kv", "write_kv", "burst_sync",
+    "ragged_step", "sample_first", "gather_kv", "write_kv", "burst_sync",
 )
 
 DEFAULT_RING = 2048

@@ -50,6 +50,26 @@ def _pow2(n: int) -> int:
     return p
 
 
+def _ragged_bucket(n: int, lo: int = 16) -> int:
+    """Total-token bucket for the mock ragged path — the same family as
+    TpuEngine._ragged_bucket (pow2 below `lo` so decode-tail rounds
+    match the legacy width axis, then the {lo*2^k, lo*3*2^(k-1)} ladder
+    with no page alignment or chunk cap), so the perf gate's
+    padded-token delta between the legacy rectangles and the ragged
+    flat dispatch is analytically recomputable, like _pow2 is for the
+    legacy model."""
+    n = max(n, 1)
+    if n < lo:
+        return _pow2(n)
+    b = lo
+    while b < n:
+        mid = b + b // 2
+        if n <= mid:
+            return mid
+        b *= 2
+    return b
+
+
 @dataclass
 class MockEngineConfig:
     total_kv_blocks: int = 1024
@@ -76,6 +96,11 @@ class MockEngineConfig:
     # as TpuEngineConfig.admit_lookahead): 0 = exact legacy head-only
     # order, bit-for-bit; ignored when DYN_TENANCY arms fair share
     admit_lookahead: int = 0
+    # ragged attention cost model (engine/ragged.py analog): steps record
+    # the flat-token `ragged_step` entry — work is the total-token bucket
+    # (_ragged_bucket), not a pow2 rectangle — so `make perf-gate`
+    # credits the padded-token delta deterministically
+    ragged: bool = False
 
 
 @dataclass
@@ -128,6 +153,9 @@ class MockEngine:
         # by the flight-control bucket autotuner; None (the default) keeps
         # the static _pow2 bucketing byte-identical
         self.bucket_ladder = None
+        # controller-facing ragged signal (TpuEngine.ragged_active
+        # contract): the BucketAutotuner retires its ladder when set
+        self.ragged_active = self.config.ragged
         # KV lifecycle flight recorder parity (kvbm/lifecycle.py): the
         # mock block pools record the same allocate/hit/evict/kv_event
         # transitions, so the lifecycle math is analytically checkable
@@ -418,12 +446,21 @@ class MockEngine:
                 # cannot fit even after eviction: preempt or requeue
                 self._preempt(r)
                 continue
+            good = max(uncached_tokens, 0)
+            if cfg.ragged:
+                entry, shape = "ragged_step", (_ragged_bucket(good),)
+                bucket = shape[0]
+            else:
+                entry = "prefill"
+                bucket = _pow2(good)
+                if self.bucket_ladder is not None:
+                    bucket = self.bucket_ladder.bucket_for(good, bucket)
+                shape = (1, bucket)
             led = self.memory_ledger
             if led is not None:
-                b = _pow2(max(uncached_tokens, 0))
                 led.on_dispatch(
-                    "prefill", (1, b),
-                    nbytes=b * cfg.workspace_bytes_per_token)
+                    entry, shape,
+                    nbytes=bucket * cfg.workspace_bytes_per_token)
             t0_ns = time.time_ns()
             await self._sleep(max(uncached_tokens, 0)
                               * cfg.prefill_us_per_token / 1e6)
@@ -433,11 +470,7 @@ class MockEngine:
             self.metrics.prefill_chunk.observe((end_ns - t0_ns) / 1e9)
             rec = self.step_recorder
             if rec is not None:
-                good = max(uncached_tokens, 0)
-                bucket = _pow2(good)
-                if self.bucket_ladder is not None:
-                    bucket = self.bucket_ladder.bucket_for(good, bucket)
-                rec.record("prefill", (1, bucket),
+                rec.record(entry, shape,
                            (end_ns - t0_ns) / 1e9, good_tokens=good,
                            work_tokens=bucket, lanes=1, width=1)
             if r.trace is not None:
@@ -455,11 +488,21 @@ class MockEngine:
         runnable = [r for r in self._running if r.prefilled]
         if not runnable:
             return False
+        if cfg.ragged:
+            d_entry = "ragged_step"
+            d_shape = (_ragged_bucket(len(runnable)),)
+            d_work = d_shape[0]
+        else:
+            d_entry = "decode_burst"
+            w = _pow2(len(runnable))
+            if self.bucket_ladder is not None:
+                w = self.bucket_ladder.bucket_for(len(runnable), w)
+            d_work = min(w, cfg.max_batch_size)
+            d_shape = (d_work, 1)
         led = self.memory_ledger
         if led is not None:
-            w = min(_pow2(len(runnable)), cfg.max_batch_size)
-            led.on_dispatch("decode_burst", (w, 1),
-                            nbytes=w * cfg.workspace_bytes_per_token)
+            led.on_dispatch(d_entry, d_shape,
+                            nbytes=d_work * cfg.workspace_bytes_per_token)
         t0_ns = time.time_ns()
         await self._sleep(cfg.decode_ms_per_iter / 1e3)
         step_ns = time.time_ns() - t0_ns
@@ -515,15 +558,12 @@ class MockEngine:
         rec = self.step_recorder
         if rec is not None:
             # decode goodput == emitted tokens (make profile-smoke
-            # asserts the two counters agree); width is the pow2 lane
-            # bucket the real engine would have dispatched
-            width = _pow2(len(runnable))
-            if self.bucket_ladder is not None:
-                width = self.bucket_ladder.bucket_for(len(runnable), width)
-            width = min(width, cfg.max_batch_size)
-            rec.record("decode_burst", (width, 1), step_ns / 1e9,
-                       good_tokens=emitted, work_tokens=width,
-                       lanes=len(runnable), width=width,
+            # asserts the two counters agree); work is the lane bucket
+            # the real engine would have dispatched — a pow2 rectangle
+            # on the legacy path, the flat total-token bucket on ragged
+            rec.record(d_entry, d_shape, step_ns / 1e9,
+                       good_tokens=emitted, work_tokens=d_work,
+                       lanes=len(runnable), width=d_work,
                        tokens=emitted)
         return True
 

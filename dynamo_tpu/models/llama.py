@@ -653,6 +653,90 @@ def mixed_prefill_decode(params: dict, k_cache: tuple, v_cache: tuple,
     return out, ch_logits, k_cache, v_cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "topk_lp"), donate_argnums=(1, 2))
+def ragged_prefill_decode(params: dict, k_cache: tuple, v_cache: tuple,
+                          tokens: jax.Array, positions: jax.Array,
+                          page_ids: jax.Array, offsets: jax.Array,
+                          valid: jax.Array, token_lanes: jax.Array,
+                          lane_tables: jax.Array, ch_rows: jax.Array,
+                          d_rows: jax.Array, seeds: jax.Array,
+                          steps0: jax.Array, temperature: jax.Array,
+                          top_p: jax.Array, top_k: jax.Array,
+                          cfg: LlamaConfig, topk_lp: int = 0
+                          ) -> tuple[jax.Array, jax.Array, tuple, tuple]:
+    """THE flat-token ragged step: prefill chunk tokens and decode lanes
+    ride one (Tb,) token array through one forward — no chunk rectangle,
+    no pow2 decode width, no (Bp, T, k_steps, …) shape-zoo tuple. The
+    only compile-shape dimension that varies is Tb, the total-token
+    bucket (ch_rows/d_rows/sampling arrays are fixed at the engine's
+    max_batch_size).
+
+    tokens/positions/page_ids/offsets/valid/token_lanes: (Tb,) flat rows
+    — each a chunk token or one decode lane's next token; padding rows
+    have valid=False (KV redirects to scratch page 0, attention fully
+    masked). lane_tables: (L, max_pages) page tables, one row per lane;
+    rows are disjoint across sequences so cross-lane leakage is
+    structurally impossible; within-chunk causality comes from the
+    ragged mask (a row attends positions <= its own, and its K/V is
+    written before attention — the `_decode_once` contract).
+    ch_rows: (Bp,) flat row of each chunk's LAST token (→ ch_logits);
+    d_rows: (B,) flat row of each decode lane (→ sampled). Sampling
+    matches decode_multi_step's step exactly (same traced sampler, same
+    steps0 indexing), so a lane's stream is identical whether its token
+    came from a fused burst or a ragged round. Returns
+    (packed (2 + 2*topk_lp, 1, B) f32 in the decode_multi_step layout,
+    ch_logits (Bp, V) f32, k_cache, v_cache).
+    """
+    from dynamo_tpu.engine.attention import ragged_attention
+    from dynamo_tpu.engine.sampling import (
+        chosen_logprob,
+        sample_tokens_traced,
+        topk_logprobs,
+    )
+
+    Tb = tokens.shape[0]
+    x = params["embed"][tokens]                            # (Tb, E)
+    qpos = jnp.where(valid, positions, -1).astype(jnp.int32)
+
+    new_k, new_v = [], []
+    for l in range(cfg.num_layers):
+        lp = _layer_params(params, l)
+        kc, vc = k_cache[l], v_cache[l]
+        hn = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = qkv_proj(hn, lp, cfg)
+        q = q.reshape(Tb, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(Tb, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(Tb, cfg.num_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        kc, vc = _write_kv(kc, vc, k, v, page_ids, offsets, valid)
+        attn = ragged_attention(q, kc, vc, qpos, token_lanes, lane_tables,
+                                page_size=cfg.page_size)   # (Tb, H, D)
+        x = x + qm(attn.reshape(Tb, -1), lp["wo"])
+        hn = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(hn, lp, cfg)
+        new_k.append(kc)
+        new_v.append(vc)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    ch_logits = qm(x[ch_rows], params["lm_head"]).astype(jnp.float32)
+    d_logits = qm(x[d_rows], params["lm_head"]).astype(jnp.float32)
+
+    sampled = sample_tokens_traced(
+        d_logits, seeds, steps0, temperature, top_p, top_k)
+    chosen = chosen_logprob(d_logits, sampled)
+    out = jnp.zeros((2 + 2 * topk_lp, 1, d_rows.shape[0]),
+                    dtype=jnp.float32)
+    out = out.at[0, 0].set(sampled.astype(jnp.float32))
+    out = out.at[1, 0].set(chosen)
+    if topk_lp:
+        ids, vals = topk_logprobs(d_logits, topk_lp)
+        out = lax.dynamic_update_slice(out, ids.T[:, None, :], (2, 0, 0))
+        out = lax.dynamic_update_slice(
+            out, vals.T[:, None, :], (2 + topk_lp, 0, 0))
+    return out, ch_logits, tuple(new_k), tuple(new_v)
+
+
 @partial(jax.jit, static_argnames=("cfg", "num_steps", "topk_lp"),
          donate_argnums=(1, 2))
 def decode_multi_step_guided(params: dict, k_cache, v_cache,

@@ -340,9 +340,11 @@ def test_plane_tick_stamps_counts_and_guards():
 
 def test_perf_armed_pass_deterministic_and_goodput_preserving():
     """Two armed passes must replay to byte-identical records (the
-    controllers are clock-free), and the armed ladder must cut padded
-    tokens without costing a single token of goodput — the exact
-    property the extended perf gate holds the checked-in baseline to."""
+    controllers are clock-free), and the armed ragged dispatch must cut
+    padded tokens without costing a single token of goodput — the exact
+    property the extended perf gate holds the checked-in baseline to.
+    With ragged active the bucket controller's actions are ladder
+    handoffs (retired, explainable), not rung edits."""
     from dynamo_tpu.bench.perf import PerfConfig, record_to_json, run_perf
 
     cfg = PerfConfig()
@@ -353,7 +355,10 @@ def test_perf_armed_pass_deterministic_and_goodput_preserving():
     assert a["control_sim"]["events"], "armed pass never acted"
     for ev in a["control_sim"]["events"]:
         assert ev["controller"] == "bucket"
-        assert "from" in ev and "to" in ev and ev["evidence"]["shapes"]
+        assert "from" in ev and ev["to"] == "retired"
+        assert ev["evidence"]["ragged_active"] is True
+    assert "ragged_step" in \
+        base["metrics"]["control"]["padded_by_entry_armed"]
     assert a["metrics"]["engine"]["goodput_tokens"] == \
         base["metrics"]["engine"]["goodput_tokens"]
     assert a["metrics"]["engine"]["padded_pct"] < \

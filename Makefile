@@ -5,7 +5,8 @@ PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 
 .PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
 	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
-	kv-smoke perf-gate perf-baseline fairness-smoke ragged-smoke
+	kv-smoke perf-gate perf-baseline fairness-smoke ragged-smoke \
+	overload-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -21,7 +22,8 @@ tier1:
 # kills/stalls/wedges workers mid-stream and requires 100% of requests
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
-chaos: heal-smoke control-smoke mem-smoke fairness-smoke ragged-smoke
+chaos: heal-smoke control-smoke mem-smoke fairness-smoke ragged-smoke \
+	overload-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -138,6 +140,20 @@ perf-baseline:
 # md5, clean /metrics). Chip-free.
 fairness-smoke:
 	$(PYTEST) tests/test_tenancy.py
+
+# serving-class / brownout gate (docs/robustness.md "Serving classes &
+# brownout"): class-table parsing and resolution precedence, the
+# deadline-admission decision boundary on hand-built histograms, the
+# brownout ladder under a fake clock (escalation + hysteresis
+# walk-back), expired deadlines dropped before prefill, the chaos soak
+# with client abandons, and the overload gauntlet — a bursty mix beyond
+# mock-fleet capacity with the SLO monitor + brownout armed, gated on
+# batch shedding before any interactive 503, zero engine-side drops of
+# admitted streams, and the explainable stage on every surface. Also
+# pins the unarmed byte-identical contract (schedule artifact md5,
+# clean /metrics, no gate objects on the HTTP path). Chip-free.
+overload-smoke:
+	$(PYTEST) tests/test_serving_classes.py
 
 # ragged-attention gate (docs/scheduler.md "Ragged dispatch"):
 # interpret-mode Pallas kernel parity vs the XLA reference (GQA

@@ -361,11 +361,11 @@ def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
             "max_batch_size": cfg.max_batch_size,
             "prefill_us_per_token": cfg.prefill_us_per_token,
             "decode_ms_per_iter": cfg.decode_ms_per_iter,
-            # empty tenants key dropped: untenanted perf records stay
-            # byte-identical to pre-tenancy baselines (same contract as
-            # schedule_to_jsonl)
+            # empty tenants/classes keys dropped: untenanted, classless
+            # perf records stay byte-identical to older baselines (same
+            # contract as schedule_to_jsonl)
             "traffic": {k: v for k, v in asdict(cfg.traffic).items()
-                        if k != "tenants" or v},
+                        if k not in ("tenants", "classes") or v},
         },
         "metrics": {
             "engine": {

@@ -33,7 +33,7 @@ logger = logging.getLogger(__name__)
 CONTROL_EVENTS_SUBJECT = "control_events"
 
 #: every controller this build knows how to host, in attach order
-CONTROLLERS = ("bucket", "kvbm", "router", "forecast")
+CONTROLLERS = ("bucket", "kvbm", "router", "forecast", "brownout")
 
 _TRUTHY = {"1", "true", "yes", "on"}
 
@@ -181,15 +181,16 @@ class ControlPlane:
 
 
 def control_plane_from_env(runtime=None, *, engines=None, routers=None,
-                           planner=None, scale_events=None,
+                           planner=None, scale_events=None, brownout=None,
                            now=time.time) -> ControlPlane | None:
     """Build an armed ControlPlane from DYN_CONTROL, or None when unset.
 
     ``engines``/``routers``/``scale_events`` are zero-arg suppliers (the
     fleet they observe can grow after wiring); ``planner`` is the live
-    Planner or None.  Controllers whose inputs are absent are simply not
-    attached — arming `forecast` on a frontend with no planner is a
-    no-op, not an error.
+    Planner or None; ``brownout`` is the frontend's live BrownoutMachine
+    (serving_classes) or None.  Controllers whose inputs are absent are
+    simply not attached — arming `forecast` on a frontend with no
+    planner is a no-op, not an error.
     """
     enabled = control_enabled()
     if not enabled:
@@ -216,4 +217,9 @@ def control_plane_from_env(runtime=None, *, engines=None, routers=None,
     if planner is not None:
         plane.attach(ScaleAwareForecast(planner, scale_events
                                         or (lambda: [])))
+    if brownout is not None:
+        # the brownout machine already satisfies the controller contract
+        # (name/tick/state); attaching puts its walk-back on the shared
+        # tick and its stage transitions in the control action ring
+        plane.attach(brownout)
     return plane

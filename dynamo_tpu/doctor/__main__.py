@@ -111,6 +111,9 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "tenants": ("tenants",
                 "per-tenant quotas, fair-share deficits, and goodput "
                 "from /debug/tenants"),
+    "classes": ("classes",
+                "serving-class objectives, deadline admission, and "
+                "brownout stage from /debug/classes"),
 }
 
 

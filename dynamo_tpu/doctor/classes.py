@@ -1,0 +1,125 @@
+"""`python -m dynamo_tpu.doctor classes <url-or-json>` — render the
+serving-class / brownout view.
+
+Input is either a frontend base url (fetches ``/debug/classes`` over
+HTTP) or a path to a JSON file holding the same payload. Prints each
+class's objectives and weight against its live admit/shed/downgrade
+counts, the deadline-admission estimate the gate is currently using,
+the brownout stage with its hot objectives, and the shed/reject
+breakdown by reason. Exit code 0 when a classes view was rendered,
+1 when the input was unusable or serving classes are unarmed (the
+frontend answers 503 without DYN_CLASSES).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def load_classes(source: str) -> Optional[dict]:
+    """Fetch /debug/classes from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.error
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/classes"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                print("doctor classes: serving classes not configured on "
+                      "this frontend (set DYN_CLASSES)")
+                return None
+            print(f"doctor classes: fetch {url} failed: {e!r}")
+            return None
+        except Exception as e:
+            print(f"doctor classes: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor classes: cannot read {source}: {e!r}")
+        return None
+
+
+def _ms(v) -> str:
+    try:
+        return f"{float(v) * 1e3:.1f}ms"
+    except (TypeError, ValueError):
+        return "-"
+
+
+def render(payload: dict) -> int:
+    if not payload.get("enabled"):
+        print("doctor classes: serving classes not enabled in this capture")
+        return 1
+    classes = payload.get("classes") or {}
+    default = payload.get("default_class")
+    counters = payload.get("counters") or {}
+    print(f"classes: {len(classes)} defined"
+          + (f", default={default}" if default else ""))
+    for name, c in sorted(classes.items()):
+        objs = []
+        if c.get("ttft_objective_s"):
+            objs.append(f"ttft<={_ms(c['ttft_objective_s'])}")
+        if c.get("itl_objective_s"):
+            objs.append(f"itl<={_ms(c['itl_objective_s'])}")
+        if c.get("deadline_s"):
+            objs.append(f"deadline={c['deadline_s']}s")
+        if c.get("shed_stage"):
+            objs.append(f"shed@stage{c['shed_stage']}")
+        if c.get("cap_stage"):
+            objs.append(f"cap@stage{c['cap_stage']}"
+                        f"->{c.get('cap_tokens', 0)}tok")
+        if c.get("downgrade_to"):
+            objs.append(f"downgrade->{c['downgrade_to']}")
+        print(f"  {name}: weight={c.get('weight', 1.0)} "
+              + (" ".join(objs) if objs else "best-effort"))
+        live = [f"admitted={(counters.get('admitted') or {}).get(name, 0)}"]
+        for key in ("shed", "downgraded", "deadline_rejected"):
+            v = (counters.get(key) or {}).get(name, 0)
+            if v:
+                live.append(f"{key}={v}")
+        print("    " + " ".join(live))
+    adm = payload.get("admission") or {}
+    if adm:
+        print(f"admission: est_ttft={_ms(adm.get('est_ttft_s'))} "
+              f"(q{adm.get('quantile', '?')} across engines) — requests "
+              "whose deadline budget is below this are rejected/downgraded")
+    bo = payload.get("brownout")
+    if bo:
+        hot = bo.get("hot_objectives") or []
+        print(f"brownout: stage={bo.get('stage', 0)} "
+              f"({bo.get('stage_name', '?')}) "
+              f"transitions={bo.get('transitions', 0)} "
+              f"hold={bo.get('hold_s', '?')}s "
+              f"recover={bo.get('recover_s', '?')}s"
+              + (f" hot={','.join(sorted(hot))}" if hot else ""))
+    rej = counters.get("rejections") or []
+    if rej:
+        print("rejections:")
+        for row in rej:
+            print(f"  {row.get('reason', '?')}"
+                  f"[{row.get('class', 'unknown')}]: "
+                  f"{row.get('count', 0)}")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m dynamo_tpu.doctor classes "
+              "<frontend-url | classes.json>")
+        return 1
+    payload = load_classes(argv[0])
+    if payload is None:
+        return 1
+    return render(payload)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

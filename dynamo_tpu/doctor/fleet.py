@@ -151,6 +151,33 @@ def _fmt_tenants(ts: Optional[dict]) -> list[str]:
     return lines
 
 
+def _fmt_classes(cs: Optional[dict]) -> list[str]:
+    """Per-class admission lines (present only on fleets that armed
+    DYN_CLASSES — classless fleets print nothing here)."""
+    if not cs:
+        return []
+    lines = []
+    for name, c in sorted(cs.items()):
+        parts = [f"admitted={c.get('admitted', 0)}"]
+        for key in ("shed", "downgraded", "deadline_rejected"):
+            if c.get(key):
+                parts.append(f"{key}={c[key]}")
+        lines.append(f"    class {name}: " + " ".join(parts))
+    return lines
+
+
+def _fmt_rejections(rj: Optional[dict]) -> list[str]:
+    """HTTP 429/503 rejection counts by reason and class — the shed
+    load /fleet/status would otherwise silently hide."""
+    if not rj:
+        return []
+    lines = []
+    for reason, by_cls in sorted(rj.items()):
+        parts = [f"{cls}={n}" for cls, n in sorted(by_cls.items())]
+        lines.append(f"    rejected[{reason}]: " + " ".join(parts))
+    return lines
+
+
 def render(status: dict) -> int:
     components = status.get("components") or []
     print(f"fleet: {len(components)} component(s) reporting")
@@ -165,6 +192,10 @@ def render(status: dict) -> int:
               f"{_fmt_memory(c.get('memory'))}")
         for line in _fmt_tenants(c.get("tenants")):
             print(line)
+        for line in _fmt_classes(c.get("classes")):
+            print(line)
+        for line in _fmt_rejections(c.get("rejections")):
+            print(line)
     fleet = status.get("fleet") or {}
     print(f"  [merged  ] {_fmt_latency(fleet.get('latency') or {})}"
           f"{_fmt_goodput(fleet.get('goodput'))}"
@@ -173,6 +204,18 @@ def render(status: dict) -> int:
           f"{_fmt_memory(fleet.get('memory'))}")
     for line in _fmt_tenants(fleet.get("tenants")):
         print(line)
+    for line in _fmt_classes(fleet.get("classes")):
+        print(line)
+    for line in _fmt_rejections(fleet.get("rejections")):
+        print(line)
+    brownout = status.get("brownout")
+    if brownout:
+        hot = brownout.get("hot_objectives") or []
+        print(f"brownout: stage={brownout.get('stage', 0)} "
+              f"({brownout.get('stage_name', '?')}) "
+              f"transitions={brownout.get('transitions', 0)}"
+              + (f" hot={','.join(sorted(hot))}" if hot else "")
+              + " — `doctor classes <url>` for the class ladder")
     slo = status.get("slo")
     if slo:
         print("slo:")

@@ -48,6 +48,7 @@ from dynamo_tpu.models.llama import (
     ragged_prefill_decode,
 )
 from dynamo_tpu.protocols import (
+    DEADLINE_ADMIT_ERR,
     FINISH_CANCELLED,
     FINISH_ERROR,
     FINISH_LENGTH,
@@ -340,6 +341,10 @@ class _Seq:
     # is armed, else None — the fair scheduler and per-tenant metrics key
     # off it; untenanted engines never read it
     tenant: Optional[str] = None
+    # serving class (dynamo_tpu/serving_classes): resolved class name
+    # when DYN_CLASSES is armed, else None — class-weighted fair-share
+    # accounting keys off it; classless engines never read it
+    cls: Optional[str] = None
 
     @property
     def pos(self) -> int:
@@ -712,6 +717,16 @@ class TpuEngine:
             from dynamo_tpu.tenancy import FairScheduler, TenantMetrics
             self.fair = FairScheduler(self.tenancy)
             self.tenant_metrics = TenantMetrics()
+        # Serving-class plane (dynamo_tpu/serving_classes): None unless
+        # DYN_CLASSES. Class-weighted fair-share rides the same
+        # FairScheduler; spec_shrink is the brownout stage-3 actuator —
+        # when set, decode bursts fall back to the non-spec compiled
+        # variant (no new XLA shapes), freeing draft compute for TTFT.
+        from dynamo_tpu.serving_classes import classes_from_env
+        self.classes = classes_from_env()
+        self.spec_shrink = False
+        if self.classes is not None and self.fair is not None:
+            self.fair.classes = self.classes
         if self.memory_ledger is not None:
             from dynamo_tpu.models.loader import params_footprint
 
@@ -880,6 +895,11 @@ class TpuEngine:
                 tenant = self.tenancy.tenant_of(
                     getattr(context, "headers", None))
                 attrs["tenant"] = tenant
+            cls = None
+            if self.classes is not None:
+                cls = self.classes.class_of(
+                    getattr(context, "headers", None))
+                attrs["class"] = cls
             trace = RequestTrace.begin(
                 "engine.request", getattr(context, "headers", None),
                 attrs)
@@ -898,6 +918,7 @@ class TpuEngine:
                 t_enqueue_ns=time.time_ns(),
                 trace=trace,
                 tenant=tenant,
+                cls=cls,
             )
             if trace is not None:
                 trace.event("enqueued", waiting=len(self._waiting),
@@ -1165,6 +1186,21 @@ class TpuEngine:
                 self._waiting.pop(idx)
                 self._finish(cand, FINISH_CANCELLED)
                 return True
+            # A request whose deadline already passed while queued must
+            # not burn prefill: drop it here with a distinct in-band
+            # error. FINISH_ERROR arrives over a healthy stream — no
+            # ConnectionError — so the frontend breaker/replay machinery
+            # is naturally skipped (the request failed, the worker
+            # didn't).
+            deadline = cand.ctx.deadline
+            if deadline is not None \
+                    and asyncio.get_running_loop().time() >= deadline:
+                self._waiting.pop(idx)
+                cand.queue.put_nowait(EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": DEADLINE_ADMIT_ERR}).to_dict())
+                self._finish(cand, FINISH_ERROR, emit=False)
+                return True
             hashes = cand.prompt_hashes
             need_pages = (len(cand.prompt) + self.model_cfg.page_size - 1) \
                 // self.model_cfg.page_size
@@ -1216,7 +1252,8 @@ class TpuEngine:
             self.metrics.queue_wait.observe(wait_s)
             if self.fair is not None:
                 self.fair.on_admit(
-                    cand.tenant, len(cand.prompt) + cand.max_tokens)
+                    cand.tenant, len(cand.prompt) + cand.max_tokens,
+                    cls=cand.cls)
                 tm = self.tenant_metrics
                 if tm is not None and cand.tenant is not None:
                     tm.observe_queue_wait(cand.tenant, wait_s)
@@ -1765,7 +1802,10 @@ class TpuEngine:
         # distribution — engine/spec.py), so a draft engine always
         # speculates; only non-spec engines route constrained lanes to
         # the constrained burst.
-        use_spec = self.draft_params is not None
+        # spec_shrink is the brownout stage-3 actuator: fall back to the
+        # already-compiled non-spec burst (no new XLA shapes), freeing
+        # draft-model compute and HBM bandwidth for interactive TTFT.
+        use_spec = self.draft_params is not None and not self.spec_shrink
         k_steps = (cfg.spec_iters_per_sync * (cfg.spec_gamma + 1)
                    if use_spec else cfg.decode_steps_per_sync)
         self._prep_decode_lanes(runnable, k_steps)

@@ -135,16 +135,75 @@ async def start_frontend(runtime: DistributedRuntime,
     cfg = runtime.config
     collector = TelemetryCollector(runtime.events)
     await collector.start()
+    # /debug/profile reads whatever engines serve_engine registered on
+    # this runtime (late-bound: workers may start after the frontend)
+    engines_supplier = \
+        lambda: list(getattr(runtime, "profile_engines", []))
+    http.profile_engines = engines_supplier
+    # Serving classes (docs/robustness.md "Serving classes & brownout"):
+    # DYN_CLASSES was parsed by HttpService.__init__; here the frontend
+    # gets the deadline-admission estimator over the live engine
+    # histograms and — when the config arms it — the brownout machine,
+    # fed by the SLO loop below and ticked for walk-back either by the
+    # control plane (when attached there) or by the SLO loop itself.
+    brownout = None
+    classes_cfg = http.classes
+    if classes_cfg is not None:
+        from dynamo_tpu.serving_classes import (
+            AdmissionEstimator,
+            BrownoutMachine,
+        )
+
+        http.admission = AdmissionEstimator(
+            engines_supplier, classes_cfg.admission_quantile)
+        if classes_cfg.brownout:
+            brownout = BrownoutMachine(
+                classes_cfg, engines=engines_supplier,
+                bus=runtime.events, metrics=http.class_metrics)
+            http.brownout = brownout
+    # Flight control (docs/flight_control.md): DYN_CONTROL unset ⇒ None —
+    # no plane, no controllers, /debug/control 503s, behavior untouched.
+    # Armed, the plane observes whatever this process can reach: in-proc
+    # engines (the same late-bound list /debug/profile uses), the
+    # kv-mode routers, and the brownout machine. The planner-side
+    # forecast controller is attached by whoever owns the Planner
+    # (tests / run scripts) via
+    # control_plane_from_env(planner=..., scale_events=...).
+    from dynamo_tpu.control.plane import control_plane_from_env
+
+    control = control_plane_from_env(
+        runtime,
+        engines=engines_supplier,
+        routers=lambda: manager.kv_routers(),
+        brownout=brownout)
+    if control is not None:
+        control.start()
+        http.control_plane = control
+    brownout_on_plane = (control is not None and brownout is not None
+                         and brownout in control.controllers)
     slo = None
     slo_task = None
-    if cfg.slo_ttft > 0 or cfg.slo_itl > 0:
-        objectives = []
-        if cfg.slo_ttft > 0:
-            objectives.append(SloObjective(
-                "ttft", cfg.slo_ttft, cfg.slo_target_ratio))
-        if cfg.slo_itl > 0:
-            objectives.append(SloObjective(
-                "itl", cfg.slo_itl, cfg.slo_target_ratio))
+    objectives = []
+    if cfg.slo_ttft > 0:
+        objectives.append(SloObjective(
+            "ttft", cfg.slo_ttft, cfg.slo_target_ratio))
+    if cfg.slo_itl > 0:
+        objectives.append(SloObjective(
+            "itl", cfg.slo_itl, cfg.slo_target_ratio))
+    if classes_cfg is not None:
+        # per-class objectives ("ttft:interactive" etc) fed by the HTTP
+        # path's per-class latency samples — so brownout can fire on ONE
+        # class's burn even while the global windows look healthy
+        for name, c in sorted(classes_cfg.classes.items()):
+            if c.ttft_objective_s > 0:
+                objectives.append(SloObjective(
+                    f"ttft:{name}", c.ttft_objective_s,
+                    cfg.slo_target_ratio))
+            if c.itl_objective_s > 0:
+                objectives.append(SloObjective(
+                    f"itl:{name}", c.itl_objective_s,
+                    cfg.slo_target_ratio))
+    if objectives:
         slo = SloMonitor(objectives,
                          fast_window=cfg.slo_fast_window,
                          slow_window=cfg.slo_slow_window,
@@ -159,32 +218,17 @@ async def start_frontend(runtime: DistributedRuntime,
                 for ev in slo.evaluate():
                     _publish_best_effort(runtime.events,
                                          SLO_EVENTS_SUBJECT, ev)
+                    if brownout is not None:
+                        brownout.on_slo_event(ev)
+                if brownout is not None and not brownout_on_plane:
+                    brownout.tick()
 
         slo_task = _asyncio.get_running_loop().create_task(_slo_loop())
-    # /debug/profile reads whatever engines serve_engine registered on
-    # this runtime (late-bound: workers may start after the frontend)
-    http.profile_engines = \
-        lambda: list(getattr(runtime, "profile_engines", []))
-    # Flight control (docs/flight_control.md): DYN_CONTROL unset ⇒ None —
-    # no plane, no controllers, /debug/control 503s, behavior untouched.
-    # Armed, the plane observes whatever this process can reach: in-proc
-    # engines (the same late-bound list /debug/profile uses) and the
-    # kv-mode routers. The planner-side forecast controller is attached
-    # by whoever owns the Planner (tests / run scripts) via
-    # control_plane_from_env(planner=..., scale_events=...).
-    from dynamo_tpu.control.plane import control_plane_from_env
-
-    control = control_plane_from_env(
-        runtime,
-        engines=lambda: list(getattr(runtime, "profile_engines", [])),
-        routers=lambda: manager.kv_routers())
-    if control is not None:
-        control.start()
-        http.control_plane = control
     http.fleet_status_provider = \
         lambda: collector.fleet_status(
             slo=slo,
-            control=(control.summary if control is not None else None))
+            control=(control.summary if control is not None else None),
+            brownout=(brownout.state if brownout is not None else None))
     publisher = None
     if cfg.telemetry_interval > 0:
         publisher = TelemetryPublisher(

@@ -124,6 +124,7 @@ class HttpService:
             web.get("/debug/memory", self._debug_memory),
             web.get("/debug/control", self._debug_control),
             web.get("/debug/tenants", self._debug_tenants),
+            web.get("/debug/classes", self._debug_classes),
             web.get("/openapi.json", self._openapi),
         ])
         # Tenancy quota plane (dynamo_tpu/tenancy, docs/multitenancy.md):
@@ -141,6 +142,24 @@ class HttpService:
             tm = TenantMetrics()
             tm.register(manager.runtime.metrics, role="frontend")
             self.quota = QuotaGate(self.tenancy, tm)
+        # Serving-class plane (dynamo_tpu/serving_classes,
+        # docs/robustness.md): None unless DYN_CLASSES — brownout-shed,
+        # token-capped, and deadline-infeasible requests are bounced (or
+        # downgraded) HERE, before any engine work, and the resolved
+        # class rides ctx.headers[x-dyn-class] to the workers.
+        # start_frontend wires the brownout machine and the admission
+        # estimator once the engine supplier exists.
+        from dynamo_tpu.serving_classes import classes_from_env
+
+        self.classes = classes_from_env()
+        self.class_metrics = None
+        self.brownout = None               # BrownoutMachine | None
+        self.admission = None              # AdmissionEstimator | None
+        if self.classes is not None:
+            from dynamo_tpu.serving_classes import ClassMetrics
+
+            self.class_metrics = ClassMetrics()
+            self.class_metrics.register(manager.runtime.metrics)
         # request-lifecycle debug view: in-flight dicts keyed by request
         # id plus a bounded ring of finished ones, served verbatim by
         # /debug/requests (per-stage timings, status, trace id)
@@ -185,12 +204,18 @@ class HttpService:
         # controller; None (the default) keeps /debug/control a 503.
         self.control_plane = None          # ControlPlane | None
 
-    def _observe_latency(self, kind: str, seconds: float) -> None:
+    def _observe_latency(self, kind: str, seconds: float,
+                         cls: Optional[str] = None) -> None:
         """One TTFT/ITL sample into both the histogram and (when
-        configured) the SLO monitor's rolling windows."""
+        configured) the SLO monitor's rolling windows. With a class
+        name, the sample also feeds the per-class objective window
+        ("ttft:interactive" etc — the monitor ignores names it has no
+        objective for)."""
         (self._ttft if kind == "ttft" else self._itl).observe(seconds)
         if self.slo is not None:
             self.slo.observe(kind, seconds)
+            if cls:
+                self.slo.observe(f"{kind}:{cls}", seconds)
 
     def _observe_usage(self, usage: Optional[dict]) -> None:
         if not usage:
@@ -240,12 +265,108 @@ class HttpService:
         if ok:
             return tenant.name, None
         self._req_counter.inc(endpoint=endpoint, status="429")
+        if self.class_metrics is not None:
+            # shed load must show in the fleet picture next to served
+            # load — 429s land in rejections{reason="quota", class}
+            from dynamo_tpu.serving_classes.config import CLASS_HEADER
+
+            cls_name = self.classes.resolve(
+                request.headers.get(CLASS_HEADER), tenant).name
+            self.class_metrics.on_rejected("quota", cls_name)
         err = OpenAIError(
             f"tenant {tenant.name!r} over {reason} quota",
             status=429, err_type="rate_limit_exceeded")
         return tenant.name, web.json_response(
             err.body(), status=429,
             headers={"Retry-After": retry_after_header(retry)})
+
+    def _class_gate(self, request: web.Request, body,
+                    endpoint: str, tenant: Optional[str]):
+        """Resolve the serving class and apply brownout shed / token
+        cap / deadline-feasibility BEFORE any engine work
+        (docs/robustness.md "Serving classes & brownout"). Returns
+        (cls_name, downgraded_from, reject_response); (None, "", None)
+        when classes are unarmed. May mutate body["max_tokens"] (the
+        stage-2 cap on new streams)."""
+        if self.classes is None:
+            return None, "", None
+        from dynamo_tpu.runtime.transport import DEADLINE_HEADER
+        from dynamo_tpu.serving_classes.config import CLASS_HEADER
+        from dynamo_tpu.tenancy import retry_after_header
+
+        tenant_rec = (self.tenancy.get(tenant)
+                      if self.tenancy is not None and tenant else None)
+        cls = self.classes.resolve(
+            request.headers.get(CLASS_HEADER), tenant_rec)
+
+        def _shed(c):
+            self._req_counter.inc(endpoint=endpoint, status="503")
+            if self.class_metrics is not None:
+                self.class_metrics.on_shed(c.name, reason="brownout")
+            err = OpenAIError(
+                f"class {c.name!r} shed: fleet in brownout stage "
+                f"{self.brownout.state()['stage_name']!r}",
+                status=503, err_type="overloaded")
+            return c.name, "", web.json_response(
+                err.body(), status=503,
+                headers={"Retry-After":
+                         retry_after_header(self.brownout.recover_s)})
+
+        # brownout shed ladder: stage >= the class's shed_stage bounces
+        # new requests with Retry-After sized to the recovery window
+        if self.brownout is not None and self.brownout.sheds(cls):
+            return _shed(cls)
+        # deadline feasibility: explicit remaining-budget header wins,
+        # else the class's implicit deadline; 0 = no deadline
+        explicit = 0.0
+        hdr = request.headers.get(DEADLINE_HEADER)
+        if hdr:
+            try:
+                explicit = float(hdr)
+            except ValueError:
+                explicit = 0.0
+        budget = explicit if explicit > 0 else cls.deadline_s
+        downgraded_from = ""
+        if budget > 0 and self.admission is not None:
+            feasible, est, retry = self.admission.check(budget)
+            if not feasible:
+                if explicit <= 0 and cls.downgrade_to:
+                    # only the class-implicit deadline is unmeetable:
+                    # demote to the looser class instead of bouncing —
+                    # the client finds out via x-dyn-class-downgraded
+                    downgraded_from = cls.name
+                    if self.class_metrics is not None:
+                        self.class_metrics.on_downgraded(cls.name)
+                    cls = self.classes.get(cls.downgrade_to)
+                    if self.brownout is not None \
+                            and self.brownout.sheds(cls):
+                        return _shed(cls)
+                else:
+                    self._req_counter.inc(endpoint=endpoint,
+                                          status="503")
+                    if self.class_metrics is not None:
+                        self.class_metrics.on_deadline_rejected(cls.name)
+                    err = OpenAIError(
+                        f"deadline unmeetable: estimated TTFT "
+                        f"{est:.3f}s exceeds remaining budget "
+                        f"{budget:.3f}s", status=503,
+                        err_type="deadline_unmeetable")
+                    return cls.name, "", web.json_response(
+                        err.body(), status=503,
+                        headers={"Retry-After":
+                                 retry_after_header(retry)})
+        # stage-2 brownout: cap completion budget on new streams of
+        # cappable classes (running streams are never touched)
+        if self.brownout is not None and isinstance(body, dict):
+            cap = self.brownout.cap_for(cls)
+            if cap > 0:
+                cur = (body.get("max_tokens")
+                       or body.get("max_completion_tokens") or 0)
+                if not cur or cur > cap:
+                    body["max_tokens"] = cap
+        if self.class_metrics is not None:
+            self.class_metrics.on_admitted(cls.name)
+        return cls.name, downgraded_from, None
 
     def _audit_begin(self, request_id: str, endpoint: str, body):
         if self.audit is None:
@@ -508,6 +629,12 @@ class HttpService:
         tenant, reject = self._tenant_gate(request, body, endpoint)
         if reject is not None:
             return reject
+        # class gate after the quota gate: shed/deadline-infeasible
+        # requests cost one histogram read and a 503, nothing downstream
+        cls, downgraded_from, reject = self._class_gate(
+            request, body, endpoint, tenant)
+        if reject is not None:
+            return reject
         stream = bool(body.get("stream"))
         request_id = new_request_id(
             "chatcmpl" if kind == KIND_CHAT else "cmpl")
@@ -516,6 +643,12 @@ class HttpService:
             from dynamo_tpu.tenancy.config import TENANT_HEADER
 
             ctx.headers[TENANT_HEADER] = tenant
+        if cls is not None:
+            from dynamo_tpu.serving_classes.config import CLASS_HEADER
+
+            # post-resolution (and post-downgrade) identity: engines
+            # attribute fair-share accounting by this header
+            ctx.headers[CLASS_HEADER] = cls
         from dynamo_tpu.runtime.tracing import tracer
 
         pipeline_request = {"_kind": kind, "body": body,
@@ -543,6 +676,10 @@ class HttpService:
                "trace_id": span.trace_id if tracer().enabled else None,
                "status": "in_flight", "first_token_s": None,
                "last_token_s": None, "duration_s": None, "usage": None}
+        if cls is not None:
+            rec["class"] = cls
+            if downgraded_from:
+                rec["downgraded_from"] = downgraded_from
         self._dbg_inflight[request_id] = rec
         try:
             chunks = engine.generate(pipeline_request, ctx)
@@ -592,20 +729,28 @@ class HttpService:
         })
         if rec is None:
             rec = {}
+        if rec.get("downgraded_from"):
+            # tell the client its request was demoted (deadline-
+            # infeasible at its original class) and to what
+            resp.headers["x-dyn-class-downgraded"] = \
+                rec["downgraded_from"]
+            resp.headers["x-dyn-class"] = str(rec.get("class", ""))
         first_token_at: Optional[float] = None
         last_token_at: Optional[float] = None
         try:
             async for chunk in chunks:
                 if first_token_at is None and self._has_content(chunk):
                     first_token_at = time.perf_counter()
-                    self._observe_latency("ttft", first_token_at - start)
+                    self._observe_latency("ttft", first_token_at - start,
+                                          cls=rec.get("class"))
                     rec["first_token_s"] = round(first_token_at - start, 6)
                     if self.quota is not None and rec.get("tenant"):
                         self.quota.metrics.observe_ttft(
                             rec["tenant"], first_token_at - start)
                 elif self._has_content(chunk) and last_token_at is not None:
                     self._observe_latency(
-                        "itl", time.perf_counter() - last_token_at)
+                        "itl", time.perf_counter() - last_token_at,
+                        cls=rec.get("class"))
                 if self._has_content(chunk):
                     last_token_at = time.perf_counter()
                     rec["last_token_s"] = round(last_token_at - start, 6)
@@ -705,6 +850,14 @@ class HttpService:
                         "deficits, KV blocks, goodput",
                 "arm": "DYN_TENANCY=<path|inline json>",
                 "armed": self.quota is not None,
+                "available": True,
+            },
+            "/debug/classes": {
+                "what": "serving-class table, admitted/shed/downgraded "
+                        "counters, deadline-admission estimate, "
+                        "brownout stage",
+                "arm": "DYN_CLASSES=1|<path|inline json>",
+                "armed": self.classes is not None,
                 "available": True,
             },
         }
@@ -867,6 +1020,33 @@ class HttpService:
                            if st]
         return web.json_response(body)
 
+    async def _debug_classes(self, request: web.Request) -> web.Response:
+        """Serving-class view (docs/robustness.md "Serving classes &
+        brownout"): the resolved class table and default, live
+        admitted/shed/downgraded/rejection counters, the current
+        deadline-admission TTFT estimate, and the brownout machine's
+        stage + hot objectives. 503 unless DYN_CLASSES armed classes on
+        this process."""
+        if self.classes is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "serving classes not configured "
+                           "(set DYN_CLASSES)"},
+                status=503)
+        body = {"enabled": True,
+                "default_class": self.classes.default_class,
+                "classes": self.classes.payload()}
+        if self.class_metrics is not None:
+            body["counters"] = self.class_metrics.payload()
+        if self.admission is not None:
+            body["admission"] = {
+                "quantile": self.admission.quantile,
+                "est_ttft_s": round(self.admission.estimate_s(), 6),
+            }
+        if self.brownout is not None:
+            body["brownout"] = self.brownout.state()
+        return web.json_response(body)
+
     async def _debug_router(self, request: web.Request) -> web.Response:
         """Router decision flight-recorder view (docs/observability.md
         "Router observability"): per-model decision counters, index
@@ -1002,6 +1182,9 @@ class HttpService:
             "/debug/tenants": ("Per-tenant quotas, live streams, "
                                "fair-share deficits, KV blocks, goodput",
                                False),
+            "/debug/classes": ("Serving-class table, admitted/shed/"
+                               "downgraded counters, deadline-admission "
+                               "estimate, brownout stage", False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

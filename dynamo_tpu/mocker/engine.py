@@ -20,6 +20,7 @@ from dynamo_tpu.engine.metrics import EngineMetrics
 from dynamo_tpu.engine.profiler import recorder_from_env
 from dynamo_tpu.mocker.kv_manager import MockKvManager
 from dynamo_tpu.protocols import (
+    DEADLINE_ADMIT_ERR,
     FINISH_CANCELLED,
     FINISH_ERROR,
     FINISH_LENGTH,
@@ -122,6 +123,8 @@ class _MockRequest:
     # tenancy: resolved tenant name when DYN_TENANCY is armed, else None
     # (same contract as TpuEngine._Seq.tenant)
     tenant: Optional[str] = None
+    # serving class when DYN_CLASSES is armed (TpuEngine._Seq.cls parity)
+    cls: Optional[str] = None
 
     @property
     def max_tokens(self) -> int:
@@ -204,6 +207,15 @@ class MockEngine:
             from dynamo_tpu.tenancy import FairScheduler, TenantMetrics
             self.fair = FairScheduler(self.tenancy)
             self.tenant_metrics = TenantMetrics()
+        # Serving-class plane parity with TpuEngine: class-weighted
+        # fair-share when armed; spec_shrink is carried inertly (the
+        # mock has no draft model) so brownout state/tests see the same
+        # surface on mock fleets.
+        from dynamo_tpu.serving_classes import classes_from_env
+        self.classes = classes_from_env()
+        self.spec_shrink = False
+        if self.classes is not None and self.fair is not None:
+            self.fair.classes = self.classes
         self._oom = False
         self._peak_bytes = 0
         if self.memory_ledger is not None:
@@ -271,6 +283,11 @@ class MockEngine:
             tenant = self.tenancy.tenant_of(
                 getattr(context, "headers", None))
             attrs["tenant"] = tenant
+        cls = None
+        if self.classes is not None:
+            cls = self.classes.class_of(
+                getattr(context, "headers", None))
+            attrs["class"] = cls
         trace = RequestTrace.begin(
             "engine.request", getattr(context, "headers", None), attrs)
         mreq = _MockRequest(
@@ -279,6 +296,7 @@ class MockEngine:
             arrival=self._arrivals,
             trace=trace, t_enqueue_ns=time.time_ns(),
             tenant=tenant,
+            cls=cls,
         )
         self._arrivals += 1
         if trace is not None:
@@ -389,6 +407,21 @@ class MockEngine:
                     token_ids=[], finish_reason=FINISH_CANCELLED).to_dict())
                 cand.queue.put_nowait(None)
                 return True
+            # deadline already blown while queued: drop before prefill
+            # with the distinct in-band error (TpuEngine._admit_one
+            # parity) — no ConnectionError, so breaker/replay never fire
+            deadline = cand.ctx.deadline
+            if deadline is not None \
+                    and asyncio.get_running_loop().time() >= deadline:
+                self._waiting.pop(idx)
+                if cand.trace is not None:
+                    cand.trace.end(status="ERROR",
+                                   finish_reason=FINISH_ERROR)
+                cand.queue.put_nowait(EngineOutput(
+                    token_ids=[], finish_reason=FINISH_ERROR,
+                    extra={"error": DEADLINE_ADMIT_ERR}).to_dict())
+                cand.queue.put_nowait(None)
+                return True
             new_active = self.kv.blocks_to_activate(cand.seq)
             if self.fair is not None:
                 budget = self.tenancy.get(cand.tenant).kv_block_budget
@@ -418,7 +451,8 @@ class MockEngine:
             if self.fair is not None:
                 self.fair.on_admit(
                     cand.tenant,
-                    len(cand.req.token_ids) + cand.max_tokens)
+                    len(cand.req.token_ids) + cand.max_tokens,
+                    cls=cand.cls)
                 tm = self.tenant_metrics
                 if tm is not None and cand.tenant is not None:
                     # cand is already in _running, so this counts it

@@ -164,6 +164,14 @@ FINISH_EOS = "eos"
 FINISH_CANCELLED = "cancelled"
 FINISH_ERROR = "error"
 
+# Emitted (in-band, as a FINISH_ERROR output) when a request's
+# Context.deadline has already passed at admission time — the engine
+# drops it before prefill instead of burning compute on an answer the
+# client has stopped waiting for. In-band delivery means no transport
+# ConnectionError, so the frontend's breaker/replay machinery is
+# naturally skipped: the request FAILED, it did not "disconnect".
+DEADLINE_ADMIT_ERR = "request deadline exceeded before admission"
+
 
 @dataclass
 class SamplingOptions:

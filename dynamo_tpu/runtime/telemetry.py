@@ -354,6 +354,52 @@ def tenant_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     return out or None
 
 
+def class_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """Per-serving-class admission view from the `dynamo_class_*`
+    series (dynamo_tpu/serving_classes, docs/robustness.md). None when
+    the component never armed `DYN_CLASSES` — classless fleets see no
+    new block."""
+    admitted = _counter_by_label(
+        snapshot, "dynamo_class_admitted_total", "class")
+    shed = _counter_by_label(snapshot, "dynamo_class_shed_total", "class")
+    downgraded = _counter_by_label(
+        snapshot, "dynamo_class_downgraded_total", "class")
+    deadline = _counter_by_label(
+        snapshot, "dynamo_class_deadline_rejected_total", "class")
+    if not admitted and not shed and not downgraded and not deadline:
+        return None
+    names = (set(admitted) | set(shed) | set(downgraded)
+             | set(deadline)) - {""}
+    out: dict[str, Any] = {}
+    for name in sorted(names):
+        c: dict[str, Any] = {"admitted": int(admitted.get(name, 0))}
+        if shed.get(name):
+            c["shed"] = int(shed[name])
+        if downgraded.get(name):
+            c["downgraded"] = int(downgraded[name])
+        if deadline.get(name):
+            c["deadline_rejected"] = int(deadline[name])
+        out[name] = c
+    return out or None
+
+
+def rejection_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """429/503 rejections by {reason, class} from the frontend gates —
+    shed load shown next to served load instead of an unexplained
+    goodput dip. None when nothing was rejected."""
+    m = snapshot.get("dynamo_http_rejections_total")
+    if not m or m.get("type") != "counter":
+        return None
+    out: dict[str, Any] = {}
+    for lbl, v in m.get("values", []):
+        d = dict(lbl)
+        reason = d.get("reason", "?")
+        by_cls = out.setdefault(reason, {})
+        key = d.get("class", "") or "unknown"
+        by_cls[key] = int(by_cls.get(key, 0) + v)
+    return out or None
+
+
 def _publish_best_effort(bus, subject: str, payload: dict) -> None:
     """Never block, never raise: local buses take publish_nowait; remote
     buses get a fire-and-forget task (same contract as breaker events)."""
@@ -470,11 +516,14 @@ class TelemetryCollector:
         return merge_snapshots([p.get("metrics") or {}
                                 for p in self.live().values()])
 
-    def fleet_status(self, slo=None, control=None) -> dict[str, Any]:
+    def fleet_status(self, slo=None, control=None,
+                     brownout=None) -> dict[str, Any]:
         """`control` is the local ControlPlane's summary — a dict or a
         zero-arg callable returning one (or None) — surfaced verbatim as
         the `control` block so /fleet/status and doctor fleet show which
-        controllers are armed and what they last did."""
+        controllers are armed and what they last did. `brownout` is the
+        local BrownoutMachine's state (dict or zero-arg callable),
+        surfaced the same way."""
         now = time.time()
         components = []
         fleet_tok_s = 0.0
@@ -505,6 +554,12 @@ class TelemetryCollector:
             ts = tenant_summary(metrics)
             if ts is not None:
                 entry["tenants"] = ts
+            cs = class_summary(metrics)
+            if cs is not None:
+                entry["classes"] = cs
+            rj = rejection_summary(metrics)
+            if rj is not None:
+                entry["rejections"] = rj
             components.append(entry)
         merged = self.merged()
         out: dict[str, Any] = {
@@ -530,12 +585,22 @@ class TelemetryCollector:
         fleet_ten = tenant_summary(merged)
         if fleet_ten is not None:
             out["fleet"]["tenants"] = fleet_ten
+        fleet_cls = class_summary(merged)
+        if fleet_cls is not None:
+            out["fleet"]["classes"] = fleet_cls
+        fleet_rej = rejection_summary(merged)
+        if fleet_rej is not None:
+            out["fleet"]["rejections"] = fleet_rej
         if slo is not None:
             out["slo"] = slo.status()
         if control is not None:
             c = control() if callable(control) else control
             if c is not None:
                 out["control"] = c
+        if brownout is not None:
+            b = brownout() if callable(brownout) else brownout
+            if b is not None:
+                out["brownout"] = b
         return out
 
     async def stop(self) -> None:

@@ -41,6 +41,9 @@ class Tenant:
     token_burst: float = 0.0         # bucket capacity; 0 = max(rate, 1)
     kv_block_budget: int = 0         # max KV pages/blocks held; 0 = unlimited
     api_keys: tuple = ()             # bearer keys that map to this tenant
+    # serving class applied to this tenant's requests when no
+    # x-dyn-class header is present; "" = the classes config default
+    default_class: str = ""
 
     def __post_init__(self) -> None:
         if not self.name:
@@ -122,6 +125,7 @@ class TenancyConfig:
             "token_burst": t.burst if t.token_rate else 0.0,
             "kv_block_budget": t.kv_block_budget,
             "api_keys": len(t.api_keys),
+            "default_class": t.default_class,
         } for name, t in sorted(self.tenants.items())}
 
 
@@ -151,6 +155,7 @@ def parse_tenancy(obj: dict) -> TenancyConfig:
             token_burst=float(entry.get("token_burst", 0.0)),
             kv_block_budget=int(entry.get("kv_block_budget", 0)),
             api_keys=tuple(entry.get("api_keys", ())),
+            default_class=str(entry.get("default_class", "")),
         )
         if t.name in tenants:
             raise ValueError(f"duplicate tenant {t.name!r}")

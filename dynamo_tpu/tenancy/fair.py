@@ -36,6 +36,12 @@ class FairScheduler:
         # tenant -> cumulative service / weight (virtual time)
         self.service: dict[str, float] = {}
         self._backlogged: set[str] = set()
+        # optional ServingClassesConfig: when set, admissions carrying a
+        # class name divide their cost by the class weight too, so an
+        # interactive request at weight 4 charges a quarter of the
+        # virtual time a batch request of the same size does. None (the
+        # default) keeps the legacy accounting byte-identical.
+        self.classes = None
 
     def weight_of(self, tenant: Optional[str]) -> float:
         return self.cfg.get(tenant).weight
@@ -63,10 +69,14 @@ class FairScheduler:
         order = sorted(heads, key=lambda t: (self.service.get(t, 0.0), t))
         return [heads[t] for t in order]
 
-    def on_admit(self, tenant: Optional[str], cost: float) -> None:
+    def on_admit(self, tenant: Optional[str], cost: float,
+                 cls: Optional[str] = None) -> None:
         name = tenant or ANON_TENANT
+        weight = self.weight_of(name)
+        if cls is not None and self.classes is not None:
+            weight *= self.classes.get(cls).weight
         self.service[name] = (self.service.get(name, 0.0)
-                              + max(cost, 1.0) / self.weight_of(name))
+                              + max(cost, 1.0) / weight)
 
     def payload(self) -> dict:
         """Normalized-service view for /debug/tenants: the deficit of a

@@ -41,6 +41,10 @@ class RequestResult:
     text: str = ""           # concatenated deltas (token-identity gate)
     finish_reason: str = ""
     tenant: str = ""         # tenant this request rode in as ("" = none)
+    cls: str = ""            # serving class it rode in as ("" = none)
+    deadline_missed: bool = False  # 503'd as deadline_unmeetable
+    shed: bool = False       # 503'd by brownout/overload shedding
+    downgraded: bool = False  # served, but in a lower class than asked
 
     @property
     def completed(self) -> bool:
@@ -52,7 +56,7 @@ async def _replay_one(session, url: str, model: str,
                       t0: float) -> RequestResult:
     res = RequestResult(index=req.index, status="error:unsent",
                         sent_at=round(time.monotonic() - t0, 6),
-                        tenant=req.tenant)
+                        tenant=req.tenant, cls=req.cls)
     body = {
         "model": model,
         "stream": True,
@@ -61,8 +65,14 @@ async def _replay_one(session, url: str, model: str,
                       "content": prompt_text(req, cfg)}],
     }
     # tenanted schedules ride the identity header the quota gate and
-    # fair scheduler key on (tenancy/config.py TENANT_HEADER)
-    headers = {"x-dyn-tenant": req.tenant} if req.tenant else None
+    # fair scheduler key on (tenancy/config.py TENANT_HEADER); classed
+    # schedules ride the serving-class header the admission gate keys on
+    headers = {}
+    if req.tenant:
+        headers["x-dyn-tenant"] = req.tenant
+    if req.cls:
+        headers["x-dyn-class"] = req.cls
+    headers = headers or None
     start = time.monotonic()
     last_token_at = None
     itls: list[float] = []
@@ -73,7 +83,18 @@ async def _replay_one(session, url: str, model: str,
             if resp.status != 200:
                 detail = (await resp.text())[:200]
                 res.status = f"error:http_{resp.status}:{detail}"
+                if resp.status == 503:
+                    # discriminate brownout shedding from deadline
+                    # infeasibility via the err_type in the 503 body
+                    # (http_service._class_gate)
+                    if "deadline_unmeetable" in detail:
+                        res.deadline_missed = True
+                    else:
+                        res.shed = True
                 return res
+            if resp.headers.get("x-dyn-class-downgraded"):
+                res.downgraded = True
+                res.cls = resp.headers.get("x-dyn-class", res.cls)
             async for raw in resp.content:
                 line = raw.strip()
                 if not line.startswith(b"data:"):
@@ -176,6 +197,9 @@ def summarize_results(results: list[RequestResult]) -> dict:
         "abandoned": len(abandoned),
         "errors": len(errors),
         "error_samples": [r.status for r in errors[:5]],
+        "shed": sum(1 for r in done if r.shed),
+        "deadline_missed": sum(1 for r in done if r.deadline_missed),
+        "downgraded": sum(1 for r in done if r.downgraded),
         "tokens": sum(r.tokens for r in done),
         "ttft_p50_s": round(_percentile(ttfts, 0.50), 6),
         "ttft_p99_s": round(_percentile(ttfts, 0.99), 6),
@@ -192,5 +216,17 @@ def summarize_by_tenant(results: list[RequestResult]) -> dict:
     for r in results:
         if r is not None and r.tenant:
             by.setdefault(r.tenant, []).append(r)
+    return {name: summarize_results(rs)
+            for name, rs in sorted(by.items())}
+
+
+def summarize_by_class(results: list[RequestResult]) -> dict:
+    """`summarize_results` split by serving class — {} when the replay
+    carried no class headers. The overload smoke compares these: batch
+    should shed while interactive holds its TTFT objective."""
+    by: dict[str, list[RequestResult]] = {}
+    for r in results:
+        if r is not None and r.cls:
+            by.setdefault(r.cls, []).append(r)
     return {name: summarize_results(rs)
             for name, rs in sorted(by.items())}

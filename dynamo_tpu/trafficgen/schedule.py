@@ -67,6 +67,13 @@ class TrafficConfig:
     # interactive one. Empty (the default) draws nothing extra from the
     # RNG and serializes byte-identically to pre-tenancy schedules.
     tenants: list = field(default_factory=list)
+    # serving-class mixes (docs/robustness.md): each entry is a dict
+    # {"name": ..., "share": relative arrival weight, and optional
+    # isl/osl override keys like tenants} — the replayer injects the
+    # name as the x-dyn-class header. Empty (the default) draws nothing
+    # extra from the RNG and serializes byte-identically to classless
+    # schedules (md5-pinned by tests/test_serving_classes.py).
+    classes: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.pattern not in PATTERNS:
@@ -76,6 +83,10 @@ class TrafficConfig:
             if not isinstance(t, dict) or not t.get("name"):
                 raise ValueError(
                     f"tenant spec needs a 'name': {t!r}")
+        for c in self.classes:
+            if not isinstance(c, dict) or not c.get("name"):
+                raise ValueError(
+                    f"class spec needs a 'name': {c!r}")
 
 
 @dataclass
@@ -87,6 +98,7 @@ class ScheduledRequest:
     prefix_id: int = -1  # shared system-prompt id; -1 = none
     abandon_after: int = 0  # cancel after this many tokens; 0 = read all
     tenant: str = ""     # x-dyn-tenant header value; "" = untenanted
+    cls: str = ""        # x-dyn-class header value; "" = classless
 
     @property
     def prompt_tokens(self) -> int:
@@ -179,6 +191,19 @@ def build_schedule(cfg: TrafficConfig) -> list[ScheduledRequest]:
             osl_p = (spec.get("osl_mean", cfg.osl_mean),
                      spec.get("osl_sigma", cfg.osl_sigma),
                      spec.get("osl_max", cfg.osl_max))
+        # class draw rides directly after the tenant draw: a classless
+        # config consumes the RNG in exactly the legacy order, so the
+        # pre-classes md5 pin survives (tests/test_serving_classes.py)
+        cls = ""
+        if cfg.classes:
+            cspec = _pick_tenant(cfg.classes, rng)
+            cls = str(cspec["name"])
+            isl_p = (cspec.get("isl_mean", isl_p[0]),
+                     cspec.get("isl_sigma", isl_p[1]),
+                     cspec.get("isl_max", isl_p[2]))
+            osl_p = (cspec.get("osl_mean", osl_p[0]),
+                     cspec.get("osl_sigma", osl_p[1]),
+                     cspec.get("osl_max", osl_p[2]))
         isl = _lognormal_int(rng, isl_p[0], isl_p[1], isl_p[2])
         osl = _lognormal_int(rng, osl_p[0], osl_p[1], osl_p[2])
         prefix_id = -1
@@ -190,7 +215,7 @@ def build_schedule(cfg: TrafficConfig) -> list[ScheduledRequest]:
         reqs.append(ScheduledRequest(
             index=i, at=round(t, 6), isl=isl, osl=osl,
             prefix_id=prefix_id, abandon_after=abandon_after,
-            tenant=tenant))
+            tenant=tenant, cls=cls))
     return reqs
 
 
@@ -234,12 +259,17 @@ def schedule_to_jsonl(cfg: TrafficConfig,
         # untenanted schedules keep the pre-tenancy byte layout — the
         # md5 pin in tests/test_tenancy.py holds across this feature
         cfg_d.pop("tenants", None)
+    if not cfg_d.get("classes"):
+        # ditto for classless schedules (tests/test_serving_classes.py)
+        cfg_d.pop("classes", None)
     lines = [json.dumps({"version": SCHEDULE_VERSION,
                          "config": cfg_d}, sort_keys=True)]
     for r in reqs:
         d = asdict(r)
         if not d.get("tenant"):
             d.pop("tenant", None)
+        if not d.get("cls"):
+            d.pop("cls", None)
         lines.append(json.dumps(d, sort_keys=True))
     return "\n".join(lines) + "\n"
 
@@ -286,4 +316,18 @@ def summarize_tenants(reqs: list[ScheduledRequest]) -> dict:
         t["requests"] += 1
         t["isl_tokens"] += r.isl
         t["osl_tokens"] += r.osl
+    return out
+
+
+def summarize_classes(reqs: list[ScheduledRequest]) -> dict:
+    """Per-class request/token counts — {} for classless schedules."""
+    out: dict[str, dict] = {}
+    for r in reqs:
+        if not r.cls:
+            continue
+        c = out.setdefault(r.cls, {"requests": 0, "isl_tokens": 0,
+                                   "osl_tokens": 0})
+        c["requests"] += 1
+        c["isl_tokens"] += r.isl
+        c["osl_tokens"] += r.osl
     return out

@@ -607,11 +607,15 @@ async def test_control_loop_smoke(monkeypatch, tmp_path):
         for r in results:
             if r.status != STATUS_ABANDONED:
                 assert r.status == STATUS_OK, (r.index, r.status)
-        # 3. every controller acted at least once
+        # 3. every attached controller acted at least once (brownout is
+        # enabled by DYN_CONTROL but unattached without DYN_CLASSES, and
+        # its whole point is to idle while the fleet is healthy)
         counts = plane.action_counts()
-        if not all(counts[name] >= 1 for name in CONTROLLERS):
+        attached = {c.name for c in plane.controllers}
+        assert attached >= {"bucket", "kvbm", "router", "forecast"}
+        if not all(counts[name] >= 1 for name in attached):
             print("CTLSTATE", json.dumps(plane.summary(), default=str))
-        assert all(counts[name] >= 1 for name in CONTROLLERS), counts
+        assert all(counts[name] >= 1 for name in attached), counts
         # 4. every action is explainable: before/after + evidence, and
         # the counter matches the ring
         events = plane.events()

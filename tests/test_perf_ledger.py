@@ -280,7 +280,7 @@ async def test_debug_index_endpoint(monkeypatch):
             assert set(surfaces) == {"/debug/requests", "/debug/profile",
                                      "/debug/router", "/debug/kv",
                                      "/debug/control", "/debug/memory",
-                                     "/debug/tenants"}
+                                     "/debug/tenants", "/debug/classes"}
             # always-on ring vs env-armed recorders, with the knob named
             assert surfaces["/debug/requests"]["armed"] is True
             assert surfaces["/debug/requests"]["arm"] is None

@@ -1,0 +1,860 @@
+"""Serving classes & brownout (`make overload-smoke`, docs/robustness.md).
+
+Covers the whole serving-class plane: class table parsing and identity
+resolution precedence, the deadline-admission decision boundary against
+hand-built histograms, the brownout ladder under a fake clock (each
+stage escalated in order and walked back with hysteresis), class-
+weighted fair share, the expired-deadline drop at engine admission, the
+byte-identical unarmed pins (schedule artifact md5, clean /metrics,
+no class gate on the HTTP path), the observability surfaces
+(/debug/classes, doctor classes, fleet status blocks), a chaos soak
+with client abandons, and the overload gauntlet: a bursty mix beyond
+fleet capacity where batch sheds before any interactive 503 and every
+admitted stream completes.
+"""
+
+import asyncio
+import contextlib
+import hashlib
+import json
+import os
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig, _MockRequest
+from dynamo_tpu.protocols import DEADLINE_ADMIT_ERR, PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.serving_classes import (
+    BROWNOUT_STAGES,
+    CLASS_HEADER,
+    AdmissionEstimator,
+    BrownoutMachine,
+    ClassMetrics,
+    ServingClassesConfig,
+    classes_from_env,
+    default_classes,
+    estimate_ttft_s,
+    parse_classes,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+pytestmark = pytest.mark.tier0
+
+# legacy schedule artifact: computed on main BEFORE tenancy/classes —
+# a classless TrafficConfig must keep serializing to these exact bytes
+LEGACY_SCHEDULE_MD5 = "5ce3e0a36fa00b9b3f91b6cb44cb233f"
+
+
+@contextlib.contextmanager
+def classes_env(value="1"):
+    old = os.environ.get("DYN_CLASSES")
+    os.environ["DYN_CLASSES"] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("DYN_CLASSES", None)
+        else:
+            os.environ["DYN_CLASSES"] = old
+
+
+# -- class table & identity resolution --------------------------------------
+
+
+def test_default_classes_and_resolution_precedence():
+    cfg = ServingClassesConfig()
+    assert set(cfg.classes) == {"interactive", "standard", "batch"}
+    assert cfg.default_class == "standard"
+    # header wins
+    assert cfg.resolve("interactive", None).name == "interactive"
+    # tenant default next
+
+    class _T:
+        default_class = "batch"
+
+    assert cfg.resolve(None, _T()).name == "batch"
+    assert cfg.resolve("interactive", _T()).name == "interactive"
+    # config default last; unknown names resolve to the default class
+    assert cfg.resolve(None, None).name == "standard"
+    assert cfg.resolve("made-up", None).name == "standard"
+    assert cfg.get("nope").name == "standard"
+    # engine-side identity from propagated headers
+    assert cfg.class_of({CLASS_HEADER: "batch"}) == "batch"
+    assert cfg.class_of({CLASS_HEADER: "made-up"}) == "standard"
+    assert cfg.class_of(None) == "standard"
+    # the preset shed ladder: batch sheds first, standard caps at 2,
+    # interactive is never shed
+    assert cfg.get("batch").shed_stage == 1
+    assert cfg.get("standard").cap_stage == 2
+    assert cfg.get("standard").downgrade_to == "batch"
+    assert cfg.get("interactive").shed_stage == 0
+
+
+def test_parse_classes_validation():
+    # empty classes list keeps the preset (one-knob tuning)
+    cfg = parse_classes({"brownout": False})
+    assert set(cfg.classes) == {"interactive", "standard", "batch"}
+    assert cfg.brownout is False
+    cfg = parse_classes({"classes": [
+        {"name": "rt", "weight": 8, "ttft_objective_s": 0.2,
+         "deadline_s": 1.0},
+        {"name": "bulk", "shed_stage": 1}],
+        "default_class": "bulk", "brownout_hold_s": 2})
+    assert cfg.get("rt").deadline_s == 1.0
+    assert cfg.default_class == "bulk"
+    assert cfg.brownout_hold_s == 2.0
+    with pytest.raises(ValueError):
+        parse_classes({"classes": [{"weight": 2}]})       # no name
+    with pytest.raises(ValueError):
+        parse_classes({"classes": [{"name": "a"}, {"name": "a"}]})
+    with pytest.raises(ValueError):
+        parse_classes({"classes": [{"name": "a", "weight": 0}]})
+    with pytest.raises(ValueError):                        # unknown default
+        parse_classes({"classes": [{"name": "a"}],
+                       "default_class": "z"})
+    with pytest.raises(ValueError):                        # bad downgrade
+        parse_classes({"classes": [{"name": "a",
+                                    "downgrade_to": "ghost"}]})
+
+
+def test_classes_env_off_by_default(tmp_path):
+    assert classes_from_env({}) is None
+    assert classes_from_env({"DYN_CLASSES": ""}) is None
+    assert classes_from_env({"DYN_CLASSES": "1"}).get("batch").shed_stage \
+        == 1
+    doc = {"classes": [{"name": "only"}], "default_class": "only"}
+    inline = classes_from_env({"DYN_CLASSES": json.dumps(doc)})
+    assert set(inline.classes) == {"only"}
+    p = tmp_path / "classes.json"
+    p.write_text(json.dumps(doc))
+    assert set(classes_from_env(
+        {"DYN_CLASSES": str(p)}).classes) == {"only"}
+
+
+# -- deadline-aware admission (hand-traced) ---------------------------------
+
+
+class _Hist:
+    """Synthetic histogram: a fixed quantile answer + sample count."""
+
+    def __init__(self, q_value, count=10):
+        self._q = q_value
+        self.count = count
+
+    def quantile(self, q):
+        return self._q
+
+
+class _Eng:
+    def __init__(self, ttft=None, queue_wait=None):
+        class _M:
+            pass
+        self.metrics = _M()
+        self.metrics.ttft = ttft
+        self.metrics.queue_wait = queue_wait
+
+
+def test_deadline_admission_decision_boundary():
+    # min across engines (router picks the best one)
+    engines = [_Eng(ttft=_Hist(2.0)), _Eng(ttft=_Hist(0.8))]
+    assert estimate_ttft_s(engines) == pytest.approx(0.8)
+    # empty ttft window falls back to queue wait
+    assert estimate_ttft_s([_Eng(ttft=_Hist(0, count=0),
+                                 queue_wait=_Hist(1.5))]) \
+        == pytest.approx(1.5)
+    # no evidence at all: 0.0 — never reject on silence
+    assert estimate_ttft_s([_Eng()]) == 0.0
+    assert estimate_ttft_s([]) == 0.0
+
+    est = AdmissionEstimator(lambda: engines, quantile=0.9)
+    # budget above the estimate: feasible
+    ok, got, retry = est.check(1.0)
+    assert ok and got == pytest.approx(0.8) and retry == 0.0
+    # budget below: infeasible, Retry-After = ceil(est - budget), min 1
+    ok, got, retry = est.check(0.5)
+    assert not ok and got == pytest.approx(0.8) and retry == 1.0
+    engines[1] = _Eng(ttft=_Hist(4.2))
+    ok, _, retry = est.check(0.5)
+    assert not ok and retry == 2.0          # ceil(2.0 - 0.5) = 2
+    # no deadline = always feasible, zero cost
+    assert est.check(0.0) == (True, 0.0, 0.0)
+    # a dying supplier degrades to admit-everything, never raises
+    boom = AdmissionEstimator(lambda: (_ for _ in ()).throw(OSError()))
+    assert boom.check(0.1)[0] is True
+
+
+# -- brownout ladder under a fake clock -------------------------------------
+
+
+def _slo_ev(objective, to, **extra):
+    return {"objective": objective, "from": "ok", "to": to, **extra}
+
+
+def test_brownout_escalation_and_walkback_hysteresis():
+    t = [0.0]
+    cfg = ServingClassesConfig(brownout_hold_s=5.0, brownout_recover_s=15.0)
+
+    class _FakeEng:
+        spec_shrink = False
+
+    engines = [_FakeEng()]
+    bus_events = []
+
+    class _Bus:
+        def publish_nowait(self, subject, data):
+            bus_events.append((subject, data))
+    m = ClassMetrics()
+    bo = BrownoutMachine(cfg, engines=lambda: engines, bus=_Bus(),
+                         metrics=m, clock=lambda: t[0])
+    assert bo.stage == 0 and not bo.sheds(cfg.get("batch"))
+
+    # stage 1: a fast_burn escalates and batch starts shedding
+    acts = bo.on_slo_event(_slo_ev("ttft:interactive", "fast_burn",
+                                   fast_burn=99.0, threshold_s=0.5))
+    assert [a["to"] for a in acts] == ["shed_batch"]
+    assert bo.sheds(cfg.get("batch")) and not bo.sheds(cfg.get("standard"))
+    assert bo.cap_for(cfg.get("standard")) == 0
+    # hold_s: a second hot event inside the hold window does NOT escalate
+    t[0] = 3.0
+    assert bo.on_slo_event(_slo_ev("itl:interactive", "breach")) == []
+    assert bo.stage == 1
+    # past the hold: stage 2 caps standard streams
+    t[0] = 6.0
+    acts = bo.on_slo_event(_slo_ev("ttft:standard", "fast_burn"))
+    assert [a["to"] for a in acts] == ["cap_standard"]
+    assert bo.cap_for(cfg.get("standard")) == 32
+    # stage 3 actuates spec_shrink on the live engines
+    t[0] = 12.0
+    acts = bo.on_slo_event(_slo_ev("ttft:interactive", "breach"))
+    assert bo.stage == 3 and engines[0].spec_shrink is True
+    # bounded at the top
+    t[0] = 18.0
+    assert bo.on_slo_event(_slo_ev("itl:standard", "fast_burn")) == []
+    assert bo.stage == 3
+
+    # walk-back: nothing while any objective is still hot
+    t[0] = 100.0
+    assert bo.tick() == []
+    # all four hot objectives recover; clean clock starts at the LAST
+    for obj in ("ttft:interactive", "itl:interactive", "ttft:standard",
+                "itl:standard"):
+        t[0] += 1.0
+        bo.on_slo_event(_slo_ev(obj, "ok"))
+    clean_start = t[0]
+    t[0] = clean_start + 14.0
+    assert bo.tick() == []                  # recover_s not yet elapsed
+    t[0] = clean_start + 15.0
+    acts = bo.tick()
+    assert [a["to"] for a in acts] == ["cap_standard"]
+    assert engines[0].spec_shrink is False  # stage 3 actuation cleared
+    # each further step down needs a FRESH clean window + hold
+    assert bo.tick() == []
+    t[0] += 15.0
+    assert [a["to"] for a in bo.tick()] == ["shed_batch"]
+    t[0] += 15.0
+    assert [a["to"] for a in bo.tick()] == ["ok"]
+    assert bo.stage == 0 and bo.tick() == []
+
+    # every transition was an explainable published event + counted
+    subjects = {s for s, _ in bus_events}
+    assert subjects == {"brownout_events"}
+    evs = [d for _, d in bus_events]
+    assert all({"knob", "from", "to", "reason", "evidence", "at"}
+               <= set(e) for e in evs)
+    assert [e["to"] for e in evs] == ["shed_batch", "cap_standard",
+                                     "shrink_spec", "cap_standard",
+                                     "shed_batch", "ok"]
+    assert bo.transitions == 6 and bo.state()["stage_name"] == "ok"
+    assert m.brownout_state.get() == 0
+    assert m.brownout_actions.get(stage="shed_batch") == 2
+    # controller contract for the DYN_CONTROL plane
+    assert bo.name == "brownout" and BROWNOUT_STAGES[0] == "ok"
+
+
+# -- class-weighted fair share ----------------------------------------------
+
+
+def test_fair_scheduler_class_weights():
+    from dynamo_tpu.tenancy import FairScheduler, parse_tenancy
+
+    tcfg = parse_tenancy({"tenants": [{"name": "a", "weight": 2.0}]})
+    fair = FairScheduler(tcfg)
+    # unarmed: classes attr is None and cls is ignored — legacy math
+    assert fair.classes is None
+    fair.on_admit("a", 12.0, cls="interactive")
+    assert fair.service["a"] == pytest.approx(6.0)     # 12 / 2
+    # armed: interactive (weight 4) charges a quarter of the virtual time
+    fair.classes = ServingClassesConfig()
+    fair.on_admit("a", 12.0, cls="interactive")
+    assert fair.service["a"] == pytest.approx(6.0 + 1.5)  # 12 / (2*4)
+    fair.on_admit("a", 12.0, cls="batch")
+    assert fair.service["a"] == pytest.approx(7.5 + 6.0)  # 12 / (2*1)
+    fair.on_admit("a", 12.0, cls=None)                    # classless rider
+    assert fair.service["a"] == pytest.approx(13.5 + 6.0)
+
+
+# -- expired deadline dropped at admission (satellite bugfix) ---------------
+
+
+def _enqueue(eng, toks, ctx=None, max_tokens=8, cls=None):
+    r = PreprocessedRequest(token_ids=list(toks), model="m")
+    r.stop.max_tokens = max_tokens
+    mreq = _MockRequest(
+        req=r, ctx=ctx or Context(), queue=asyncio.Queue(),
+        seq=TokenBlockSequence(eng.config.block_size, list(toks)),
+        arrival=eng._arrivals, t_enqueue_ns=time.time_ns(), cls=cls)
+    eng._arrivals += 1
+    eng._waiting.append(mreq)
+    return mreq
+
+
+async def test_expired_deadline_dropped_before_admission():
+    """A request whose Context.deadline already passed while queued is
+    dropped at _admit with the distinct in-band error — it never burns
+    prefill, and the error is a FINISH_ERROR EngineOutput (not a
+    ConnectionError), so breaker/replay never fire for it."""
+    eng = MockEngine(MockEngineConfig(block_size=4, total_kv_blocks=64))
+    loop = asyncio.get_running_loop()
+    dead_ctx = Context()
+    dead_ctx.deadline = loop.time() - 0.5
+    expired = _enqueue(eng, range(100, 108), ctx=dead_ctx)
+    live = _enqueue(eng, range(200, 208))
+    eng._admit()
+    # the expired request was dropped, the live one admitted
+    assert expired not in eng._running and expired not in eng._waiting
+    assert live in eng._running
+    out = expired.queue.get_nowait()
+    assert out["finish_reason"] == "error"
+    assert out["extra"]["error"] == DEADLINE_ADMIT_ERR
+    assert expired.queue.get_nowait() is None      # stream terminated
+    # a future deadline is NOT dropped
+    ok_ctx = Context()
+    ok_ctx.deadline = loop.time() + 60.0
+    future = _enqueue(eng, range(300, 308), ctx=ok_ctx)
+    eng._admit()
+    assert future in eng._running
+    await eng.close()
+
+
+# -- byte-identical unarmed pins --------------------------------------------
+
+
+def test_schedule_artifact_md5_pinned_and_class_mixes():
+    from dynamo_tpu.trafficgen.schedule import (
+        TrafficConfig,
+        build_schedule,
+        schedule_from_jsonl,
+        schedule_to_jsonl,
+        summarize_classes,
+    )
+
+    cfg = TrafficConfig(pattern="bursty", seed=1234, duration_s=60.0,
+                        base_rps=2.0, prefix_fraction=0.3,
+                        abandon_fraction=0.1)
+    text = schedule_to_jsonl(cfg, build_schedule(cfg))
+    assert hashlib.md5(text.encode()).hexdigest() == LEGACY_SCHEDULE_MD5
+    assert '"cls"' not in text and '"classes"' not in text
+    # classed config: deterministic share-weighted draws, per-class
+    # length overrides, lossless artifact roundtrip
+    ccfg = TrafficConfig(
+        pattern="poisson", seed=7, duration_s=20.0, base_rps=5.0,
+        classes=[{"name": "interactive", "share": 3.0, "osl_mean": 8},
+                 {"name": "batch", "share": 1.0, "osl_mean": 128}])
+    reqs = build_schedule(ccfg)
+    assert reqs == build_schedule(ccfg)
+    mix = summarize_classes(reqs)
+    assert set(mix) == {"interactive", "batch"}
+    assert mix["interactive"]["requests"] > 2 * mix["batch"]["requests"]
+    # osl override actually biases the per-class token shape
+    assert (mix["batch"]["osl_tokens"] / mix["batch"]["requests"]
+            > mix["interactive"]["osl_tokens"]
+            / mix["interactive"]["requests"])
+    cfg2, reqs2 = schedule_from_jsonl(schedule_to_jsonl(ccfg, reqs))
+    assert cfg2 == ccfg and reqs2 == reqs
+    with pytest.raises(ValueError):
+        TrafficConfig(classes=[{"share": 1.0}])    # class without a name
+
+
+# -- HTTP stack -------------------------------------------------------------
+
+
+async def setup_stack(model="mock-model", workers=1, rt_kw=None, **eng_kw):
+    from dynamo_tpu.llm.entrypoint import (
+        serve_engine,
+        start_frontend,
+        wire_engine_events,
+    )
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(store_url="memory", **(rt_kw or {})))
+    card = ModelDeploymentCard(
+        name=model, namespace="ns", component="mock",
+        tokenizer_kind="word", tokenizer_path=model,
+        router_mode="round_robin", migration_limit=1)
+    kw = dict(block_size=card.kv_block_size, speedup=200.0,
+              default_max_tokens=64)
+    kw.update(eng_kw)
+    handles, engines = [], []
+    for i in range(workers):
+        ev_sink, m_sink = wire_engine_events(rt, card)
+        eng = MockEngine(MockEngineConfig(worker_id=i + 1, **kw),
+                         event_sink=ev_sink, metrics_sink=m_sink)
+        engines.append(eng)
+        handles.append(await serve_engine(rt, eng, card, instance_id=i + 1))
+    frontend = await start_frontend(rt)
+    for _ in range(200):
+        if model in frontend.manager.model_names():
+            break
+        await asyncio.sleep(0.01)
+    return rt, frontend, handles, engines
+
+
+async def teardown_stack(rt, frontend, handles, engines):
+    await frontend.stop()
+    for h in handles:
+        await h.stop()
+    for e in engines:
+        await e.close()
+    await rt.close()
+
+
+class _StubAdmission:
+    """Deterministic infeasible verdict for the HTTP-path tests."""
+
+    quantile = 0.9
+
+    def __init__(self, est=5.0):
+        self.est = est
+
+    def estimate_s(self):
+        return self.est
+
+    def check(self, budget_s):
+        if budget_s <= 0:
+            return True, 0.0, 0.0
+        if self.est <= budget_s:
+            return True, self.est, 0.0
+        return False, self.est, max(self.est - budget_s, 1.0)
+
+
+async def test_http_class_resolution_metrics_and_debug_surface():
+    """Armed fleet: the header resolves the class, per-class counters
+    export, /debug/classes renders the live view, /debug/requests
+    attributes the class, and the engine-side fair scheduler got the
+    class table."""
+    with classes_env():
+        rt, fe, hs, es = await setup_stack()
+    try:
+        assert fe.http.classes is not None
+        assert fe.http.brownout is not None
+        assert fe.http.admission is not None
+        assert es[0].fair is None           # classes alone ≠ tenancy
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 6, "stream": True,
+                    "messages": [{"role": "user", "content": "hi there"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions", json=body,
+                              headers={CLASS_HEADER: "interactive"}) as r:
+                assert r.status == 200
+                await r.read()
+            async with s.post(f"{fe.url}/v1/chat/completions",
+                              json=dict(body)) as r:
+                assert r.status == 200      # headerless → default class
+                await r.read()
+            async with s.get(f"{fe.url}/debug/classes") as r:
+                assert r.status == 200
+                dbg = await r.json()
+            assert dbg["enabled"] is True
+            assert dbg["default_class"] == "standard"
+            assert dbg["classes"]["interactive"]["weight"] == 4.0
+            assert dbg["counters"]["admitted"] == {"interactive": 1,
+                                                   "standard": 1}
+            assert dbg["brownout"]["stage"] == 0
+            assert "est_ttft_s" in dbg["admission"]
+            async with s.get(f"{fe.url}/debug/requests") as r:
+                recent = (await r.json())["recent"]
+            assert {rec["class"] for rec in recent} \
+                == {"interactive", "standard"}
+            async with s.get(f"{fe.url}/metrics") as r:
+                text = await r.text()
+            assert ('dynamo_class_admitted_total{class="interactive"} 1'
+                    in text)
+            assert "dynamo_brownout_state 0" in text
+            async with s.get(f"{fe.url}/debug") as r:
+                surfaces = (await r.json())["surfaces"]
+            assert surfaces["/debug/classes"]["armed"] is True
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_unarmed_frontend_has_no_classes_surface():
+    """No DYN_CLASSES: /debug/classes is a 503, /metrics carries no
+    dynamo_class_*/dynamo_brownout_* series, requests record no class,
+    a class header is inert, and no gate objects exist on the path."""
+    assert "DYN_CLASSES" not in os.environ
+    rt, fe, hs, es = await setup_stack()
+    try:
+        assert fe.http.classes is None and fe.http.brownout is None
+        assert fe.http.admission is None and fe.http.class_metrics is None
+        assert es[0].classes is None and es[0].spec_shrink is False
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": "plain"}]}
+            async with s.post(f"{fe.url}/v1/chat/completions", json=body,
+                              headers={CLASS_HEADER: "interactive"}) as r:
+                assert r.status == 200
+            async with s.get(f"{fe.url}/debug/classes") as r:
+                assert r.status == 503
+                assert "DYN_CLASSES" in (await r.json())["reason"]
+            async with s.get(f"{fe.url}/metrics") as r:
+                text = await r.text()
+            assert "dynamo_class_" not in text
+            assert "dynamo_brownout_" not in text
+            assert "dynamo_http_rejections_" not in text
+            async with s.get(f"{fe.url}/debug/requests") as r:
+                assert "class" not in (await r.json())["recent"][0]
+            async with s.get(f"{fe.url}/debug") as r:
+                surfaces = (await r.json())["surfaces"]
+            assert surfaces["/debug/classes"]["armed"] is False
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+async def test_http_brownout_shed_cap_and_deadline_gate():
+    """The frontend gate end-to-end: stage-1 sheds batch with 503 +
+    Retry-After, stage-2 caps standard streams' max_tokens, a provably
+    unmeetable explicit deadline bounces with err_type
+    deadline_unmeetable, and an unmeetable class-implicit deadline
+    downgrades instead (visible via x-dyn-class-downgraded)."""
+    doc = {"classes": [
+        {"name": "interactive", "weight": 4.0, "ttft_objective_s": 0.5},
+        {"name": "standard", "weight": 2.0, "deadline_s": 0.5,
+         "cap_stage": 2, "cap_tokens": 5, "downgrade_to": "batch"},
+        {"name": "batch", "shed_stage": 1}]}
+    with classes_env(json.dumps(doc)):
+        rt, fe, hs, es = await setup_stack()
+    try:
+        bo = fe.http.brownout
+        async with aiohttp.ClientSession() as s:
+            url = f"{fe.url}/v1/chat/completions"
+            body = {"model": "mock-model", "max_tokens": 32, "stream": True,
+                    "messages": [{"role": "user", "content": "go now"}]}
+            # stage 1: batch sheds, interactive flows
+            bo.stage = 1
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "batch"}) as r:
+                assert r.status == 503
+                assert int(r.headers["Retry-After"]) >= 1
+                err = await r.json()
+                assert err["error"]["type"] == "overloaded"
+                assert "shed_batch" in err["error"]["message"]
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "interactive"}) as r:
+                assert r.status == 200
+                await r.read()
+            # stage 2: standard streams get their token budget capped —
+            # count the delivered content chunks
+            bo.stage = 2
+            tokens = 0
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "standard"}) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.strip()
+                    if not line.startswith(b"data:"):
+                        continue
+                    data = line[len(b"data:"):].strip()
+                    if data == b"[DONE]":
+                        break
+                    chunk = json.loads(data)
+                    for ch in chunk.get("choices", ()):
+                        if (ch.get("delta") or {}).get("content"):
+                            tokens += 1
+            assert 0 < tokens <= 5
+            bo.stage = 0
+            # explicit deadline below the (stubbed) TTFT estimate: 503,
+            # no downgrade — the client asked for THAT deadline
+            fe.http.admission = _StubAdmission(est=5.0)
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "interactive",
+                                       "x-dyn-deadline-s": "1.0"}) as r:
+                assert r.status == 503
+                assert int(r.headers["Retry-After"]) >= 1
+                err = await r.json()
+                assert err["error"]["type"] == "deadline_unmeetable"
+            # class-implicit deadline unmeetable: standard downgrades to
+            # batch and the stream advertises the demotion
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "standard"}) as r:
+                assert r.status == 200
+                assert r.headers["x-dyn-class-downgraded"] == "standard"
+                assert r.headers[CLASS_HEADER] == "batch"
+                await r.read()
+            # ...unless the downgrade target itself sheds: then 503
+            bo.stage = 1
+            async with s.post(url, json=dict(body),
+                              headers={CLASS_HEADER: "standard"}) as r:
+                assert r.status == 503
+                assert (await r.json())["error"]["type"] == "overloaded"
+            bo.stage = 0
+            async with s.get(f"{fe.url}/debug/classes") as r:
+                counters = (await r.json())["counters"]
+            assert counters["shed"] == {"batch": 2}
+            assert counters["downgraded"] == {"standard": 2}
+            assert counters["deadline_rejected"] == {"interactive": 1}
+            reasons = {(row["reason"], row["class"]): row["count"]
+                       for row in counters["rejections"]}
+            assert reasons[("brownout", "batch")] == 2
+            assert reasons[("deadline", "interactive")] == 1
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+
+# -- telemetry + doctor surfaces --------------------------------------------
+
+
+def _counter(values):
+    return {"type": "counter", "values": [[lbl, v] for lbl, v in values]}
+
+
+def test_class_and_rejection_summaries_and_fleet_blocks():
+    from dynamo_tpu.runtime.telemetry import (
+        TelemetryCollector,
+        class_summary,
+        rejection_summary,
+    )
+
+    assert class_summary({}) is None
+    assert rejection_summary({}) is None
+    snap = {
+        "dynamo_class_admitted_total": _counter(
+            [({"class": "interactive"}, 6), ({"class": "batch"}, 2)]),
+        "dynamo_class_shed_total": _counter([({"class": "batch"}, 3)]),
+        "dynamo_http_rejections_total": _counter(
+            [({"reason": "brownout", "class": "batch"}, 3),
+             ({"reason": "quota", "class": "unknown"}, 1)]),
+    }
+    cs = class_summary(snap)
+    assert cs["interactive"]["admitted"] == 6
+    assert cs["batch"]["shed"] == 3
+    rj = rejection_summary(snap)
+    assert rj["brownout"]["batch"] == 3 and rj["quota"]["unknown"] == 1
+
+    col = TelemetryCollector(bus=None)
+    col.ingest({"component": "fe", "instance": "1", "role": "frontend",
+                "at": time.time(), "metrics": snap})
+    status = col.fleet_status(
+        brownout=lambda: {"stage": 1, "stage_name": "shed_batch",
+                          "hot_objectives": ["ttft:interactive"],
+                          "transitions": 1})
+    assert status["components"][0]["classes"]["batch"]["shed"] == 3
+    assert status["fleet"]["rejections"]["brownout"]["batch"] == 3
+    assert status["brownout"]["stage_name"] == "shed_batch"
+    # classless snapshots produce no blocks at all
+    col2 = TelemetryCollector(bus=None)
+    col2.ingest({"component": "fe", "instance": "1", "role": "frontend",
+                 "at": time.time(), "metrics": {}})
+    status2 = col2.fleet_status()
+    assert "classes" not in status2["components"][0]
+    assert "rejections" not in status2["fleet"]
+    assert "brownout" not in status2
+
+
+def test_doctor_classes_and_fleet_render(tmp_path, capsys):
+    from dynamo_tpu.doctor import classes as doctor_classes
+    from dynamo_tpu.doctor import fleet as doctor_fleet
+
+    cfg = ServingClassesConfig(classes=default_classes())
+    payload = {"enabled": True, "default_class": "standard",
+               "classes": cfg.payload(),
+               "counters": {"admitted": {"interactive": 4},
+                            "shed": {"batch": 2}, "downgraded": {},
+                            "deadline_rejected": {},
+                            "rejections": [{"reason": "brownout",
+                                            "class": "batch",
+                                            "count": 2}]},
+               "admission": {"quantile": 0.9, "est_ttft_s": 0.42},
+               "brownout": {"stage": 1, "stage_name": "shed_batch",
+                            "hot_objectives": ["ttft:interactive"],
+                            "transitions": 1, "hold_s": 5.0,
+                            "recover_s": 15.0}}
+    p = tmp_path / "classes.json"
+    p.write_text(json.dumps(payload))
+    assert doctor_classes.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "interactive: weight=4.0" in out and "shed@stage1" in out
+    assert "est_ttft=420.0ms" in out
+    assert "stage=1 (shed_batch)" in out
+    assert "brownout[batch]: 2" in out
+    # unarmed capture exits 1
+    p2 = tmp_path / "off.json"
+    p2.write_text(json.dumps({"status": "unavailable"}))
+    assert doctor_classes.main([str(p2)]) == 1
+    capsys.readouterr()
+    status = {"components": [{"component": "fe", "instance": "1",
+                              "role": "frontend", "age_s": 0.1,
+                              "latency": {},
+                              "classes": {"batch": {"admitted": 2,
+                                                    "shed": 3}},
+                              "rejections": {"brownout": {"batch": 3}}}],
+              "fleet": {"latency": {}},
+              "brownout": payload["brownout"]}
+    assert doctor_fleet.render(status) == 0
+    out = capsys.readouterr().out
+    assert "class batch: admitted=2 shed=3" in out
+    assert "rejected[brownout]: batch=3" in out
+    assert "brownout: stage=1 (shed_batch)" in out
+
+
+# -- chaos soak: abandons under an armed class plane ------------------------
+
+
+async def test_class_chaos_soak_with_abandons():
+    """A classed schedule with abandon_fraction replayed over an armed
+    fleet at stage 0: every non-abandoned stream completes, abandoned
+    streams stop early, nothing sheds, and completed streams are
+    token-identical to an isolated sequential run."""
+    from dynamo_tpu.trafficgen.runner import _replay_one, replay
+    from dynamo_tpu.trafficgen.schedule import TrafficConfig, build_schedule
+
+    cfg = TrafficConfig(
+        pattern="poisson", seed=11, duration_s=4.0, base_rps=5.0,
+        isl_mean=8, isl_max=16, osl_mean=10, osl_max=16,
+        abandon_fraction=0.3,
+        classes=[{"name": "interactive", "share": 1.0},
+                 {"name": "batch", "share": 1.0}])
+    schedule = build_schedule(cfg)
+    assert any(r.abandon_after for r in schedule)
+
+    rt, fe, hs, es = await setup_stack(speedup=200.0)   # classless ref
+    iso = []
+    try:
+        async with aiohttp.ClientSession() as s:
+            t0 = time.monotonic()
+            for req in schedule:
+                iso.append(await _replay_one(s, fe.url, "mock-model",
+                                             req, cfg, t0))
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+
+    with classes_env():
+        rt, fe, hs, es = await setup_stack(speedup=200.0)
+    try:
+        results = await replay(fe.url, "mock-model", schedule, cfg,
+                               time_scale=0.05)
+    finally:
+        await teardown_stack(rt, fe, hs, es)
+    for r, ref in zip(results, iso):
+        assert r is not None
+        assert not r.shed and not r.deadline_missed and not r.downgraded
+        if r.status == "ok":
+            assert ref.status == "ok" and r.text == ref.text, \
+                f"stream {r.index} diverged"
+        else:
+            assert r.status == "abandoned"
+
+
+# -- the overload gauntlet (`make overload-smoke` centerpiece) --------------
+
+
+def _overload_schedule():
+    """Wave 1 floods the fleet beyond capacity (batch-heavy, with
+    interactive riders whose TTFT will blow the objective); wave 2
+    trickles in while the fleet is hot — its batch arrivals are the
+    shed candidates, its interactive arrivals must still be served."""
+    from dynamo_tpu.trafficgen.schedule import ScheduledRequest
+
+    reqs = []
+    i = 0
+    for k in range(10):                      # wave 1: 10 batch + 4 int
+        reqs.append(ScheduledRequest(index=i, at=round(0.002 * k, 6),
+                                     isl=8, osl=10, cls="batch"))
+        i += 1
+    for k in range(4):
+        reqs.append(ScheduledRequest(index=i, at=round(0.02 + 0.002 * k, 6),
+                                     isl=8, osl=10, cls="interactive"))
+        i += 1
+    for k in range(12):                      # wave 2: 12 batch, spread
+        reqs.append(ScheduledRequest(index=i, at=round(0.6 + 0.12 * k, 6),
+                                     isl=8, osl=10, cls="batch"))
+        i += 1
+    for k in range(4):                       # wave 2: 4 interactive
+        reqs.append(ScheduledRequest(index=i, at=round(0.8 + 0.3 * k, 6),
+                                     isl=8, osl=10, cls="interactive"))
+        i += 1
+    return reqs
+
+
+async def test_overload_brownout_gauntlet():
+    """The tentpole gate, chip-free and seeded: a bursty mix beyond mock
+    capacity with the SLO monitor + brownout armed. Asserts the ladder's
+    contract: (1) batch requests shed via brownout, (2) not one
+    interactive request was 503'd — batch always sheds first, (3) every
+    admitted stream ran to completion (no engine-side drops), and
+    (4) the brownout stage + counters are visible on the debug and
+    fleet surfaces."""
+    from dynamo_tpu.trafficgen.runner import (
+        replay,
+        summarize_by_class,
+        summarize_results,
+    )
+    from dynamo_tpu.trafficgen.schedule import TrafficConfig
+
+    doc = {"classes": [
+        # deliberately unmeetable interactive objective: the queue built
+        # by wave 1 guarantees fast_burn, making escalation deterministic
+        {"name": "interactive", "weight": 4.0, "ttft_objective_s": 0.02},
+        {"name": "standard", "weight": 2.0},
+        {"name": "batch", "shed_stage": 1}],
+        "brownout_hold_s": 0.0, "brownout_recover_s": 600.0}
+    with classes_env(json.dumps(doc)):
+        rt, fe, hs, es = await setup_stack(
+            speedup=1.0, max_batch_size=2,
+            rt_kw={"slo_check_interval": 0.05, "slo_fast_window": 30.0})
+    try:
+        schedule = _overload_schedule()
+        results = await replay(fe.url, "mock-model", schedule,
+                               TrafficConfig())
+        assert all(r is not None for r in results)
+        per_class = summarize_by_class(results)
+        # (1) the fleet browned out and shed batch load
+        assert per_class["batch"]["shed"] >= 1, summarize_results(results)
+        assert fe.http.brownout.stage >= 1
+        assert fe.http.brownout.transitions >= 1
+        # (2) interactive never saw a 503 of any kind
+        inter = [r for r in results if r.cls == "interactive"]
+        assert len(inter) == 8
+        assert all(r.status == "ok" for r in inter), \
+            [r.status for r in inter]
+        # (3) zero engine-side drops: everything not shed completed
+        for r in results:
+            assert r.status == "ok" or r.shed, r.status
+            if r.status == "ok":
+                assert r.tokens > 0
+        # interactive latency stayed sane even under the flood (a
+        # generous CI-safe bound — the objective itself was set
+        # unmeetably tight to force the escalation)
+        ttfts = sorted(r.ttft_s for r in inter)
+        assert ttfts[int(0.9 * (len(ttfts) - 1))] < 5.0
+        # (4) the overload is explainable on the surfaces
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{fe.url}/debug/classes") as r:
+                dbg = await r.json()
+            assert dbg["brownout"]["stage"] >= 1
+            assert dbg["counters"]["shed"].get("batch", 0) >= 1
+            assert any(row["reason"] == "brownout"
+                       for row in dbg["counters"]["rejections"])
+            async with s.get(f"{fe.url}/fleet/status") as r:
+                fleet = await r.json()
+            assert fleet["brownout"]["stage"] >= 1
+            assert fleet["slo"]["ttft:interactive"]["state"] != "ok"
+            async with s.get(f"{fe.url}/metrics") as r:
+                text = await r.text()
+            assert "dynamo_brownout_state" in text
+            assert 'dynamo_class_shed_total{class="batch"}' in text
+    finally:
+        await teardown_stack(rt, fe, hs, es)

@@ -6,7 +6,7 @@ PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 .PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
 	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
 	kv-smoke perf-gate perf-baseline fairness-smoke ragged-smoke \
-	overload-smoke
+	overload-smoke mesh-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -23,7 +23,7 @@ tier1:
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
 chaos: heal-smoke control-smoke mem-smoke fairness-smoke ragged-smoke \
-	overload-smoke
+	overload-smoke mesh-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -164,6 +164,16 @@ overload-smoke:
 # Chip-free.
 ragged-smoke:
 	$(PYTEST) tests/test_ragged_attention.py
+
+# mesh/collective gate (docs/observability.md "Mesh & collectives"):
+# wire-byte formulas vs HLO ground truth — a tp=2 megatron-sharded
+# llama layer stack compiled on the forced-8-device CPU mesh must
+# produce exactly the analytic all-reduce count/bytes — plus the
+# unarmed byte-identical contract (no recorder object, identical
+# tokens + scheduler_stats), reshard-manifest tripwire, link-tier
+# topology classification, and mesh_summary fleet wiring. Chip-free.
+mesh-smoke:
+	$(PYTEST) tests/test_mesh_recorder.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

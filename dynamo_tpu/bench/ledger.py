@@ -54,6 +54,9 @@ class RunRecord:
     # renders its attribution on the outage row instead of a bare
     # RESOURCE_EXHAUSTED tail
     oom_report: Optional[dict] = None
+    # "bench" (tok/s record) | "multichip" (dryrun wrapper — pass/fail
+    # evidence, never a throughput number)
+    kind: str = "bench"
 
 
 @dataclass
@@ -134,10 +137,48 @@ def _collect_errors(parsed: dict) -> list:
     return out
 
 
+def is_multichip_record(data: dict) -> bool:
+    """The dryrun_multichip wrapper shape (MULTICHIP_r0*.json):
+    `{n_devices, rc, ok, skipped, tail}` — pass/fail evidence with a
+    log tail, never a `value` or `parsed` block."""
+    return (isinstance(data, dict) and "n_devices" in data
+            and "rc" in data and "ok" in data
+            and "value" not in data and "parsed" not in data)
+
+
+def _normalize_multichip(data: dict, label: str) -> RunRecord:
+    """Honest RunRecord for a multichip dryrun round: ok rounds carry
+    the device count (no fake tok/s), failed rounds are outages with
+    the tail classified, skipped rounds are outages with no error."""
+    tail = str(data.get("tail") or "").strip()
+    skipped = bool(data.get("skipped"))
+    ok = bool(data.get("ok")) and not data.get("rc")
+    status = "ok" if ok and not skipped else "outage"
+    errors: list = []
+    if not ok and not skipped:
+        errors = [tail[-400:] if tail else f"rc={data.get('rc')}"]
+    diagnosis = None
+    if errors:
+        from dynamo_tpu.doctor.preflight import classify
+        diagnosis = classify(errors[0])
+    elif skipped:
+        diagnosis = {"kind": "skipped", "detail": "round skipped"}
+    metrics: dict = {}
+    n_dev = _num(data.get("n_devices"))
+    if n_dev is not None:
+        metrics["n_devices"] = n_dev
+    return RunRecord(label=label, round=None, status=status, value=None,
+                     metrics=metrics, errors=errors, diagnosis=diagnosis,
+                     raw=data, kind="multichip")
+
+
 def normalize_run(data: dict, label: str = "") -> RunRecord:
     """One RunRecord from any historical BENCH_*.json shape: the
-    `{n, cmd, rc, tail, parsed}` wrapper, a bare parsed dict, or the
-    current bench.py output (value:null + skipped + preflight block)."""
+    `{n, cmd, rc, tail, parsed}` wrapper, a bare parsed dict, the
+    current bench.py output (value:null + skipped + preflight block),
+    or a MULTICHIP_r0*.json dryrun wrapper."""
+    if is_multichip_record(data):
+        return _normalize_multichip(data, label)
     rnd = None
     parsed = data
     if isinstance(data.get("parsed"), dict):
@@ -206,7 +247,13 @@ def load_run(path: str) -> RunRecord:
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     stem = os.path.splitext(os.path.basename(path))[0]
-    label = stem[6:] if stem.lower().startswith("bench_") else stem
+    low = stem.lower()
+    if low.startswith("bench_"):
+        label = stem[6:]
+    elif low.startswith("multichip_"):
+        label = "mc-" + stem[10:]   # keeps r0N distinct from BENCH r0N
+    else:
+        label = stem
     return normalize_run(data, label=label)
 
 
@@ -291,6 +338,16 @@ GATE_THRESHOLDS = {
     # regression) fails the gate outright
     "control.padded_by_entry_armed.ragged_step":
         GateSpec("lower", 0.0, "abs"),
+    # communication plane (bench/perf.py: simulated megatron
+    # collectives through a real CollectiveRecorder): analytic wire
+    # bytes are exact functions of the schedule + sharding constants,
+    # so any growth is a sharding/bucketing regression and any reshard
+    # means the collective set grew behind the manifest — both fail
+    # chip-free
+    "mesh.collective_bytes_total": GateSpec("lower", 0.02, "rel"),
+    "mesh.bytes_by_entry.prefill": GateSpec("lower", 0.02, "rel"),
+    "mesh.bytes_by_entry.decode_burst": GateSpec("lower", 0.02, "rel"),
+    "mesh.reshards": GateSpec("lower", 0.0, "abs"),
 }
 
 

@@ -49,6 +49,10 @@ import random
 import sys
 from dataclasses import asdict, dataclass, field
 
+from dynamo_tpu.engine.collectives import (
+    CollectiveRecorder,
+    megatron_collectives,
+)
 from dynamo_tpu.engine.profiler import StepRecorder
 from dynamo_tpu.kvbm.lifecycle import KvLifecycleRecorder
 from dynamo_tpu.mocker.engine import _pow2, _ragged_bucket
@@ -86,6 +90,13 @@ class PerfConfig:
     prefill_us_per_token: float = 20.0
     decode_ms_per_iter: float = 4.0
     overlap_weight: float = 1.0
+    # simulated comm plane: a megatron-sharded model of this size feeds
+    # analytic collective bytes through a real CollectiveRecorder —
+    # bytes scale with *padded* tokens, so a bucketing regression also
+    # inflates the gated mesh.* keys
+    tp: int = 4
+    model_layers: int = 8
+    model_hidden: int = 1024
     traffic: TrafficConfig = field(default_factory=lambda: TrafficConfig(
         pattern="bursty", duration_s=30.0, base_rps=8.0, burst_rps=24.0,
         seed=11, isl_mean=48, isl_sigma=0.6, isl_max=256,
@@ -130,6 +141,19 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
     for w in wkeys:
         kv[w].lifecycle = kv_recs[w]
     decisions = DecisionRecorder(capacity=4096)
+    mesh_rec = CollectiveRecorder()
+
+    def comm(entry, shape, tokens, fresh, dt) -> None:
+        """Simulated-comm accounting for one dispatch: on a fresh
+        (entry, shape) compile, install the analytic megatron
+        collective set (bytes ∝ padded tokens); every dispatch folds
+        the cached bytes — the same ingest/record_dispatch path the
+        armed engine drives from real HLO."""
+        if fresh:
+            mesh_rec.ingest(entry, shape, megatron_collectives(
+                layers=cfg.model_layers, tokens=tokens,
+                hidden=cfg.model_hidden, tp=cfg.tp))
+        mesh_rec.record_dispatch(entry, shape, dt)
     selector = DefaultWorkerSelector(
         SelectorConfig(overlap_weight=cfg.overlap_weight,
                        temperature=0.0, block_size=cfg.block_size),
@@ -204,6 +228,7 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
         steps[w].record(entry, shape, dt, good_tokens=uncached,
                         work_tokens=bucket, lanes=1, width=1,
                         compiled=fresh)
+        comm(entry, shape, bucket, fresh, dt)
         if not kv[w].allocate_sequence(seq):
             admission_rejects += 1      # decode proceeds untracked by KV
         loads.mark_prefill_completed(rid)
@@ -243,6 +268,7 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
                             good_tokens=len(runnable), work_tokens=width,
                             lanes=len(runnable), width=width,
                             tokens=len(runnable), compiled=fresh)
+            comm(entry, shape, width, fresh, step_s)
             for rid in list(runnable):
                 lane = runnable[rid]
                 blk = lane.seq.append(_DECODE_BASE + lane.emitted)
@@ -258,7 +284,7 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
                     completed += 1
         vclock += step_s
 
-    record = _score(cfg, schedule, steps, kv_recs, decisions,
+    record = _score(cfg, schedule, steps, kv_recs, decisions, mesh_rec,
                     completed=completed,
                     admission_rejects=admission_rejects,
                     append_fails=append_fails)
@@ -306,8 +332,8 @@ def _fold_armed_pass(cfg: PerfConfig, record: dict) -> None:
     record["control_sim"] = sim
 
 
-def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
-           admission_rejects, append_fails) -> dict:
+def _score(cfg, schedule, steps, kv_recs, decisions, mesh_rec, *,
+           completed, admission_rejects, append_fails) -> dict:
     """Fold recorder summaries into the scored record. Only analytic
     fields are read — never wall-clock ones (dispatch_gap, wall_span,
     goodput_tok_s, residency)."""
@@ -361,6 +387,9 @@ def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
             "max_batch_size": cfg.max_batch_size,
             "prefill_us_per_token": cfg.prefill_us_per_token,
             "decode_ms_per_iter": cfg.decode_ms_per_iter,
+            "tp": cfg.tp,
+            "model_layers": cfg.model_layers,
+            "model_hidden": cfg.model_hidden,
             # empty tenants/classes keys dropped: untenanted, classless
             # perf records stay byte-identical to older baselines (same
             # contract as schedule_to_jsonl)
@@ -396,6 +425,7 @@ def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
                 "admission_rejects": admission_rejects,
                 "append_fails": append_fails,
             },
+            "mesh": _mesh_block(cfg, mesh_rec),
             "router": {
                 "decisions": d["decisions"],
                 "tokens_saved": d["tokens_saved"],
@@ -408,6 +438,24 @@ def _score(cfg, schedule, steps, kv_recs, decisions, *, completed,
         },
     }
     return record
+
+
+def _mesh_block(cfg, mesh_rec) -> dict:
+    """Analytic comm totals from the simulated-collective recorder —
+    exact functions of the seeded schedule and the megatron model
+    constants, so they serialize byte-identically per seed and the
+    gate's ``mesh.*`` keys hold them against the baseline."""
+    s = mesh_rec.summary()
+    return {
+        "tp": cfg.tp,
+        "collective_bytes_total": s["bytes_total"],
+        "bytes_by_entry": {e: v["bytes_total"]
+                           for e, v in sorted(s["entries"].items())
+                           if v["bytes_total"]},
+        "dispatches": s["dispatches"],
+        "compiles": s["compiles"],
+        "reshards": sum(s["reshards"].values()),
+    }
 
 
 def record_to_json(record: dict) -> str:

@@ -27,6 +27,7 @@ from dynamo_tpu.engine.engine import TpuEngine
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.engine import AsyncEngine
 from dynamo_tpu.runtime.push import PushRouter
+from dynamo_tpu.runtime.topology import link_for_pull_path
 
 logger = logging.getLogger(__name__)
 
@@ -415,13 +416,15 @@ class DecodeWorkerHandler:
         record. Works for numpy and jax arrays (both carry .nbytes)."""
         nbytes = int(getattr(kv_data, "nbytes", 0) or 0)
         path = self.last_pull_path or "?"
+        link = link_for_pull_path(path)
         bw = nbytes / seconds if seconds > 0 else 0.0
         if em is not None and nbytes:
-            em.kv_pull_bytes.inc(nbytes, path=path)
+            em.kv_pull_bytes.inc(nbytes, path=path, link=link)
             em.kv_pull_bw.observe(bw)
         self.transfer_log.append({
             "transfer_id": ktp.get("transfer_id"),
             "path": path,
+            "link": link,
             "bytes": nbytes,
             "seconds": round(seconds, 6),
             "bandwidth_bytes_per_s": round(bw, 1),

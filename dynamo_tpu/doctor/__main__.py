@@ -96,6 +96,10 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
                "HBM memory ledger from /debug/memory (or an OOM crash "
                "file): occupancy by class, headroom, workspace "
                "shapes, unattributed residual"),
+    "mesh": ("mesh",
+             "mesh/collective flight recorder from /debug/mesh: "
+             "per-entry collective bytes by axis, reshard warnings, "
+             "device skew, link-tier topology"),
     "preflight": ("preflight",
                   "probe the device backend from a child process "
                   "(axon-wedge diagnosis)"),

@@ -47,6 +47,23 @@ def render_trajectory(records: list) -> str:
     for rec in records:
         rnd = f"r{rec.round:02d}" if rec.round is not None else rec.label
         head = f"  {rnd}  [{_STATUS_TAG.get(rec.status, rec.status)}]"
+        if getattr(rec, "kind", "bench") == "multichip":
+            nd = int(rec.metrics.get("n_devices") or 0)
+            if rec.status == "ok":
+                tail = str(rec.raw.get("tail") or "").strip()
+                lines.append(f"{head}  multichip dryrun passed on "
+                             f"{nd} device(s)")
+                if tail:
+                    lines.append(f"        {tail.splitlines()[0][:110]}")
+            else:
+                diag = rec.diagnosis or {}
+                lines.append(f"{head}  multichip dryrun "
+                             f"rc={rec.raw.get('rc')} on {nd} device(s)"
+                             f" — no serving evidence this round")
+                lines.append(
+                    f"        cause: {diag.get('kind', 'unknown')} — "
+                    f"{(diag.get('detail') or '(no detail)')[:110]}")
+            continue
         if rec.status == "outage":
             diag = rec.diagnosis or {}
             lines.append(f"{head}  no number this round")
@@ -122,8 +139,9 @@ def main(argv=None) -> int:
         prog="python -m dynamo_tpu.doctor bench",
         description="bench-trajectory ledger and deterministic perf gate")
     p.add_argument("runs", nargs="+",
-                   help="BENCH_*.json files (trajectory) or, with "
-                        "--gate, exactly: baseline.json current.json")
+                   help="BENCH_*.json / MULTICHIP_*.json files "
+                        "(trajectory) or, with --gate, exactly: "
+                        "baseline.json current.json")
     p.add_argument("--gate", action="store_true",
                    help="compare two perf records against the "
                         "regression thresholds; exit 1 on regression")
@@ -165,8 +183,8 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps([{
             "label": r.label, "round": r.round, "status": r.status,
-            "value": r.value, "metrics": r.metrics, "errors": r.errors,
-            "diagnosis": r.diagnosis,
+            "kind": r.kind, "value": r.value, "metrics": r.metrics,
+            "errors": r.errors, "diagnosis": r.diagnosis,
         } for r in records], indent=1, sort_keys=True))
     else:
         print(render_trajectory(records))

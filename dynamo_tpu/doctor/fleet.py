@@ -125,6 +125,25 @@ def _fmt_memory(ms: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_mesh(xs: Optional[dict]) -> str:
+    """Communication-plane health (present only on workers that armed
+    DYN_MESH_RECORDER)."""
+    if not xs:
+        return ""
+    gib = 2.0 ** 30
+    parts = [f"comm={xs.get('collective_bytes_total', 0) / gib:.2f}GiB"]
+    by_axis = xs.get("bytes_by_axis")
+    if by_axis:
+        parts.append("axes=" + ",".join(sorted(by_axis)))
+    reshards = xs.get("reshards")
+    if reshards:
+        parts.append(f"reshards={sum(reshards.values())}")
+    skew = xs.get("skew")
+    if skew:
+        parts.append(f"skew~{skew.get('mean', 0.0):.2f}x")
+    return "  " + " ".join(parts)
+
+
 def _fmt_tenants(ts: Optional[dict]) -> list[str]:
     """Per-tenant fairness lines (present only on fleets that armed
     DYN_TENANCY — untenanted fleets print nothing here)."""
@@ -189,7 +208,8 @@ def render(status: dict) -> int:
               f"{_fmt_goodput(c.get('goodput'))}"
               f"{_fmt_router(c.get('router'))}"
               f"{_fmt_kv(c.get('kv'))}"
-              f"{_fmt_memory(c.get('memory'))}")
+              f"{_fmt_memory(c.get('memory'))}"
+              f"{_fmt_mesh(c.get('mesh'))}")
         for line in _fmt_tenants(c.get("tenants")):
             print(line)
         for line in _fmt_classes(c.get("classes")):
@@ -201,7 +221,8 @@ def render(status: dict) -> int:
           f"{_fmt_goodput(fleet.get('goodput'))}"
           f"{_fmt_router(fleet.get('router'))}"
           f"{_fmt_kv(fleet.get('kv'))}"
-          f"{_fmt_memory(fleet.get('memory'))}")
+          f"{_fmt_memory(fleet.get('memory'))}"
+          f"{_fmt_mesh(fleet.get('mesh'))}")
     for line in _fmt_tenants(fleet.get("tenants")):
         print(line)
     for line in _fmt_classes(fleet.get("classes")):

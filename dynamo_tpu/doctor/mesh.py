@@ -1,0 +1,199 @@
+"""`python -m dynamo_tpu.doctor mesh <url-or-file>` — explain the
+communication plane.
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/mesh``;
+  * a ``.json`` capture of the same payload (or a single-engine
+    `mesh_payload` dict) — the same render works offline on a dump.
+
+Renders, per engine: the mesh shape, the per-entry comm budget (which
+collectives each jitted entry dispatches, attributed to mesh axes,
+with analytic wire bytes per dispatch and cumulative totals), reshard
+warnings (entries whose collective set grew at recompile — GSPMD
+inserted a reshard behind the shardings), per-device HBM occupancy
+bars with the max/mean skew ratio, and the link-tier topology census
+(same-chip / ICI / DCN pair counts with bandwidth estimates). Exit
+code 0 when at least one engine payload was rendered, 1 when the
+input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+_GIB = 2.0 ** 30
+_MIB = 2.0 ** 20
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/mesh from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/mesh"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor mesh: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor mesh: cannot read {source}: {e!r}")
+        return None
+
+
+def _engine_payloads(body: dict) -> list[dict]:
+    """Normalize: the frontend wraps payloads in `engines`; a raw
+    single-engine `mesh_payload` capture is accepted as-is."""
+    if isinstance(body.get("engines"), list):
+        return [e for e in body["engines"] if isinstance(e, dict)]
+    if "summary" in body or "enabled" in body:
+        return [body]
+    return []
+
+
+def _bar(frac: float, width: int = 30) -> str:
+    n = int(round(max(0.0, min(frac, 1.0)) * width))
+    return "#" * n + "." * (width - n)
+
+
+def _bytes(n) -> str:
+    try:
+        v = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    if v >= _GIB:
+        return f"{v / _GIB:.2f}GiB"
+    return f"{v / _MIB:.1f}MiB"
+
+
+def _render_entries(summary: dict) -> None:
+    entries = summary.get("entries") or {}
+    if not entries:
+        print("  no compiled entries analyzed yet")
+        return
+    print(f"  per-entry comm budget ({summary.get('compiles', 0)} "
+          f"compile(s), {summary.get('dispatches', 0)} dispatch(es), "
+          f"{_bytes(summary.get('bytes_total', 0))} total):")
+    ranked = sorted(entries.items(),
+                    key=lambda kv: -kv[1].get("bytes_total", 0))
+    for entry, e in ranked:
+        flag = "" if e.get("analyzed", True) else "  [not analyzed]"
+        print(f"    {entry:<16} {e.get('dispatches', 0):>6} disp  "
+              f"{_bytes(e.get('bytes_total', 0)):>10}{flag}")
+        for name, op in sorted((e.get("ops") or {}).items()):
+            print(f"      {name:<20} x{op.get('count', 0)}  "
+                  f"{_bytes(op.get('bytes_per_dispatch', 0))}/dispatch")
+
+
+def _render_reshards(summary: dict, records: list[dict]) -> None:
+    reshards = summary.get("reshards") or {}
+    if not reshards:
+        return
+    total = sum(reshards.values())
+    print(f"  WARN {total} reshard(s) — collective set grew at "
+          f"recompile (check param/activation shardings):")
+    for entry, n in sorted(reshards.items()):
+        new_ops: list[str] = []
+        for r in records:
+            if r.get("kind") == "reshard" and r.get("entry") == entry:
+                new_ops = [f"{o.get('op')}/{o.get('axis')}"
+                           for o in (r.get("new_ops") or [])]
+        extra = f" (+{', '.join(new_ops)})" if new_ops else ""
+        print(f"    {entry}: {n} event(s){extra}")
+
+
+def _render_skew(summary: dict) -> None:
+    skew = summary.get("skew") or {}
+    rows = skew.get("devices") or []
+    with_stats = [r for r in rows if r.get("bytes_in_use")]
+    if not with_stats:
+        if rows:
+            print(f"  devices: {len(rows)}, no memory_stats on this "
+                  f"backend — skew UNKNOWN (not 1.0)")
+        return
+    peak = max(r["bytes_in_use"] for r in with_stats)
+    print(f"  per-device HBM ({len(with_stats)} device(s) reporting):")
+    for r in with_stats:
+        frac = r["bytes_in_use"] / peak if peak else 0.0
+        limit = r.get("bytes_limit") or 0
+        pct = (f" ({100.0 * r['bytes_in_use'] / limit:.0f}% of limit)"
+               if limit else "")
+        print(f"    dev {r.get('device', '?'):>3} {_bar(frac)} "
+              f"{_bytes(r['bytes_in_use']):>10}{pct}")
+    ratio = skew.get("skew_ratio")
+    if ratio is not None:
+        flag = "  WARN one rank is running hot" if ratio > 1.5 else ""
+        print(f"  skew (max/mean): {ratio:.3f}x{flag}")
+
+
+def _render_topology(topo: Optional[dict]) -> None:
+    if not topo:
+        return
+    pairs = topo.get("pairs_by_link") or {}
+    bw = topo.get("bandwidth_bytes_per_s") or {}
+    census = "  ".join(f"{tier}={pairs.get(tier, 0)}"
+                       for tier in ("local", "ici", "dcn")
+                       if tier in pairs)
+    print(f"  topology: {topo.get('n_devices', '?')} device(s) / "
+          f"{topo.get('n_processes', '?')} process(es)  {census}")
+    if bw:
+        print("  link bandwidth: " + "  ".join(
+            f"{tier}={v / 1e9:.0f}GB/s"
+            for tier, v in sorted(bw.items(), key=lambda kv: -kv[1])))
+
+
+def render_engine(payload: dict, idx: int) -> bool:
+    print(f"engine[{idx}]:")
+    if not payload.get("enabled"):
+        hint = payload.get("hint", "set DYN_MESH_RECORDER=1")
+        print(f"  recorder: disabled ({hint})")
+        return True
+    s = payload.get("summary") or {}
+    mesh = s.get("mesh")
+    if mesh:
+        shape = " x ".join(f"{k}={v}"
+                           for k, v in (mesh.get("shape") or {}).items())
+        print(f"  mesh: {shape} ({mesh.get('n_devices', '?')} "
+              f"device(s))")
+    _render_entries(s)
+    _render_reshards(s, payload.get("records") or [])
+    _render_skew(s)
+    _render_topology(payload.get("topology"))
+    return True
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor mesh",
+        description="explain the communication plane (/debug/mesh or "
+                    "a saved dump): per-entry collective bytes by mesh "
+                    "axis, reshard warnings, device skew, link tiers")
+    p.add_argument("source",
+                   help="frontend base url or mesh JSON capture")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    payloads = _engine_payloads(body)
+    if not payloads:
+        print("doctor mesh: no engine payloads in input")
+        return 1
+    rendered = 0
+    for i, payload in enumerate(payloads):
+        if render_engine(payload, i):
+            rendered += 1
+    return 0 if rendered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,657 @@
+"""Mesh & collective flight recorder: the communication plane, visible.
+
+Every recorder so far (step/router/KV/memory) watches the *compute*
+plane; the collectives GSPMD inserts — the thing that actually limits
+scaling past one host — were invisible. This module makes them
+first-class, chip-free:
+
+  * **Compiled-collective attribution.** At every CompileTracker
+    dispatch site, a freshly-compiled (entry, shape) is re-lowered from
+    ShapeDtypeStructs (no device buffers touched — donated args stay
+    safe) and the *optimized* HLO is walked for collective ops
+    (all-reduce / all-gather / reduce-scatter / collective-permute /
+    all-to-all). Collectives only exist post-SPMD-partitioning, so the
+    walk needs `.lower(...).compile().as_text()` — one extra analysis
+    compile per compiled key, paid only when the recorder is armed.
+    Each op gets analytic ring-algorithm wire bytes and a mesh-axis
+    attribution (replica groups are *flattened mesh positions*, matched
+    against per-axis index groups), feeding
+    `dynamo_collective_bytes_total{entry,op,axis}` and a per-entry comm
+    budget that sits beside the memory ledger's workspace table.
+
+  * **Reshard detection.** The first compile of an entry freezes its
+    expected-collective manifest — the set of (op, axis) pairs. A later
+    compile whose set *grows* means GSPMD inserted a reshard behind our
+    back (an extra all-gather from a sharding mismatch): warn once,
+    count `dynamo_mesh_reshard_total{entry}`, and drop a ring event.
+
+  * **Skew.** Per-device `memory_stats()` polls feed
+    `dynamo_mesh_device_bytes{device}` and the max/mean occupancy ratio
+    into `dynamo_mesh_skew_ratio`, so HBM imbalance (the prelude to the
+    one-rank OOM) surfaces before it becomes the next r0x outage.
+
+Off by default: `mesh_recorder_from_env()` returns None unless
+`DYN_MESH_RECORDER` is truthy, every engine touch is `if rec is not
+None`, and the unarmed serving path is byte-identical (pinned by
+tests/test_mesh_recorder.py). Consumers: `GET /debug/mesh`,
+`python -m dynamo_tpu.doctor mesh`, the fleet mesh block, bench comm
+blocks, and the perf-gate collective-bytes keys.
+
+Wire-byte formulas (ring algorithm, total bytes crossing links per
+dispatch, summed over all participants, × replica groups) with R the
+HLO *result* tensor bytes:
+
+    all-reduce          2·(n−1)·R      (R = full tensor each rank holds)
+    all-gather          (n−1)·R        (R = gathered output)
+    reduce-scatter      n·(n−1)·R      (R = scattered shard)
+    collective-permute  pairs·R
+    all-to-all          (n−1)·R
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.runtime.metrics import Counter, Gauge, Histogram
+from dynamo_tpu.runtime.topology import topology_summary
+
+logger = logging.getLogger(__name__)
+
+ENV_GATE = "DYN_MESH_RECORDER"
+DEFAULT_RING = 1024
+_TRUTHY = {"1", "true", "yes", "on"}
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+# max/mean per-device HBM occupancy: 1.0 is perfect balance; past ~1.5
+# one device is carrying half again the fleet mean and will OOM first.
+_SKEW_BUCKETS = (1.0, 1.02, 1.05, 1.1, 1.2, 1.5, 2.0, 3.0, 5.0, 10.0)
+
+# `= <result-type> <op>[-start|-done](` in optimized HLO. The lhs value
+# name may itself contain the op string (`%all-reduce.1 = ...`), so the
+# match anchors after the `=`. `-done` halves of async pairs are
+# skipped — the `-start` carries the shapes.
+_OP_RE = re.compile(
+    r"=\s+(?P<rtype>.+?)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)"
+    r"(?P<suffix>-start|-done)?\(")
+# tensor types inside a result (possibly a tuple): `bf16[4,64]{1,0}`
+_TENSOR_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+# explicit replica groups: `replica_groups={{0,1},{2,3}}`
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[0-9, ]*\}(?:,\{[0-9, ]*\})*\})")
+# iota groups: `replica_groups=[2,4]<=[8]` or `[8,4]<=[4,8]T(1,0)`
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+
+
+def _shape_label(shape) -> str:
+    if isinstance(shape, (tuple, list)):
+        return "x".join(str(s) for s in shape)
+    return str(shape)
+
+
+def _dtype_bytes(token: str) -> int:
+    """Bytes per element from an HLO dtype token (f32, bf16, s8, pred,
+    f8e4m3fn, ...): first digit run is the bit width."""
+    if token == "pred":
+        return 1
+    m = re.search(r"(\d+)", token)
+    return max(1, int(m.group(1)) // 8) if m else 4
+
+
+def _result_bytes(rtype: str) -> int:
+    """Total bytes of an HLO result type, summing tuple elements (the
+    AllReduceCombiner pass merges small all-reduces into one variadic
+    op with a tuple result)."""
+    total = 0
+    for dtype, dims in _TENSOR_RE.findall(rtype):
+        elems = 1
+        for d in dims.split(","):
+            if d.strip():
+                elems *= int(d)
+        total += elems * _dtype_bytes(dtype)
+    return total
+
+
+def _parse_groups(line: str) -> Optional[list[tuple[int, ...]]]:
+    """Replica groups (flattened partition ids) from an HLO op line, in
+    both the explicit and iota forms. None when absent/empty."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        groups = [tuple(int(x) for x in inner.split(",") if x.strip())
+                  for inner in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+        groups = [g for g in groups if g]
+        return groups or None
+    m = _IOTA_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        idx = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            idx = idx.transpose([int(x) for x in m.group(4).split(",")])
+        return [tuple(int(x) for x in row) for row in idx.reshape(g, s)]
+    return None
+
+
+def _permute_groups(pairs: list[tuple[int, int]]
+                    ) -> list[tuple[int, ...]]:
+    """Connected components of a collective-permute's source→target
+    graph — a ring permute along one mesh axis decomposes into exactly
+    that axis's groups, which is what attribution needs."""
+    adj: dict[int, set[int]] = {}
+    for a, b in pairs:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    seen: set[int] = set()
+    comps = []
+    for start in sorted(adj):
+        if start in seen:
+            continue
+        comp, stack = [], [start]
+        while stack:
+            v = stack.pop()
+            if v in seen:
+                continue
+            seen.add(v)
+            comp.append(v)
+            stack.extend(adj[v] - seen)
+        comps.append(tuple(sorted(comp)))
+    return comps
+
+
+def wire_bytes(op: str, result_bytes: int, group_size: int,
+               num_groups: int = 1, pairs: Optional[int] = None) -> int:
+    """Analytic ring-algorithm wire bytes for one dispatch of one
+    collective (docstring table). Returns 0 for unknown ops rather
+    than guessing."""
+    n = max(1, int(group_size))
+    r = int(result_bytes)
+    if op == "collective-permute":
+        return (pairs if pairs is not None else n) * r
+    if op == "all-reduce":
+        per = 2 * (n - 1) * r
+    elif op == "all-gather":
+        per = (n - 1) * r
+    elif op == "reduce-scatter":
+        per = n * (n - 1) * r
+    elif op == "all-to-all":
+        per = (n - 1) * r
+    else:
+        return 0
+    return per * max(1, int(num_groups))
+
+
+def mesh_axis_groups(mesh) -> dict[str, list[tuple[int, ...]]]:
+    """Per-axis groups of *flattened mesh positions* — the id space
+    SPMD replica_groups use (partition ids follow mesh order, not
+    Device.id)."""
+    shape = mesh.devices.shape
+    names = mesh.axis_names
+    idx = np.arange(int(np.prod(shape))).reshape(shape)
+    out: dict[str, list[tuple[int, ...]]] = {}
+    for i, name in enumerate(names):
+        moved = np.moveaxis(idx, i, -1).reshape(-1, shape[i])
+        out[name] = [tuple(sorted(int(x) for x in row)) for row in moved]
+    return out
+
+
+def _attribute_axis(groups: Optional[list[tuple[int, ...]]],
+                    axis_groups: dict[str, list[tuple[int, ...]]],
+                    n_total: int) -> str:
+    """Mesh-axis name for a collective's replica groups: exact group
+    match first, then the all-axes case, then a unique group-size
+    match, else '?' (honest over guessed)."""
+    if not groups:
+        return "?"
+    key = frozenset(tuple(sorted(g)) for g in groups)
+    for name, ag in axis_groups.items():
+        if key == frozenset(ag):
+            return name
+    if len(groups) == 1 and n_total and len(groups[0]) == n_total:
+        return ",".join(axis_groups) if axis_groups else "all"
+    size = len(groups[0])
+    cands = [name for name, ag in axis_groups.items()
+             if ag and len(ag[0]) == size]
+    if len(cands) == 1:
+        return cands[0]
+    return "?"
+
+
+def parse_collectives(hlo_text: str,
+                      axis_groups: Optional[dict] = None,
+                      n_devices: int = 0) -> list[dict]:
+    """Walk optimized HLO text for collective ops; one dict per op with
+    analytic wire bytes and mesh-axis attribution."""
+    axis_groups = axis_groups or {}
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        rbytes = _result_bytes(m.group("rtype"))
+        pairs = None
+        if op == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pair_list = ([tuple(int(x) for x in p.split(","))
+                          for p in re.findall(r"\{(\d+,\d+)\}",
+                                              pm.group(1))]
+                         if pm else [])
+            pairs = len(pair_list)
+            groups = _permute_groups(pair_list) if pair_list else None
+        else:
+            groups = _parse_groups(line)
+        if groups:
+            group_size, num_groups = len(groups[0]), len(groups)
+        elif n_devices:
+            group_size, num_groups = n_devices, 1
+        else:
+            group_size, num_groups = 1, 1
+        ops.append({
+            "op": op,
+            "axis": _attribute_axis(groups, axis_groups, n_devices),
+            "result_bytes": rbytes,
+            "group_size": group_size,
+            "num_groups": num_groups,
+            "count": 1,
+            "bytes": wire_bytes(op, rbytes, group_size, num_groups,
+                                pairs=pairs),
+        })
+    return ops
+
+
+def _abstractify(x):
+    """jax.Array → ShapeDtypeStruct carrying its sharding: lowering
+    from specs never touches device buffers, so donated caches are
+    safe to analyze pre-dispatch. Single-device shardings are dropped —
+    those arrays are uncommitted at real dispatch, and pinning them in
+    the spec clashes with mesh-sharded params at lowering time."""
+    import jax
+    if isinstance(x, jax.Array):
+        sh = x.sharding
+        if getattr(sh, "num_devices", 1) <= 1:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+    return x
+
+
+def compiled_hlo_text(fn, args, kwargs=None) -> Optional[str]:
+    """Optimized (post-SPMD) HLO for one dispatch of a jitted callable,
+    or None when the callable can't be lowered (plain-python wrappers
+    like the pp chunk driver). This is an *extra* analysis compile —
+    armed-only, once per compiled (entry, shape)."""
+    if getattr(fn, "lower", None) is None:
+        return None
+    import jax
+    sds_args = jax.tree_util.tree_map(_abstractify, args)
+    sds_kw = (jax.tree_util.tree_map(_abstractify, kwargs)
+              if kwargs else {})
+    return fn.lower(*sds_args, **sds_kw).compile().as_text()
+
+
+def megatron_collectives(*, layers: int, tokens: int, hidden: int,
+                         tp: int, dtype_bytes: int = 2) -> list[dict]:
+    """Analytic collective set for a megatron-sharded llama forward:
+    two all-reduces per layer (after the attention o-proj and after the
+    MLP down-proj), each over the full (tokens, hidden) activation.
+    Shared by the tp parity test (expected side) and the chip-free perf
+    phase (simulated comm feed), so one formula is the truth."""
+    if tp <= 1 or layers <= 0:
+        return []
+    r = int(tokens) * int(hidden) * int(dtype_bytes)
+    count = 2 * int(layers)
+    return [{
+        "op": "all-reduce", "axis": "tp", "result_bytes": r,
+        "group_size": int(tp), "num_groups": 1, "count": count,
+        "bytes": count * wire_bytes("all-reduce", r, tp),
+    }]
+
+
+class MeshMetrics:
+    """Always-constructed fixed-name metrics for the communication
+    plane; they only move when DYN_MESH_RECORDER arms the recorder, so
+    the off path stays write-free."""
+
+    def __init__(self) -> None:
+        self.collective_bytes = Counter(
+            "dynamo_collective_bytes_total",
+            "analytic wire bytes moved by compiled collectives, per "
+            "jitted entry / collective op / mesh axis")
+        self.reshards = Counter(
+            "dynamo_mesh_reshard_total",
+            "compiles whose collective set grew past the entry's "
+            "first-compile manifest (GSPMD inserted a reshard)")
+        self.skew_ratio = Histogram(
+            "dynamo_mesh_skew_ratio",
+            "max/mean per-device HBM bytes-in-use across the local "
+            "mesh", _SKEW_BUCKETS)
+        self.device_bytes = Gauge(
+            "dynamo_mesh_device_bytes",
+            "per-device bytes_in_use from memory_stats()")
+
+    def register(self, registry, recorder=None) -> None:
+        """Adopt into a runtime registry (idempotent). With a live
+        recorder, each /metrics scrape re-polls per-device occupancy
+        first — same pattern as the memory ledger."""
+        for m in (self.collective_bytes, self.reshards,
+                  self.skew_ratio, self.device_bytes):
+            registry.register(m)
+        if recorder is not None:
+            registry.on_scrape(recorder.poll_devices)
+
+
+class CollectiveRecorder:
+    """Bounded ring of compile/reshard events + cumulative per-entry
+    collective-byte totals (totals survive ring eviction). Thread-safe:
+    dispatch closures run under asyncio.to_thread, so one lock covers
+    ring + cache + manifest + totals."""
+
+    def __init__(self, capacity: int = DEFAULT_RING, metrics=None,
+                 mesh=None) -> None:
+        self.capacity = max(16, int(capacity))
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self._mesh = mesh
+        # (entry, shape_label) -> {"ops": {(op, axis): [count, bytes]},
+        #                          "bytes": int, "analyzed": bool}
+        self._cache: dict[tuple, dict] = {}
+        # entry -> frozenset[(op, axis)] captured at first compile
+        self._manifest: dict[str, frozenset] = {}
+        self._reshards: dict[str, int] = {}
+        self._warned: set[str] = set()
+        # entry -> [dispatches, bytes, host_s]
+        self._totals: dict[str, list] = {}
+        self._compiles = 0
+        self._dispatches = 0
+        self._recorded = 0
+        self._last_skew: Optional[dict] = None
+        self._axis_groups_cache: dict[int, dict] = {}
+
+    # -- compile-time analysis ----------------------------------------------
+
+    def _axis_groups(self, mesh) -> dict:
+        if mesh is None:
+            return {}
+        key = id(mesh)
+        got = self._axis_groups_cache.get(key)
+        if got is None:
+            got = self._axis_groups_cache[key] = mesh_axis_groups(mesh)
+        return got
+
+    def observe_compile(self, entry: str, shape, fn=None, args=(),
+                        kwargs=None, mesh=None,
+                        hlo: Optional[str] = None) -> None:
+        """Analyze one freshly-compiled (entry, shape): lower from
+        specs, walk the optimized HLO, install the per-key collective
+        cache, and run the reshard-manifest check. Analysis failures
+        degrade to an analyzed=False event — never into the serving
+        path."""
+        mesh = mesh if mesh is not None else self._mesh
+        analyzed = False
+        ops: list[dict] = []
+        try:
+            text = hlo if hlo is not None else compiled_hlo_text(
+                fn, args, kwargs)
+            if text is not None:
+                n = (int(np.prod(mesh.devices.shape))
+                     if mesh is not None else 0)
+                ops = parse_collectives(text, self._axis_groups(mesh), n)
+                analyzed = True
+        except Exception:
+            logger.exception("mesh recorder: HLO analysis failed for "
+                             "%s %s", entry, shape)
+        self.ingest(entry, shape, ops, analyzed=analyzed)
+
+    def ingest(self, entry: str, shape, ops: list[dict],
+               analyzed: bool = True) -> None:
+        """Install a collective analysis for (entry, shape) — the HLO
+        walk above, an analytic model (perf sim), or a test feed all
+        land here so manifest/ring/metrics behave identically."""
+        key = (entry, _shape_label(shape))
+        by_pair: dict[tuple, list] = {}
+        total = 0
+        for op in ops:
+            pair = (op["op"], op.get("axis", "?"))
+            slot = by_pair.setdefault(pair, [0, 0])
+            slot[0] += int(op.get("count", 1))
+            slot[1] += int(op.get("bytes", 0))
+            total += int(op.get("bytes", 0))
+        opset = frozenset(by_pair)
+        grew: list[tuple] = []
+        with self._lock:
+            self._cache[key] = {"ops": by_pair, "bytes": total,
+                                "analyzed": analyzed}
+            self._compiles += 1
+            self._recorded += 1
+            if analyzed:
+                have = self._manifest.get(entry)
+                if have is None:
+                    self._manifest[entry] = opset
+                elif opset > have:
+                    grew = sorted(opset - have)
+                    self._manifest[entry] = opset | have
+                    self._reshards[entry] = (
+                        self._reshards.get(entry, 0) + 1)
+            self._ring.append({
+                "kind": "reshard" if grew else "compile",
+                "entry": entry, "shape": key[1],
+                "ops": [{"op": p[0], "axis": p[1], "count": c,
+                         "bytes": b}
+                        for p, (c, b) in sorted(by_pair.items())],
+                "bytes": total, "analyzed": analyzed,
+                "new_ops": [{"op": p[0], "axis": p[1]} for p in grew],
+                "at": time.time(),
+            })
+        if grew:
+            m = self._metrics
+            if m is not None:
+                m.reshards.inc(1, entry=entry)
+            if entry not in self._warned:
+                self._warned.add(entry)
+                logger.warning(
+                    "mesh recorder: collective set for entry %r grew "
+                    "at recompile (shape %s): %s — GSPMD inserted a "
+                    "reshard; check param/activation shardings",
+                    entry, key[1],
+                    ", ".join(f"{p[0]}/{p[1]}" for p in grew))
+
+    # -- hot path ------------------------------------------------------------
+
+    def record_dispatch(self, entry: str, shape,
+                        host_s: float = 0.0) -> None:
+        """Warm-path accounting for one dispatch: fold the cached
+        per-key collective bytes into cumulative totals and the
+        labelled counter. No HLO work here."""
+        key = (entry, _shape_label(shape))
+        with self._lock:
+            cached = self._cache.get(key)
+            self._dispatches += 1
+            tot = self._totals.get(entry)
+            if tot is None:
+                tot = self._totals[entry] = [0, 0, 0.0]
+            tot[0] += 1
+            tot[2] += float(host_s)
+            if cached is not None:
+                tot[1] += cached["bytes"]
+            ops = dict(cached["ops"]) if cached is not None else {}
+        m = self._metrics
+        if m is not None:
+            for (op, axis), (_count, nbytes) in ops.items():
+                if nbytes:
+                    m.collective_bytes.inc(nbytes, entry=entry, op=op,
+                                           axis=axis)
+
+    # -- skew ---------------------------------------------------------------
+
+    def poll_devices(self, devices=None) -> Optional[dict]:
+        """Per-device memory_stats() → device-bytes gauge + max/mean
+        skew ratio. Safe on backends without memory stats (CPU returns
+        empty stats → no skew sample)."""
+        if devices is None:
+            try:
+                import jax
+                devices = jax.devices()
+            except Exception:
+                return None
+        rows = []
+        for d in devices:
+            try:
+                s = d.memory_stats() or {}
+            except Exception:
+                s = {}
+            rows.append({"device": str(getattr(d, "id", "?")),
+                         "platform": str(getattr(d, "platform", "?")),
+                         "bytes_in_use": int(s.get("bytes_in_use", 0)),
+                         "bytes_limit": int(s.get("bytes_limit", 0))})
+        in_use = [r["bytes_in_use"] for r in rows if r["bytes_in_use"]]
+        skew = None
+        if len(in_use) > 1:
+            skew = max(in_use) / (sum(in_use) / len(in_use))
+        m = self._metrics
+        if m is not None:
+            for r in rows:
+                if r["bytes_in_use"]:
+                    m.device_bytes.set(r["bytes_in_use"],
+                                       device=r["device"])
+            if skew is not None:
+                m.skew_ratio.observe(skew)
+        out = {"devices": rows, "skew_ratio": skew}
+        with self._lock:
+            self._last_skew = out
+        return out
+
+    # -- views ---------------------------------------------------------------
+
+    def snapshot(self, limit: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            recs = list(self._ring)
+        if limit is not None and limit >= 0:
+            recs = recs[-limit:]
+        return [dict(r) for r in recs]
+
+    def summary(self) -> dict:
+        """Per-entry comm budget (cumulative, exact for the run) +
+        manifest/reshard state + last skew poll."""
+        with self._lock:
+            cache = {k: {"ops": dict(v["ops"]), "bytes": v["bytes"],
+                         "analyzed": v["analyzed"]}
+                     for k, v in self._cache.items()}
+            totals = {k: list(v) for k, v in self._totals.items()}
+            manifest = dict(self._manifest)
+            reshards = dict(self._reshards)
+            compiles = self._compiles
+            dispatches = self._dispatches
+            recorded = self._recorded
+            in_ring = len(self._ring)
+            skew = self._last_skew
+        entries: dict[str, dict] = {}
+        for (entry, shape), c in sorted(cache.items()):
+            e = entries.setdefault(entry, {
+                "shapes": 0, "analyzed": True, "dispatches": 0,
+                "bytes_total": 0, "host_s": 0.0, "ops": {}})
+            e["shapes"] += 1
+            e["analyzed"] = e["analyzed"] and c["analyzed"]
+            for (op, axis), (count, nbytes) in c["ops"].items():
+                slot = e["ops"].setdefault(f"{op}/{axis}",
+                                           {"count": 0,
+                                            "bytes_per_dispatch": 0})
+                slot["count"] += count
+                slot["bytes_per_dispatch"] += nbytes
+        for entry, (n, nbytes, host_s) in totals.items():
+            e = entries.setdefault(entry, {
+                "shapes": 0, "analyzed": False, "dispatches": 0,
+                "bytes_total": 0, "host_s": 0.0, "ops": {}})
+            e["dispatches"] = n
+            e["bytes_total"] = nbytes
+            e["host_s"] = host_s
+        mesh_info = None
+        if self._mesh is not None:
+            mesh_info = {
+                "shape": {str(k): int(v) for k, v in
+                          zip(self._mesh.axis_names,
+                              self._mesh.devices.shape)},
+                "n_devices": int(np.prod(self._mesh.devices.shape)),
+            }
+        return {
+            "mesh": mesh_info,
+            "compiles": compiles,
+            "dispatches": dispatches,
+            "recorded": recorded,
+            "in_ring": in_ring,
+            "capacity": self.capacity,
+            "bytes_total": sum(v[1] for v in totals.values()),
+            "entries": entries,
+            "manifest": {e: sorted(f"{op}/{ax}" for op, ax in s)
+                         for e, s in sorted(manifest.items())},
+            "reshards": reshards,
+            "skew": skew,
+        }
+
+
+# -- construction / integration helpers -------------------------------------
+
+def mesh_recorder_enabled(env: Optional[dict] = None) -> bool:
+    e = os.environ if env is None else env
+    return str(e.get(ENV_GATE, "")).strip().lower() in _TRUTHY
+
+
+def mesh_recorder_from_env(metrics=None, mesh=None,
+                           env: Optional[dict] = None
+                           ) -> Optional[CollectiveRecorder]:
+    """None unless `DYN_MESH_RECORDER` is truthy — the off path
+    allocates nothing and the serving path stays byte-identical. Ring
+    size via `DYN_MESH_RECORDER_RING` (default 1024, floor 16)."""
+    if not mesh_recorder_enabled(env):
+        return None
+    e = os.environ if env is None else env
+    try:
+        cap = int(e.get("DYN_MESH_RECORDER_RING", DEFAULT_RING))
+    except (TypeError, ValueError):
+        cap = DEFAULT_RING
+    return CollectiveRecorder(capacity=cap, metrics=metrics, mesh=mesh)
+
+
+def mesh_payload(engine, limit: Optional[int] = None) -> dict:
+    """The `GET /debug/mesh` body for one engine. Safe on engines
+    without a recorder."""
+    rec = getattr(engine, "mesh_recorder", None)
+    if rec is None:
+        return {"enabled": False,
+                "hint": "set DYN_MESH_RECORDER=1 to arm the recorder"}
+    rec.poll_devices()
+    return {"enabled": True, "summary": rec.summary(),
+            "records": rec.snapshot(limit),
+            "topology": topology_summary()}
+
+
+def mesh_recorder_summary(engine) -> Optional[dict]:
+    """Compact comm block for bench records. None when the recorder is
+    off, so bench payloads stay unchanged by default."""
+    rec = getattr(engine, "mesh_recorder", None)
+    if rec is None:
+        return None
+    s = rec.summary()
+    return {
+        "compiles": s["compiles"],
+        "dispatches": s["dispatches"],
+        "collective_bytes_total": s["bytes_total"],
+        "bytes_by_entry": {e: v["bytes_total"]
+                           for e, v in s["entries"].items()
+                           if v["bytes_total"]},
+        "reshards": sum(s["reshards"].values()),
+        "skew_ratio": (s["skew"] or {}).get("skew_ratio"),
+    }

@@ -703,6 +703,20 @@ class TpuEngine:
         self.memory_metrics = MemoryMetrics()
         self.memory_ledger = ledger_from_env(self.memory_metrics)
         self._oom = False
+        # Mesh & collective flight recorder (engine/collectives.py):
+        # same contract — None unless DYN_MESH_RECORDER, the
+        # dynamo_collective_* / dynamo_mesh_* metrics always-on. When
+        # armed, _mesh_dispatch re-lowers each freshly-compiled
+        # (entry, shape) from ShapeDtypeStructs and walks the optimized
+        # HLO for collectives (wire bytes per op/mesh axis), checks
+        # recompiles against the entry's first-compile manifest
+        # (reshard detection), and folds cached per-key bytes into the
+        # per-entry comm budget on every dispatch.
+        from dynamo_tpu.engine.collectives import (MeshMetrics,
+                                                   mesh_recorder_from_env)
+        self.mesh_metrics = MeshMetrics()
+        self.mesh_recorder = mesh_recorder_from_env(
+            self.mesh_metrics, mesh=cfg.mesh)
         # Tenancy plane (dynamo_tpu/tenancy): same off-by-default
         # contract — None unless DYN_TENANCY, in which case _admit
         # drains per-tenant FIFO heads by weighted deficit instead of
@@ -1394,7 +1408,8 @@ class TpuEngine:
         if led is not None:
             led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
-            sampled = sample_tokens_lp(
+            sampled = self._mesh_dispatch(
+                trk, sample_tokens_lp,
                 logits_stack,
                 arr(lambda s: s.seed, np.uint32),
                 arr(lambda s: s.generated, np.uint32),
@@ -1624,7 +1639,8 @@ class TpuEngine:
 
         def dispatch():
             with trk:
-                packed, ch_logits, kc, vc = mixed_prefill_decode(
+                packed, ch_logits, kc, vc = self._mesh_dispatch(
+                    trk, mixed_prefill_decode,
                     self.params, self.k_cache, self.v_cache,
                     jax.numpy.asarray(ch_toks),
                     jax.numpy.asarray(ch_tables),
@@ -1732,7 +1748,8 @@ class TpuEngine:
         if led is not None:
             led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
-            logits, self.k_cache, self.v_cache = pp_prefill_paged(
+            logits, self.k_cache, self.v_cache = self._mesh_dispatch(
+                trk, pp_prefill_paged,
                 self.params, self.k_cache, self.v_cache,
                 jax.numpy.asarray(tokens), jax.numpy.asarray(tables),
                 cached, seq_lens, mcfg, cfg.pp_mesh, chunk)
@@ -1911,7 +1928,8 @@ class TpuEngine:
                                 compiled=trk.compiled)
 
             def run_spec_burst():
-                packed, kc, vc, dk, dv, _ = spec_decode_multi_step(
+                packed, kc, vc, dk, dv, _ = self._mesh_dispatch(
+                    trk, spec_decode_multi_step,
                     self.params, self.draft_params,
                     self.k_cache, self.v_cache, self.dk_cache,
                     self.dv_cache, jax.numpy.asarray(tokens),
@@ -2020,7 +2038,8 @@ class TpuEngine:
                     stop_ids=jax.numpy.asarray(stop_ids))
 
             def run_pp_burst():
-                packed, kc, vc = pp_decode_multi_step(
+                packed, kc, vc = self._mesh_dispatch(
+                    trk, pp_decode_multi_step,
                     self.params, self.k_cache, self.v_cache,
                     jax.numpy.asarray(tokens),
                     jax.numpy.asarray(positions),
@@ -2060,7 +2079,8 @@ class TpuEngine:
             # thread: a first-call XLA trace/compile would otherwise
             # freeze the event loop for seconds.
             def dispatch():
-                return decode_multi_step(
+                return self._mesh_dispatch(
+                    trk, decode_multi_step,
                     self.params, self.k_cache, self.v_cache,
                     jax.numpy.asarray(tokens),
                     jax.numpy.asarray(positions),
@@ -2102,7 +2122,8 @@ class TpuEngine:
 
         def run_burst():
             if use_constrained:
-                sampled, kc, vc = decode_multi_step_guided(
+                sampled, kc, vc = self._mesh_dispatch(
+                    trk, decode_multi_step_guided,
                     self.params, self.k_cache, self.v_cache,
                     jax.numpy.asarray(tokens),
                     jax.numpy.asarray(positions),
@@ -2121,7 +2142,8 @@ class TpuEngine:
                     jax.numpy.asarray(stop_ids), mcfg, k_steps,
                     topk_lp=tk)
                 return np.asarray(sampled), kc, vc
-            sampled, kc, vc = decode_multi_step(
+            sampled, kc, vc = self._mesh_dispatch(
+                trk, decode_multi_step,
                 self.params, self.k_cache, self.v_cache,
                 jax.numpy.asarray(tokens), jax.numpy.asarray(positions),
                 jax.numpy.asarray(page_tables), jax.numpy.asarray(valid),
@@ -2150,6 +2172,37 @@ class TpuEngine:
         self._mark_decode_compile(batch, trk)
         self._emit_burst(batch, packed, k_steps, tk)
         return True
+
+    def _mesh_dispatch(self, trk, fn, *args, **kwargs):
+        """Mesh-recorder shim around one jitted dispatch. Off
+        (mesh_recorder is None, the default): one attribute check, then
+        the call — tokens and scheduler_stats stay byte-identical
+        (pinned by tests/test_mesh_recorder.py). Armed: a
+        freshly-compiled (entry, shape) is analyzed FIRST — lowering
+        from ShapeDtypeStructs, so the donated cache buffers the real
+        call consumes are never touched — then the dispatch runs and
+        its cached collective bytes fold into the per-entry comm
+        budget."""
+        rec = self.mesh_recorder
+        if rec is None:
+            return fn(*args, **kwargs)
+        if trk.compiled:
+            rec.observe_compile(trk.entry, trk.shape, fn, args, kwargs,
+                                mesh=self._mesh_for_entry(trk.entry))
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        rec.record_dispatch(trk.entry, trk.shape,
+                            time.perf_counter() - t0)
+        return out
+
+    def _mesh_for_entry(self, entry: str):
+        """Mesh whose axis groups attribute this entry's collectives:
+        pp entries dispatch over the pipeline mesh, everything else
+        over the serving mesh (None on single-device engines — bytes
+        still account, axes read '?')."""
+        if entry.startswith("pp_"):
+            return self.config.pp_mesh
+        return self.config.mesh
 
     def _mark_decode_compile(self, batch: list[_Seq], trk) -> None:
         """Flag this burst's lanes when the dispatch paid an XLA compile
@@ -2462,7 +2515,8 @@ class TpuEngine:
         if led is not None:
             led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
-            packed, ch_logits, kc, vc = ragged_prefill_decode(
+            packed, ch_logits, kc, vc = self._mesh_dispatch(
+                trk, ragged_prefill_decode,
                 self.params, kc, vc,
                 jax.numpy.asarray(toks), jax.numpy.asarray(poss),
                 jax.numpy.asarray(pages), jax.numpy.asarray(offs),
@@ -2599,7 +2653,8 @@ class TpuEngine:
         if led is not None:
             led.on_dispatch(trk.entry, trk.shape, compiled=trk.compiled)
         with trk:
-            logits_b, kc, vc = prefill_batch(
+            logits_b, kc, vc = self._mesh_dispatch(
+                trk, prefill_batch,
                 params_, kc, vc,
                 jax.numpy.asarray(toks), jax.numpy.asarray(tables),
                 jax.numpy.asarray(cached), jax.numpy.asarray(seq_lens),
@@ -3170,7 +3225,9 @@ class TpuEngine:
                 led.on_dispatch(trk.entry, trk.shape,
                                 compiled=trk.compiled)
             with trk:
-                out = _gather_kv_jit(self.k_cache, self.v_cache, ids)
+                out = self._mesh_dispatch(
+                    trk, _gather_kv_jit, self.k_cache, self.v_cache,
+                    ids)
                 out.block_until_ready()
         rec = self.step_recorder
         if rec is not None:
@@ -3224,7 +3281,8 @@ class TpuEngine:
                 led.on_dispatch(trk.entry, trk.shape,
                                 compiled=trk.compiled)
             with trk:
-                self.k_cache, self.v_cache = _write_kv_pages_jit(
+                self.k_cache, self.v_cache = self._mesh_dispatch(
+                    trk, _write_kv_pages_jit,
                     self.k_cache, self.v_cache, ids,
                     jax.numpy.asarray(data))
         rec = self.step_recorder

@@ -139,6 +139,31 @@ def device_memory_stats(device=None) -> Optional[dict]:
         return None
 
 
+def per_device_memory_stats(devices=None) -> Optional[list[dict]]:
+    """Per-device ``memory_stats()`` rows for multi-device workers —
+    the device-0 view above hides exactly the imbalance a sharded
+    deployment needs to see. None on single-device backends or when no
+    device exposes stats (CPU), so single-chip payloads are unchanged."""
+    try:
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+    except Exception:
+        return None
+    if len(devices) < 2:
+        return None
+    rows = []
+    for d in devices:
+        stats = device_memory_stats(d)
+        if stats is None:
+            continue
+        rows.append({"device": str(getattr(d, "id", len(rows))),
+                     "platform": str(getattr(d, "platform", "?")),
+                     **stats})
+    return rows or None
+
+
 def workspace_from_executable(executable) -> Optional[int]:
     """Temp+output workspace bytes from an AOT ``compiled`` object's
     ``memory_analysis()``; None when the backend doesn't expose it."""
@@ -583,10 +608,14 @@ def memory_payload(engine, limit: Optional[int] = None) -> dict:
         return {"enabled": False, "worker_id": wid,
                 "hint": "set DYN_MEM_LEDGER=1 to arm the memory ledger"}
     led.poll()
-    return {"enabled": True, "worker_id": wid,
-            "summary": led.summary(),
-            "snapshots": led.snapshot(limit),
-            "oom": bool(getattr(engine, "_oom", False))}
+    out = {"enabled": True, "worker_id": wid,
+           "summary": led.summary(),
+           "snapshots": led.snapshot(limit),
+           "oom": bool(getattr(engine, "_oom", False))}
+    devices = per_device_memory_stats()
+    if devices is not None:
+        out["devices"] = devices
+    return out
 
 
 def memory_ledger_summary(engine) -> Optional[dict]:

@@ -29,9 +29,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(dp: int = 1, tp: int = 1,
               devices: Optional[list] = None) -> Mesh:
+    """(dp, tp) device mesh. A structured error (not an assert, which
+    vanishes under `python -O`) names the requested factorization vs
+    the backend's reality — a pod-slice misconfig must fail loudly at
+    startup, not as a mystery reshape deep in Mesh()."""
     devices = devices if devices is not None else jax.devices()
     n = dp * tp
-    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    if dp < 1 or tp < 1:
+        raise ValueError(
+            f"make_mesh: axis sizes must be >= 1, got dp={dp} tp={tp}")
+    if len(devices) < n:
+        platforms = sorted({str(getattr(d, "platform", "?"))
+                            for d in devices}) or ["none"]
+        raise ValueError(
+            f"make_mesh: dp={dp} x tp={tp} needs {n} device(s) but the "
+            f"backend has {len(devices)} "
+            f"({', '.join(platforms)}) — shrink dp/tp or run on a "
+            f"larger slice (XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count=N emulates N devices on CPU)")
     arr = np.asarray(devices[:n]).reshape(dp, tp)
     return Mesh(arr, axis_names=("dp", "tp"))
 

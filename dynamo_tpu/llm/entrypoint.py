@@ -307,6 +307,15 @@ async def serve_engine(runtime: DistributedRuntime, engine: AsyncEngine,
     if mm is not None and hasattr(mm, "register"):
         mm.register(runtime.metrics,
                     ledger=getattr(core, "memory_ledger", None))
+    # Mesh & collective surface (engine/collectives.py): the
+    # dynamo_collective_* / dynamo_mesh_* series join the scrape; with
+    # an armed recorder each scrape re-polls per-device occupancy and
+    # skew first (the recorder stays None unless DYN_MESH_RECORDER
+    # armed it at engine construction)
+    xm = getattr(core, "mesh_metrics", None)
+    if xm is not None and hasattr(xm, "register"):
+        xm.register(runtime.metrics,
+                    recorder=getattr(core, "mesh_recorder", None))
     # Tenancy fairness surface (dynamo_tpu/tenancy): engine-role
     # dynamo_tenant_* series (goodput, queue wait, admissions, kv_blocks)
     # join the scrape when DYN_TENANCY armed the engine's fair scheduler

@@ -122,6 +122,7 @@ class HttpService:
             web.get("/debug/router", self._debug_router),
             web.get("/debug/kv", self._debug_kv),
             web.get("/debug/memory", self._debug_memory),
+            web.get("/debug/mesh", self._debug_mesh),
             web.get("/debug/control", self._debug_control),
             web.get("/debug/tenants", self._debug_tenants),
             web.get("/debug/classes", self._debug_classes),
@@ -838,6 +839,15 @@ class HttpService:
                              is not None for e in engines or []),
                 "available": engines is not None,
             },
+            "/debug/mesh": {
+                "what": "mesh/collective flight recorder: per-entry "
+                        "collective bytes by mesh axis, reshard "
+                        "manifest, per-device skew, link-tier topology",
+                "arm": "DYN_MESH_RECORDER=1",
+                "armed": any(getattr(e, "mesh_recorder", None)
+                             is not None for e in engines or []),
+                "available": engines is not None,
+            },
             "/debug/control": {
                 "what": "flight-control plane: controller state + "
                         "knob-change actions with evidence",
@@ -974,6 +984,32 @@ class HttpService:
         except ValueError:
             limit = 64
         payloads = [memory_payload(e, limit)
+                    for e in list(self.profile_engines() or [])]
+        return web.json_response({
+            "enabled": any(p.get("enabled") for p in payloads),
+            "engines": payloads,
+        })
+
+    async def _debug_mesh(self, request: web.Request) -> web.Response:
+        """Communication-plane view (docs/observability.md "Mesh &
+        collectives"): per-entry collective bytes attributed to mesh
+        axes from compiled HLO, the expected-collective manifest with
+        reshard warnings, per-device occupancy/skew, and the link-tier
+        topology census. `?limit=N` bounds the event-ring dump. 503
+        when no in-proc engine is wired (frontend-only process — hit
+        the worker's surface)."""
+        if self.profile_engines is None:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no in-proc engine wired for mesh recorder"},
+                status=503)
+        from dynamo_tpu.engine.collectives import mesh_payload
+
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            limit = 64
+        payloads = [mesh_payload(e, limit)
                     for e in list(self.profile_engines() or [])]
         return web.json_response({
             "enabled": any(p.get("enabled") for p in payloads),
@@ -1176,6 +1212,10 @@ class HttpService:
             "/debug/memory": ("HBM memory ledger: class occupancy vs "
                               "device stats, workspace shapes, "
                               "unattributed residual (?limit=N)", False),
+            "/debug/mesh": ("Mesh/collective recorder: per-entry "
+                            "collective bytes by axis, reshard "
+                            "manifest, device skew, link topology "
+                            "(?limit=N)", False),
             "/debug/control": ("Flight-control state: armed controllers "
                                "+ knob-change actions with evidence "
                                "(?limit=N)", False),

@@ -274,30 +274,32 @@ class OpenAIPreprocessor(Operator):
                 status=400)
 
         # items are independent: bounded fan-out, order kept by position;
-        # TaskGroup cancels the siblings the moment one item fails
+        # siblings are cancelled the moment one item fails (TaskGroup
+        # semantics, spelled by hand — asyncio.TaskGroup needs py3.11)
         results: list = [None] * len(token_lists)
+
+        async def slot(i: int, ids: list) -> None:
+            results[i] = await one(ids)
+
+        tasks = [asyncio.ensure_future(slot(i, ids))
+                 for i, ids in enumerate(token_lists)]
         try:
-            async with asyncio.TaskGroup() as tg:
-                for i, ids in enumerate(token_lists):
-                    async def slot(i=i, ids=ids):
-                        results[i] = await one(ids)
-                    tg.create_task(slot())
-        except BaseExceptionGroup as eg:
-            # unwrap to a bare exception (gather semantics): the HTTP
-            # layer catches OpenAIError, so surface one if any item
-            # raised it; otherwise re-raise the first failure as-is
-            flat: list[BaseException] = []
-            stack: list[BaseException] = [eg]
-            while stack:
-                e = stack.pop()
-                if isinstance(e, BaseExceptionGroup):
-                    stack.extend(e.exceptions)
-                else:
-                    flat.append(e)
-            for e in flat:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            for t in tasks:
+                t.cancel()
+            settled = await asyncio.gather(*tasks, return_exceptions=True)
+            errors = [e for e in settled
+                      if isinstance(e, BaseException)
+                      and not isinstance(e, asyncio.CancelledError)]
+            # the HTTP layer catches OpenAIError, so surface one if any
+            # item raised it; otherwise re-raise the first failure as-is
+            for e in errors:
                 if isinstance(e, OpenAIError):
                     raise e
-            raise flat[0]
+            if errors:
+                raise errors[0]
+            raise
         yield embedding_response(req.model, results,
                                  sum(len(t) for t in token_lists),
                                  req.encoding_format)

@@ -194,6 +194,15 @@ class MockEngine:
         self.memory_metrics = MemoryMetrics()
         self.memory_ledger = ledger_from_env(self.memory_metrics,
                                              device=self)
+        # Mesh & collective recorder parity (engine/collectives.py):
+        # None unless DYN_MESH_RECORDER. The mock dispatches no HLO, so
+        # an armed recorder only gives mock fleets the same /debug/mesh
+        # surface (and lets tests feed it analytic op sets via
+        # ingest()) — arming changes no scheduling behavior.
+        from dynamo_tpu.engine.collectives import (MeshMetrics,
+                                                   mesh_recorder_from_env)
+        self.mesh_metrics = MeshMetrics()
+        self.mesh_recorder = mesh_recorder_from_env(self.mesh_metrics)
         # Tenancy plane parity with TpuEngine (dynamo_tpu/tenancy):
         # None unless DYN_TENANCY — the fairness smoke runs its
         # noisy-neighbor gate over mock fleets, so the mock scheduler

@@ -300,6 +300,62 @@ def memory_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     head = snapshot.get("dynamo_memory_headroom_bytes")
     if head and head.get("values"):
         out["headroom_bytes"] = int(head["values"][0][1])
+    # per-device occupancy (fed by the mesh recorder's polls): on
+    # multi-device workers the single device-0 view above hides the
+    # exact imbalance the skew gauges exist to catch
+    per_dev = _gauge_by_label(snapshot, "dynamo_mesh_device_bytes",
+                              "device")
+    if len(per_dev) > 1:
+        out["devices"] = {k: int(v) for k, v in sorted(per_dev.items())}
+    return out
+
+
+def mesh_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """Communication-plane view from the collective recorder's
+    always-on series (engine/collectives.py). None when the component
+    never armed `DYN_MESH_RECORDER` — the fleet view stays unchanged
+    for unrecorded workers. Cross-rank comparison happens here: each
+    worker publishes its own per-device bytes and skew, and the merged
+    fleet entry is where a straggling rank stands out."""
+    by_entry = _counter_by_label(
+        snapshot, "dynamo_collective_bytes_total", "entry")
+    reshards = _counter_by_label(
+        snapshot, "dynamo_mesh_reshard_total", "entry")
+    dev = _gauge_by_label(snapshot, "dynamo_mesh_device_bytes",
+                          "device")
+    if not by_entry and not reshards and not dev:
+        return None
+    out: dict[str, Any] = {
+        "collective_bytes_total": int(sum(by_entry.values())),
+    }
+    if by_entry:
+        out["bytes_by_entry"] = {k: int(v)
+                                 for k, v in sorted(by_entry.items())}
+        by_op = _counter_by_label(
+            snapshot, "dynamo_collective_bytes_total", "op")
+        out["bytes_by_op"] = {k: int(v)
+                              for k, v in sorted(by_op.items())}
+        by_axis = _counter_by_label(
+            snapshot, "dynamo_collective_bytes_total", "axis")
+        out["bytes_by_axis"] = {k: int(v)
+                                for k, v in sorted(by_axis.items())}
+    if reshards:
+        out["reshards"] = {k: int(v)
+                           for k, v in sorted(reshards.items())}
+    if dev:
+        out["device_bytes"] = {k: int(v) for k, v in sorted(dev.items())}
+    sk = snapshot.get("dynamo_mesh_skew_ratio")
+    if sk and sk.get("type") == "histogram" and sk.get("count"):
+        out["skew"] = {
+            "samples": sk["count"],
+            "mean": round(sk["sum"] / sk["count"], 4),
+            "p99": hist_quantile(sk["buckets"], sk["counts"], 0.99),
+        }
+    pulls = _counter_by_label(snapshot, "dynamo_kv_pull_bytes_total",
+                              "link")
+    pulls = {k: int(v) for k, v in sorted(pulls.items()) if k}
+    if pulls:
+        out["kv_pull_bytes_by_link"] = pulls
     return out
 
 
@@ -551,6 +607,9 @@ class TelemetryCollector:
             ms = memory_summary(metrics)
             if ms is not None:
                 entry["memory"] = ms
+            xs = mesh_summary(metrics)
+            if xs is not None:
+                entry["mesh"] = xs
             ts = tenant_summary(metrics)
             if ts is not None:
                 entry["tenants"] = ts
@@ -582,6 +641,9 @@ class TelemetryCollector:
         fleet_mem = memory_summary(merged)
         if fleet_mem is not None:
             out["fleet"]["memory"] = fleet_mem
+        fleet_mesh = mesh_summary(merged)
+        if fleet_mesh is not None:
+            out["fleet"]["mesh"] = fleet_mesh
         fleet_ten = tenant_summary(merged)
         if fleet_ten is not None:
             out["fleet"]["tenants"] = fleet_ten

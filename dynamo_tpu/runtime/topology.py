@@ -1,0 +1,129 @@
+"""Link-tier topology model: which wire a byte crosses, and how fast.
+
+The mesh/placement work ahead (ROADMAP "one mesh abstraction",
+NetKV-style disagg routing in PAPERS.md) needs one question answered
+cheaply and consistently: *when data moves between two devices (or two
+workers), what link does it ride and what does that cost?* Today the
+answer is implicit — disagg labels pulls "device/plane/wire", the
+collective recorder knows bytes but not media — so this module owns
+the classification in one place:
+
+  * **local** — same chip (same device, or two cores of one chip):
+    on-chip fabric, effectively free relative to everything else;
+  * **ici** — different chips inside one host/slice (same
+    `process_index`): the TPU inter-chip interconnect;
+  * **dcn** — different hosts (`process_index` differs): the
+    data-center network, orders of magnitude slower than ICI.
+
+Bandwidth numbers are *planning estimates*, not measurements — rough
+per-link figures good enough to rank placements (ICI ~100 GB/s-class,
+DCN ~100 Gbit/s-class). Override per deployment with
+`DYN_LINK_BW_LOCAL` / `DYN_LINK_BW_ICI` / `DYN_LINK_BW_DCN`
+(bytes/second). `link_cost(src, dst)` returns seconds-per-byte — the
+exact scalar a network-aware router multiplies by a KV footprint to
+price a pull.
+
+Everything here is chip-free: classification uses only attributes jax
+Device objects already carry (`id`, `process_index`, `coords`), with
+duck-typed fallbacks so mock devices and CPU meshes classify sanely.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+LINK_TIERS = ("local", "ici", "dcn")
+
+# Planning defaults (bytes/second). ICI: ~100 GB/s-class per link on
+# recent TPU generations; DCN: ~100 Gbit/s host NICs ≈ 12.5 GB/s;
+# local: on-chip, set high enough to always win a comparison.
+_DEFAULT_BW = {
+    "local": 1.0e12,
+    "ici": 9.0e10,
+    "dcn": 1.25e10,
+}
+_ENV_KEYS = {
+    "local": "DYN_LINK_BW_LOCAL",
+    "ici": "DYN_LINK_BW_ICI",
+    "dcn": "DYN_LINK_BW_DCN",
+}
+
+
+def link_bandwidths(env: Optional[dict] = None) -> dict[str, float]:
+    """Per-tier bandwidth estimates (bytes/s), env-overridable."""
+    e = os.environ if env is None else env
+    out = {}
+    for tier, default in _DEFAULT_BW.items():
+        raw = e.get(_ENV_KEYS[tier])
+        try:
+            out[tier] = float(raw) if raw else default
+        except (TypeError, ValueError):
+            out[tier] = default
+    return out
+
+
+def classify_link(src, dst) -> str:
+    """Tier of the link between two jax Devices (duck-typed: anything
+    carrying id/process_index/coords classifies)."""
+    if src is dst:
+        return "local"
+    sid = getattr(src, "id", None)
+    did = getattr(dst, "id", None)
+    if sid is not None and sid == did:
+        return "local"
+    sp = getattr(src, "process_index", 0)
+    dp = getattr(dst, "process_index", 0)
+    if sp != dp:
+        return "dcn"
+    # same host: two cores of one chip share coords → still on-chip
+    sc = getattr(src, "coords", None)
+    dc = getattr(dst, "coords", None)
+    if sc is not None and sc == dc:
+        return "local"
+    return "ici"
+
+
+def link_cost(src, dst, env: Optional[dict] = None) -> float:
+    """Seconds-per-byte between two devices — the placement scalar:
+    `link_cost(a, b) * kv_bytes` prices a KV pull over that link."""
+    return 1.0 / link_bandwidths(env)[classify_link(src, dst)]
+
+
+# Disagg pull paths (disagg/handlers.py `last_pull_path`) ride fixed
+# media regardless of which devices the bytes land on: the same-process
+# "device" pull is a device-to-device copy over ICI, while the
+# cross-process transfer plane and the chunked host wire both cross
+# hosts (DCN). Unknown paths stay unknown rather than guessing.
+_PULL_PATH_LINK = {"device": "ici", "plane": "dcn", "wire": "dcn"}
+
+
+def link_for_pull_path(path: str) -> str:
+    """Link tier for a disagg KV-pull path label."""
+    return _PULL_PATH_LINK.get(path, "?")
+
+
+def topology_summary(devices=None,
+                     env: Optional[dict] = None) -> dict:
+    """Chip-free topology census: device count, process count, and how
+    many unordered device pairs sit on each link tier — the shape of
+    the communication plane `GET /debug/mesh` renders."""
+    if devices is None:
+        try:
+            import jax
+            devices = jax.devices()
+        except Exception:
+            devices = []
+    devices = list(devices)
+    tiers = {t: 0 for t in LINK_TIERS}
+    for i in range(len(devices)):
+        for j in range(i + 1, len(devices)):
+            tier = classify_link(devices[i], devices[j])
+            tiers[tier] = tiers.get(tier, 0) + 1
+    processes = {getattr(d, "process_index", 0) for d in devices}
+    return {
+        "n_devices": len(devices),
+        "n_processes": len(processes) if devices else 0,
+        "pairs_by_link": tiers,
+        "bandwidth_bytes_per_s": link_bandwidths(env),
+    }

@@ -280,7 +280,8 @@ async def test_debug_index_endpoint(monkeypatch):
             assert set(surfaces) == {"/debug/requests", "/debug/profile",
                                      "/debug/router", "/debug/kv",
                                      "/debug/control", "/debug/memory",
-                                     "/debug/tenants", "/debug/classes"}
+                                     "/debug/mesh", "/debug/tenants",
+                                     "/debug/classes"}
             # always-on ring vs env-armed recorders, with the knob named
             assert surfaces["/debug/requests"]["armed"] is True
             assert surfaces["/debug/requests"]["arm"] is None
@@ -293,6 +294,8 @@ async def test_debug_index_endpoint(monkeypatch):
             assert surfaces["/debug/control"]["arm"].startswith("DYN_CONTROL")
             assert surfaces["/debug/memory"]["armed"] is False
             assert surfaces["/debug/memory"]["arm"] == "DYN_MEM_LEDGER=1"
+            assert surfaces["/debug/mesh"]["armed"] is False
+            assert surfaces["/debug/mesh"]["arm"] == "DYN_MESH_RECORDER=1"
             assert surfaces["/debug/tenants"]["armed"] is False
             assert surfaces["/debug/tenants"]["arm"].startswith("DYN_TENANCY")
             # round-robin model → no kv router on this frontend

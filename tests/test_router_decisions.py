@@ -562,15 +562,16 @@ def test_disagg_pull_bytes_and_bandwidth_accounting():
     handler.last_pull_path = "wire"
     handler._record_pull({"transfer_id": "t1", "prefill_len": 128},
                          kv, 0.01, em)
-    assert em.kv_pull_bytes.get(path="wire") == kv.nbytes
+    assert em.kv_pull_bytes.get(path="wire", link="dcn") == kv.nbytes
     assert em.kv_pull_bw.count == 1
     assert abs(em.kv_pull_bw.sum - kv.nbytes / 0.01) < 1.0
 
     handler.last_pull_path = "device"
     handler._record_pull({"transfer_id": "t2", "prefill_len": 64},
                          kv, 0.002, em)
-    assert em.kv_pull_bytes.get(path="device") == kv.nbytes
-    assert em.kv_pull_bytes.get(path="wire") == kv.nbytes  # unchanged
+    # the link label classifies the transfer tier (runtime/topology.py)
+    assert em.kv_pull_bytes.get(path="device", link="ici") == kv.nbytes
+    assert em.kv_pull_bytes.get(path="wire", link="dcn") == kv.nbytes
 
     assert len(handler.transfer_log) == 2
     rec = handler.transfer_log[-1]

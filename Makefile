@@ -6,7 +6,7 @@ PYTEST := JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider
 .PHONY: tier0 tier1 chaos heal-smoke control-smoke mem-smoke kvbm-soak \
 	trace-smoke fleet-smoke autoscale-smoke profile-smoke router-smoke \
 	kv-smoke perf-gate perf-baseline fairness-smoke ragged-smoke \
-	overload-smoke mesh-smoke
+	overload-smoke mesh-smoke prefix-smoke
 
 # fast smoke: the pure-host suites + the interleave scheduler gate,
 # < 60 s total (currently ~15 s)
@@ -23,7 +23,7 @@ tier1:
 # to complete token-identically — plus the self-healing suite
 # (heal-smoke) and the flight-control loop gate (control-smoke).
 chaos: heal-smoke control-smoke mem-smoke fairness-smoke ragged-smoke \
-	overload-smoke mesh-smoke
+	overload-smoke mesh-smoke prefix-smoke
 	$(PYTEST) tests/test_faults.py tests/test_chaos.py \
 		tests/test_kvbm_pipeline.py
 
@@ -174,6 +174,19 @@ ragged-smoke:
 # topology classification, and mesh_summary fleet wiring. Chip-free.
 mesh-smoke:
 	$(PYTEST) tests/test_mesh_recorder.py
+
+# prefix-plane gate (docs/observability.md "Prefix plane"): gating +
+# ring floor, the unarmed AND armed byte-identical routing contract
+# (seeded placements, live-RNG draw order, clean /metrics), the
+# hand-traceable shadow counterfactual (tier-held chain vs device
+# overlap — exact tokens saved), pull-cost economics over the
+# DYN_LINK_BW_* link tiers, duplication math by depth bucket,
+# tier-blind detection incl. the demoted-prefix WARN in doctor
+# prefixes, perf-record prefix keys + two-run byte-identity, the
+# surface-drift lint, and the full-stack GET /debug/prefixes + doctor
+# smoke over a live mock fleet. Chip-free.
+prefix-smoke:
+	$(PYTEST) tests/test_prefix_plane.py tests/test_surface_drift.py
 
 # step-profiler gate (docs/observability.md "Step profiler"): arm
 # DYN_STEP_PROFILE on a MockEngine deployment, drive requests, read the

@@ -546,6 +546,18 @@ async def _phase_long_body(cfg, eng):
     sp = step_profile_summary(eng)
     if sp is not None:
         out["step_profile"] = sp
+    # prefix-reuse block: this phase already measures the same workload
+    # with and without an L_SHARED-token shared prefix — the measured
+    # speedup is the on-device upper bound for one worker that the
+    # fleet-wide shadow counterfactual (router/prefix_plane.py)
+    # projects across workers and tiers
+    out["prefix"] = {
+        "shared_prefix_tokens": L_SHARED,
+        "tok_s_unique": round(tok_s, 1),
+        "tok_s_shared": round(cached_tok_s, 1),
+        "shared_speedup": round(cached_tok_s / tok_s, 3)
+        if tok_s else None,
+    }
     # KV memory-plane block (kvbm/lifecycle.py): present when the phase
     # ran with DYN_KV_LIFECYCLE — hits/evictions/reuse-distance/hotness
     from dynamo_tpu.kvbm.lifecycle import kv_lifecycle_summary
@@ -1078,7 +1090,11 @@ async def phase_traffic():
     from dynamo_tpu.mocker.engine import MockEngine, MockEngineConfig
     from dynamo_tpu.runtime.config import RuntimeConfig
     from dynamo_tpu.runtime.distributed import DistributedRuntime
-    from dynamo_tpu.trafficgen.runner import replay, summarize_results
+    from dynamo_tpu.trafficgen.runner import (
+        replay,
+        summarize_by_prefix,
+        summarize_results,
+    )
     from dynamo_tpu.trafficgen.schedule import TrafficConfig, build_schedule
 
     rt = await DistributedRuntime.create(RuntimeConfig(store_url="memory"))
@@ -1172,6 +1188,21 @@ async def phase_traffic():
                                    for m in mem_summaries),
             "attributed_bytes": sum(m["attributed_bytes"]
                                     for m in mem_summaries),
+        }
+    by_prefix = summarize_by_prefix(results)
+    if by_prefix:
+        # shared-prefix sessions measured from the client side (each
+        # result carries its schedule's prefix_id); per-session latency
+        # detail stays in the full summarize_by_prefix shape — the
+        # fleet counterfactual for the same sessions is /debug/prefixes
+        out["prefix"] = {
+            "sessions": len(by_prefix),
+            "requests": sum(s["requests"] for s in by_prefix.values()),
+            "tokens": sum(s["tokens"] for s in by_prefix.values()),
+            "by_session": {
+                name: {"requests": s["requests"], "ok": s["ok"],
+                       "tokens": s["tokens"]}
+                for name, s in by_prefix.items()},
         }
     if summary["errors"]:
         out["error"] = f"{summary['errors']} replay errors: " \

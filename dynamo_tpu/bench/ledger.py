@@ -100,6 +100,10 @@ LEDGER_METRICS = (
                "higher", 0.0,
                (("traffic", "router", "tokens_saved"),
                 ("perf", "metrics", "router", "tokens_saved"))),
+    MetricSpec("prefix_shadow_saved", "shadow prefill saveable", "tok",
+               "higher", 0.0,
+               (("perf", "metrics", "prefix",
+                 "shadow_tokens_saved_total"),)),
 )
 
 
@@ -348,6 +352,13 @@ GATE_THRESHOLDS = {
     "mesh.bytes_by_entry.prefill": GateSpec("lower", 0.02, "rel"),
     "mesh.bytes_by_entry.decode_burst": GateSpec("lower", 0.02, "rel"),
     "mesh.reshards": GateSpec("lower", 0.0, "abs"),
+    # fleet prefix plane (bench/perf.py shadow pass over the analytic
+    # offload tier): the measured reuse opportunity must not silently
+    # shrink (a router/index change that loses sight of tier-resident
+    # prefixes), and the duplication census must not silently grow
+    "prefix.shadow_tokens_saved_total": GateSpec("higher", 0.02, "rel"),
+    "prefix.tier_blind_total": GateSpec("higher", 0.02, "rel"),
+    "prefix.duplicate_bytes": GateSpec("lower", 0.02, "rel"),
 }
 
 

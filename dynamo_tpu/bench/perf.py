@@ -58,6 +58,7 @@ from dynamo_tpu.kvbm.lifecycle import KvLifecycleRecorder
 from dynamo_tpu.mocker.engine import _pow2, _ragged_bucket
 from dynamo_tpu.mocker.kv_manager import MockKvManager
 from dynamo_tpu.router.decision_log import DecisionRecorder
+from dynamo_tpu.router.prefix_plane import PrefixHeatRecorder
 from dynamo_tpu.router.scheduler import (
     DefaultWorkerSelector,
     MultiWorkerSequences,
@@ -142,6 +143,20 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
         kv[w].lifecycle = kv_recs[w]
     decisions = DecisionRecorder(capacity=4096)
     mesh_rec = CollectiveRecorder()
+    # fleet prefix plane (router/prefix_plane.py): shadow-routes every
+    # sim decision against an analytic offload tier — every block a
+    # worker ever cached but has since evicted is modeled as
+    # host-resident, so prefix.shadow_tokens_saved_total measures what a
+    # tier-aware shared index would recover from this exact schedule.
+    # Base pass only (the armed companion pass discards its record), and
+    # env={} so DYN_LINK_BW_* overrides can't perturb the gated bytes.
+    prefix_rec = None if control else PrefixHeatRecorder(
+        capacity=4096, block_size=cfg.block_size,
+        block_nbytes=_kv_block_nbytes(cfg),
+        prefill_us_per_token=cfg.prefill_us_per_token, env={})
+    # per worker: chain depth of every block it ever cached (feeds both
+    # device residency depth and the evicted-blocks offload model)
+    seen_depth: dict = {w: {} for w in wkeys}
 
     def comm(entry, shape, tokens, fresh, dt) -> None:
         """Simulated-comm accounting for one dispatch: on a fresh
@@ -205,6 +220,26 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
                 total_kv_blocks=cfg.total_kv_blocks))
         result = selector.select(req_blocks, cands)
         w = result.worker
+        if prefix_rec is not None:
+            # residency sync + shadow counterfactual AFTER the live
+            # select — the recorder never sees the selector's RNG, so
+            # the placement stream is byte-identical with or without it
+            for w2 in wkeys:
+                dev = {h: seen_depth[w2].get(h, 1)
+                       for h in kv[w2]._active}
+                dev.update({h: seen_depth[w2].get(h, 1)
+                            for h in kv[w2]._inactive})
+                prefix_rec.observe_worker_blocks(w2, dev)
+                prefix_rec.observe_tiers(w2, {
+                    h: ("host", prefix_rec.block_nbytes)
+                    for h, d in seen_depth[w2].items() if h not in dev})
+            prefix_rec.observe_decision(
+                request_id=rid, seq_hashes=seq.seq_hashes(),
+                request_blocks=req_blocks, candidates=cands,
+                result=result, config=selector.config,
+                n_tokens=len(ids))
+            for i, h in enumerate(seq.seq_hashes()):
+                seen_depth[w].setdefault(h, i + 1)
         uncached = max(len(ids) - result.overlap_blocks * cfg.block_size, 0)
         result.prefill_tokens = uncached
         result.total_blocks = req_blocks
@@ -277,6 +312,8 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
                     if not kv[w].append_block(blk.seq_hash, blk.local_hash,
                                               blk.parent_seq_hash):
                         append_fails += 1
+                    seen_depth[w].setdefault(
+                        blk.seq_hash, len(lane.seq.seq_hashes()))
                 if lane.emitted >= lane.osl:
                     kv[w].free_sequence(lane.seq.seq_hashes())
                     loads.free(rid)
@@ -287,7 +324,7 @@ def run_perf(cfg: PerfConfig, control: bool = False) -> dict:
     record = _score(cfg, schedule, steps, kv_recs, decisions, mesh_rec,
                     completed=completed,
                     admission_rejects=admission_rejects,
-                    append_fails=append_fails)
+                    append_fails=append_fails, prefix_rec=prefix_rec)
     if control:
         record["control_sim"] = {
             "events": events,
@@ -332,8 +369,33 @@ def _fold_armed_pass(cfg: PerfConfig, record: dict) -> None:
     record["control_sim"] = sim
 
 
+def _kv_block_nbytes(cfg) -> int:
+    """Analytic bytes of one KV block under the sim's megatron model:
+    [k; v] x layers x hidden x block_size tokens x 2 bytes (bf16) —
+    the same constants the mesh block's collective model uses, so the
+    shadow pull-vs-recompute tradeoff is internally consistent."""
+    return 2 * cfg.model_layers * cfg.model_hidden * cfg.block_size * 2
+
+
+def _prefix_block(prefix_rec) -> dict:
+    """Gated subset of the prefix-plane summary: cumulative shadow
+    totals plus the end-state duplication census. All analytic — no
+    wall-clock or ring-order fields ever reach the record."""
+    s = prefix_rec.summary()
+    dup = s["duplication"]
+    return {
+        "decisions": s["decisions"],
+        "shadow_tokens_saved_total": s["shadow_tokens_saved_total"],
+        "shadow_divergence": s["shadow_divergence"],
+        "tier_blind_total": s["tier_blind_total"],
+        "duplicate_blocks": dup["duplicate_blocks"],
+        "duplicate_bytes": dup["duplicate_bytes"],
+    }
+
+
 def _score(cfg, schedule, steps, kv_recs, decisions, mesh_rec, *,
-           completed, admission_rejects, append_fails) -> dict:
+           completed, admission_rejects, append_fails,
+           prefix_rec=None) -> dict:
     """Fold recorder summaries into the scored record. Only analytic
     fields are read — never wall-clock ones (dispatch_gap, wall_span,
     goodput_tok_s, residency)."""
@@ -437,6 +499,8 @@ def _score(cfg, schedule, steps, kv_recs, decisions, mesh_rec, *,
             },
         },
     }
+    if prefix_rec is not None:
+        record["metrics"]["prefix"] = _prefix_block(prefix_rec)
     return record
 
 

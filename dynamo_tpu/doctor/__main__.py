@@ -118,6 +118,10 @@ SUBCOMMANDS: dict[str, tuple[str, str]] = {
     "classes": ("classes",
                 "serving-class objectives, deadline admission, and "
                 "brownout stage from /debug/classes"),
+    "prefixes": ("prefixes",
+                 "fleet prefix plane from /debug/prefixes: duplication "
+                 "by depth, tier-blind misses, shadow routing "
+                 "counterfactual"),
 }
 
 

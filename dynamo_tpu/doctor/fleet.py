@@ -144,6 +144,23 @@ def _fmt_mesh(xs: Optional[dict]) -> str:
     return "  " + " ".join(parts)
 
 
+def _fmt_prefix(ps: Optional[dict]) -> str:
+    """Fleet prefix-plane health (present only on routers that armed
+    DYN_PREFIX_HEAT)."""
+    if not ps:
+        return ""
+    gib = 2.0 ** 30
+    parts = [f"pfx_saved={ps.get('shadow_tokens_saved', 0)}tok"]
+    if ps.get("tier_blind"):
+        parts.append(f"tier_blind={ps['tier_blind']}")
+    if ps.get("shadow_divergence"):
+        parts.append(f"diverged={ps['shadow_divergence']}")
+    dup = ps.get("duplicate_bytes")
+    if dup:
+        parts.append(f"dup={dup / gib:.2f}GiB")
+    return "  " + " ".join(parts)
+
+
 def _fmt_tenants(ts: Optional[dict]) -> list[str]:
     """Per-tenant fairness lines (present only on fleets that armed
     DYN_TENANCY — untenanted fleets print nothing here)."""
@@ -209,7 +226,8 @@ def render(status: dict) -> int:
               f"{_fmt_router(c.get('router'))}"
               f"{_fmt_kv(c.get('kv'))}"
               f"{_fmt_memory(c.get('memory'))}"
-              f"{_fmt_mesh(c.get('mesh'))}")
+              f"{_fmt_mesh(c.get('mesh'))}"
+              f"{_fmt_prefix(c.get('prefix'))}")
         for line in _fmt_tenants(c.get("tenants")):
             print(line)
         for line in _fmt_classes(c.get("classes")):
@@ -222,7 +240,8 @@ def render(status: dict) -> int:
           f"{_fmt_router(fleet.get('router'))}"
           f"{_fmt_kv(fleet.get('kv'))}"
           f"{_fmt_memory(fleet.get('memory'))}"
-          f"{_fmt_mesh(fleet.get('mesh'))}")
+          f"{_fmt_mesh(fleet.get('mesh'))}"
+          f"{_fmt_prefix(fleet.get('prefix'))}")
     for line in _fmt_tenants(fleet.get("tenants")):
         print(line)
     for line in _fmt_classes(fleet.get("classes")):

@@ -1,0 +1,182 @@
+"""`python -m dynamo_tpu.doctor prefixes <url-or-file>` — explain the
+fleet prefix plane.
+
+Input is one of:
+
+  * a frontend base url — fetches ``GET /debug/prefixes``;
+  * a ``.json`` capture of the same payload (or a single-model
+    `prefix_payload` dict) — the same render works offline on a dump.
+
+Renders, per kv-mode model: the shadow-routing headline (prefill tokens
+a tier-aware shared index would have saved, placement divergence rate),
+cross-worker duplication bytes by chain-depth bucket (shallow = system
+prompts duplicated by design, deep = conversation tails duplicated by
+accident), the tier-blind miss count (WARN when placements routed away
+from a worker whose host/disk tier held a deeper run than any
+candidate's device overlap), the hottest shared prefixes, and the most
+recent shadow-vs-actual placements. Exit code 0 when at least one model
+payload was rendered, 1 when the input was unusable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+_GIB = 2.0 ** 30
+_MIB = 2.0 ** 20
+
+
+def load_payload(source: str) -> Optional[dict]:
+    """Fetch /debug/prefixes from a base url, or read a JSON capture."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        url = source.rstrip("/") + "/debug/prefixes"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception as e:
+            print(f"doctor prefixes: fetch {url} failed: {e!r}")
+            return None
+    try:
+        with open(source, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"doctor prefixes: cannot read {source}: {e!r}")
+        return None
+
+
+def _model_payloads(body: dict) -> list[dict]:
+    """Normalize: the frontend wraps payloads in `models`; a raw
+    single-model `prefix_payload` capture is accepted as-is."""
+    if isinstance(body.get("models"), list):
+        return [m for m in body["models"] if isinstance(m, dict)]
+    if "summary" in body or "enabled" in body:
+        return [body]
+    return []
+
+
+def _bytes(n) -> str:
+    try:
+        v = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    if v >= _GIB:
+        return f"{v / _GIB:.2f}GiB"
+    if v >= _MIB:
+        return f"{v / _MIB:.1f}MiB"
+    return f"{v:.0f}B"
+
+
+def _render_headline(s: dict) -> None:
+    decisions = s.get("decisions", 0)
+    print(f"  shadow counterfactual: {decisions} decision(s), "
+          f"{s.get('shadow_tokens_saved_total', 0)} prefill token(s) a "
+          f"tier-aware index would have saved")
+    print(f"  divergence: {s.get('shadow_divergence', 0)} "
+          f"({s.get('divergence_pct', 0.0)}%) placement(s) the shadow "
+          f"selector moved")
+
+
+def _render_duplication(s: dict) -> None:
+    dup = s.get("duplication") or {}
+    print(f"  duplication: {dup.get('duplicate_blocks', 0)} redundant "
+          f"block(s) / {_bytes(dup.get('duplicate_bytes', 0))} across "
+          f"{dup.get('blocks_tracked', 0)} tracked block(s)")
+    for bucket, nb in sorted((dup.get("by_depth_bucket") or {}).items()):
+        print(f"    depth {bucket:<6} {_bytes(nb):>10}")
+
+
+def _render_tier_blind(s: dict) -> None:
+    blind = s.get("tier_blind_total", 0)
+    if blind:
+        print(f"  WARN {blind} tier-blind decision(s) — a host/disk "
+              f"tier held a deeper prefix run than any candidate's "
+              f"device overlap (the radix index could not see it)")
+    else:
+        print("  tier-blind decisions: 0")
+
+
+def _render_hottest(s: dict) -> None:
+    rows = s.get("hottest") or []
+    if not rows:
+        return
+    print("  hottest shared prefixes:")
+    for r in rows:
+        print(f"    {r.get('seq_hash', '?')}  depth {r.get('depth', 0):>3}"
+              f"  {r.get('hits', 0):>5} hit(s)  "
+              f"{r.get('shadow_tokens_saved', 0):>7} tok saved")
+
+
+def _render_records(records: list[dict], n: int = 8) -> None:
+    if not records:
+        return
+    print(f"  recent shadow-vs-actual placements (last {min(n, len(records))}):")
+    for r in records[-n:]:
+        actual = r.get("actual") or {}
+        shadow = r.get("shadow") or {}
+        mark = "≠" if r.get("diverged") else "="
+        flags = []
+        if r.get("tier_blind"):
+            flags.append("tier-blind")
+        if r.get("tokens_saved"):
+            flags.append(f"saved {r['tokens_saved']} tok")
+        extra = f"  [{', '.join(flags)}]" if flags else ""
+        print(f"    {r.get('request_id', '?'):<14} "
+              f"actual {actual.get('worker', '?')}"
+              f"@{actual.get('overlap_blocks', 0)} {mark} "
+              f"shadow {shadow.get('worker', '?')}"
+              f"@{shadow.get('overlap_blocks', 0)} "
+              f"({shadow.get('source', 'index')}){extra}")
+
+
+def render_model(payload: dict, idx: int) -> bool:
+    name = payload.get("model", f"model[{idx}]")
+    print(f"{name}:")
+    if not payload.get("enabled"):
+        hint = payload.get("hint", "set DYN_PREFIX_HEAT=1")
+        print(f"  recorder: disabled ({hint})")
+        return True
+    s = payload.get("summary") or {}
+    workers = s.get("workers") or {}
+    print(f"  residency: {workers.get('device', 0)} device worker(s), "
+          f"{workers.get('tier', 0)} tier snapshot(s), block_size "
+          f"{payload.get('block_size', '?')}")
+    _render_headline(s)
+    _render_duplication(s)
+    _render_tier_blind(s)
+    _render_hottest(s)
+    _render_records(payload.get("records") or [])
+    return True
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.doctor prefixes",
+        description="explain the fleet prefix plane (/debug/prefixes "
+                    "or a saved dump): duplication by depth, tier-blind "
+                    "misses, shadow routing counterfactual")
+    p.add_argument("source",
+                   help="frontend base url or prefixes JSON capture")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+
+    body = load_payload(args.source)
+    if body is None:
+        return 1
+    payloads = _model_payloads(body)
+    if not payloads:
+        print("doctor prefixes: no model payloads in input")
+        return 1
+    rendered = 0
+    for i, payload in enumerate(payloads):
+        if render_model(payload, i):
+            rendered += 1
+    return 0 if rendered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

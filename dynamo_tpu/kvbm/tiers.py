@@ -275,3 +275,26 @@ class TieredStore:
                 out += [h for h in self.disk._lru.keys()
                         if h not in self.host._blocks]
             return out
+
+    def resident_hashes(self, tier: str = "all"
+                        ) -> dict[int, tuple[str, int]]:
+        """Cheap residency snapshot for the prefix plane
+        (router/prefix_plane.py `observe_tiers`): seq_hash ->
+        ("host" | "disk", block bytes). One lock hold, no data copies —
+        host bytes come from the live array headers, disk bytes from
+        the in-memory dtype/shape index. A block in both tiers reports
+        the host copy. `tier` restricts to "host" or "disk"."""
+        with self._lock:
+            out: dict[int, tuple[str, int]] = {}
+            if tier in ("host", "all"):
+                for h, arr in self.host._blocks.items():
+                    out[h] = ("host", int(arr.nbytes))
+            if tier in ("disk", "all") and self.disk is not None:
+                for h, (_p, dtype, shape) in self.disk._lru.items():
+                    if h in out:
+                        continue
+                    nbytes = _np_dtype(dtype).itemsize
+                    for d in shape:
+                        nbytes *= int(d)
+                    out[h] = ("disk", nbytes)
+            return out

@@ -126,6 +126,7 @@ class HttpService:
             web.get("/debug/control", self._debug_control),
             web.get("/debug/tenants", self._debug_tenants),
             web.get("/debug/classes", self._debug_classes),
+            web.get("/debug/prefixes", self._debug_prefixes),
             web.get("/openapi.json", self._openapi),
         ])
         # Tenancy quota plane (dynamo_tpu/tenancy, docs/multitenancy.md):
@@ -870,6 +871,17 @@ class HttpService:
                 "armed": self.classes is not None,
                 "available": True,
             },
+            "/debug/prefixes": {
+                "what": "fleet prefix heatmap: cross-worker duplication, "
+                        "tier-blind misses, shadow routing "
+                        "counterfactual (tokens a tier-aware index "
+                        "would have saved)",
+                "arm": "DYN_PREFIX_HEAT=1",
+                "armed": any(getattr(getattr(r, "router", r),
+                                     "prefix_heat", None) is not None
+                             for r in routers.values()),
+                "available": bool(routers),
+            },
         }
         return web.json_response({"surfaces": surfaces})
 
@@ -1110,6 +1122,33 @@ class HttpService:
             "models": models,
         })
 
+    async def _debug_prefixes(self, request: web.Request) -> web.Response:
+        """Fleet prefix-plane view (docs/observability.md "Prefix
+        plane"): per-model duplication bytes by depth bucket, tier-blind
+        miss count, hottest shared prefixes, and the shadow-routing
+        counterfactual ring — when DYN_PREFIX_HEAT arms the
+        PrefixHeatRecorder. `?limit=N` bounds each ring dump. 503 when
+        no kv-mode model is being served (round-robin/random routing
+        makes no placement decisions to shadow)."""
+        from dynamo_tpu.router.prefix_plane import prefix_payload
+
+        routers = self.manager.kv_routers()
+        if not routers:
+            return web.json_response(
+                {"status": "unavailable",
+                 "reason": "no kv-mode model served by this frontend"},
+                status=503)
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            limit = 256
+        models = [{"model": name, **prefix_payload(r, limit)}
+                  for name, r in routers.items()]
+        return web.json_response({
+            "enabled": any(m.get("enabled") for m in models),
+            "models": models,
+        })
+
     @staticmethod
     def _has_content(chunk: dict) -> bool:
         """True for any token-bearing delta. reasoning_content and
@@ -1225,6 +1264,10 @@ class HttpService:
             "/debug/classes": ("Serving-class table, admitted/shed/"
                                "downgraded counters, deadline-admission "
                                "estimate, brownout stage", False),
+            "/debug/prefixes": ("Fleet prefix heatmap: duplication by "
+                                "depth, tier-blind misses, shadow "
+                                "routing counterfactual (?limit=N)",
+                                False),
             "/openapi.json": ("This document", False),
         }
         paths: dict[str, dict] = {}

@@ -236,7 +236,13 @@ class KvIndexer:
             compute_block_hashes(tokens, self.block_size))
 
     def remove_worker(self, worker: WorkerKey) -> None:
+        # Purge the per-worker event cursor and gap counter along with
+        # the blocks: a resynced or respawned worker restarts its
+        # event_id sequence, and a stale cursor would mis-count the
+        # reset as a gap (and keep dead workers in event_gaps forever).
         self.tree.remove_worker(worker)
+        self._last_event_id.pop(worker, None)
+        self.gaps.pop(worker, None)
 
 
 class ApproxKvIndexer:

@@ -38,6 +38,10 @@ from dynamo_tpu.router.decision_log import (
     worker_label,
 )
 from dynamo_tpu.router.indexer import ApproxKvIndexer, KvIndexer, WorkerKey
+from dynamo_tpu.router.prefix_plane import (
+    PrefixHeatRecorder,
+    prefix_heat_from_env,
+)
 from dynamo_tpu.router.recorder import KvRecorder
 from dynamo_tpu.router.scheduler import (
     DefaultWorkerSelector,
@@ -119,6 +123,12 @@ class KvRouter:
         # per-decision ring is armed only by DYN_ROUTER_LOG.
         self.metrics = RouterMetrics()
         self.recorder: Optional[DecisionRecorder] = recorder_from_env()
+        # Fleet prefix heatmap + shadow-routing counterfactual
+        # (router/prefix_plane.py), armed only by DYN_PREFIX_HEAT: the
+        # unarmed hot path costs one `is not None` check and routing
+        # stays byte-identical (shadow scoring owns a private RNG).
+        self.prefix_heat: Optional[PrefixHeatRecorder] = \
+            prefix_heat_from_env(block_size=config.block_size)
         # KV-event stream gap detection (indexer.py): a missed event means
         # the index diverged from the worker's real cache until its blocks
         # churn out. Count per worker; log once per worker so a lossy bus
@@ -145,8 +155,16 @@ class KvRouter:
 
     def register_metrics(self, registry) -> None:
         """Adopt the router metrics into a runtime registry; the prefix-
-        index gauges refresh at scrape time."""
+        index gauges refresh at scrape time. The prefix-plane metrics
+        register only when DYN_PREFIX_HEAT armed the recorder, so the
+        unarmed /metrics surface is unchanged."""
         self.metrics.register(registry, index_stats=self.index_stats)
+        ph = self.prefix_heat
+        if ph is not None:
+            def refresh() -> None:
+                ph.observe_index(self.indexer)
+                ph.refresh_gauges()
+            ph.metrics.register(registry, callback=refresh)
 
     # -- worker membership (fed by instance watch) --------------------------
 
@@ -231,6 +249,19 @@ class KvRouter:
             self.recorder.record_decision(
                 request_id, result, candidates, mode=mode,
                 tokens_saved=max(saved, 0), n_tokens=len(token_ids))
+        if self.prefix_heat is not None:
+            # shadow counterfactual (prefix_plane.py): re-score through
+            # a tier-aware augmented index; never changes `result` and
+            # never touches self.selector.rng
+            from dynamo_tpu.tokens import compute_seq_hashes
+            self.prefix_heat.observe_decision(
+                request_id=request_id,
+                seq_hashes=compute_seq_hashes(
+                    token_ids, self.config.block_size),
+                request_blocks=request_blocks,
+                candidates=candidates, result=result,
+                config=self.selector.config,
+                n_tokens=len(token_ids), mode=mode)
         if update_states:
             self.sequences.add_request(
                 request_id, result.worker,
@@ -383,8 +414,9 @@ class KvPushRouter:
         the blocks they described."""
         try:
             idx = self.router.indexer
+            # remove_worker also forgets the event cursor + gap counter
+            # (indexer.py) so the replayed tail re-seeds continuity
             idx.remove_worker(worker)
-            idx._last_event_id.pop(worker, None)
             sub = await self.bus.subscribe(
                 kv_events_subject(self._ns, self._component),
                 from_start=True)

@@ -359,6 +359,37 @@ def mesh_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     return out
 
 
+def prefix_summary(snapshot: dict[str, dict]) -> Optional[dict]:
+    """Fleet prefix-plane view from the shadow-routing recorder's
+    series (router/prefix_plane.py). None when the component never
+    armed `DYN_PREFIX_HEAT` — the fleet view stays unchanged. The
+    counters merge across routers, so the fleet entry is the
+    fleet-wide reuse opportunity the shared-index direction would
+    capture."""
+    saved = _counter_total(snapshot,
+                           "dynamo_prefix_shadow_tokens_saved_total")
+    blind = _counter_total(snapshot, "dynamo_prefix_tier_blind_total")
+    diverged = _counter_total(snapshot,
+                              "dynamo_prefix_shadow_divergence_total")
+    dup = _gauge_by_label(snapshot, "dynamo_prefix_duplicate_bytes",
+                          "depth_bucket")
+    if not saved and not blind and not diverged and not dup:
+        # distinguish never-armed (no series at all) from armed-but-
+        # quiet: an armed recorder has registered at least one series
+        if "dynamo_prefix_shadow_tokens_saved_total" not in snapshot:
+            return None
+    out: dict[str, Any] = {
+        "shadow_tokens_saved": int(saved),
+        "shadow_divergence": int(diverged),
+        "tier_blind": int(blind),
+    }
+    if dup:
+        out["duplicate_bytes"] = int(sum(dup.values()))
+        out["duplicate_bytes_by_depth"] = {
+            k: int(v) for k, v in sorted(dup.items())}
+    return out
+
+
 def tenant_summary(snapshot: dict[str, dict]) -> Optional[dict]:
     """Per-tenant fairness view from the `dynamo_tenant_*` series
     (dynamo_tpu/tenancy, docs/multitenancy.md). None when the component
@@ -610,6 +641,9 @@ class TelemetryCollector:
             xs = mesh_summary(metrics)
             if xs is not None:
                 entry["mesh"] = xs
+            ps = prefix_summary(metrics)
+            if ps is not None:
+                entry["prefix"] = ps
             ts = tenant_summary(metrics)
             if ts is not None:
                 entry["tenants"] = ts
@@ -644,6 +678,9 @@ class TelemetryCollector:
         fleet_mesh = mesh_summary(merged)
         if fleet_mesh is not None:
             out["fleet"]["mesh"] = fleet_mesh
+        fleet_pfx = prefix_summary(merged)
+        if fleet_pfx is not None:
+            out["fleet"]["prefix"] = fleet_pfx
         fleet_ten = tenant_summary(merged)
         if fleet_ten is not None:
             out["fleet"]["tenants"] = fleet_ten

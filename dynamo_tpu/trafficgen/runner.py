@@ -42,6 +42,7 @@ class RequestResult:
     finish_reason: str = ""
     tenant: str = ""         # tenant this request rode in as ("" = none)
     cls: str = ""            # serving class it rode in as ("" = none)
+    prefix_id: int = -1      # shared system-prompt session (-1 = none)
     deadline_missed: bool = False  # 503'd as deadline_unmeetable
     shed: bool = False       # 503'd by brownout/overload shedding
     downgraded: bool = False  # served, but in a lower class than asked
@@ -56,7 +57,8 @@ async def _replay_one(session, url: str, model: str,
                       t0: float) -> RequestResult:
     res = RequestResult(index=req.index, status="error:unsent",
                         sent_at=round(time.monotonic() - t0, 6),
-                        tenant=req.tenant, cls=req.cls)
+                        tenant=req.tenant, cls=req.cls,
+                        prefix_id=req.prefix_id)
     body = {
         "model": model,
         "stream": True,
@@ -172,7 +174,12 @@ async def replay(url: str, model: str, schedule: list[ScheduledRequest],
     if out_path:
         with open(out_path, "a") as f:
             for r in results:
-                f.write(json.dumps(asdict(r), sort_keys=True) + "\n")
+                d = asdict(r)
+                if d.get("prefix_id", -1) < 0:
+                    # prefixless traces keep the pre-prefix byte layout
+                    # (same key-drop contract as schedule_to_jsonl)
+                    d.pop("prefix_id", None)
+                f.write(json.dumps(d, sort_keys=True) + "\n")
     return results
 
 
@@ -216,6 +223,19 @@ def summarize_by_tenant(results: list[RequestResult]) -> dict:
     for r in results:
         if r is not None and r.tenant:
             by.setdefault(r.tenant, []).append(r)
+    return {name: summarize_results(rs)
+            for name, rs in sorted(by.items())}
+
+
+def summarize_by_prefix(results: list[RequestResult]) -> dict:
+    """`summarize_results` split by shared-prefix session — {} when the
+    replay carried no prefix sessions. The prefix-plane smoke compares
+    these measured per-session hit rates against the router's shadow
+    counterfactual (router/prefix_plane.py)."""
+    by: dict[str, list[RequestResult]] = {}
+    for r in results:
+        if r is not None and r.prefix_id >= 0:
+            by.setdefault(f"p{r.prefix_id}", []).append(r)
     return {name: summarize_results(rs)
             for name, rs in sorted(by.items())}
 

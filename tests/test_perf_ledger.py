@@ -281,7 +281,7 @@ async def test_debug_index_endpoint(monkeypatch):
                                      "/debug/router", "/debug/kv",
                                      "/debug/control", "/debug/memory",
                                      "/debug/mesh", "/debug/tenants",
-                                     "/debug/classes"}
+                                     "/debug/classes", "/debug/prefixes"}
             # always-on ring vs env-armed recorders, with the knob named
             assert surfaces["/debug/requests"]["armed"] is True
             assert surfaces["/debug/requests"]["arm"] is None

@@ -133,6 +133,35 @@ def test_kv_indexer_tokens_api():
     assert scores.scores == {(3, 0): 4}
 
 
+def test_remove_worker_purges_event_cursor_and_gaps():
+    """A respawned worker restarts its event_id sequence: remove_worker
+    must drop the continuity cursor and gap counter, or the resync
+    reads as a giant gap and dead workers haunt event_gaps forever."""
+    idx = KvIndexer(block_size=BS)
+    ev = stored_event(1, list(range(8)))
+    ev.event_id = 1
+    idx.apply_event(ev)
+    ev2 = stored_event(1, list(range(8, 16)), start_block=0)
+    ev2.event_id = 5                      # ids 2-4 lost: gap of 3
+    ev2.parent_seq_hash = SEED_HASH
+    idx.apply_event(ev2)
+    assert idx.gaps == {(1, 0): 3}
+    assert idx._last_event_id == {(1, 0): 5}
+
+    idx.remove_worker((1, 0))
+    assert idx.gaps == {}
+    assert idx._last_event_id == {}
+    assert idx.find_matches_for_tokens(list(range(8))).scores == {}
+
+    # the respawned worker's fresh id=1 stream is NOT a gap
+    ev3 = stored_event(1, list(range(8)))
+    ev3.event_id = 1
+    idx.apply_event(ev3)
+    assert idx.gaps == {}
+    assert idx.find_matches_for_tokens(
+        list(range(8))).scores == {(1, 0): 2}
+
+
 def test_approx_indexer_ttl():
     now = [0.0]
     idx = ApproxKvIndexer(block_size=BS, ttl_secs=10.0, clock=lambda: now[0])

@@ -1,0 +1,59 @@
+"""Surface-drift lint: every `GET /debug/*` route the frontend serves
+must also be discoverable everywhere an operator would look for it —
+the `/debug` index, the openapi payload, the doctor SUBCOMMANDS table,
+and docs/observability.md. A new debug surface that skips one of these
+ships dark; this test makes the omission a tier-0 failure instead of a
+docs bug found in an incident."""
+
+import inspect
+import pathlib
+import re
+
+import pytest
+
+from dynamo_tpu.doctor.__main__ import SUBCOMMANDS
+from dynamo_tpu.llm.http_service import HttpService
+
+pytestmark = pytest.mark.tier0
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# routes whose doctor subcommand is spelled differently
+ROUTE_TO_SUBCOMMAND = {"requests": "request"}
+
+
+def debug_routes() -> list[str]:
+    src = inspect.getsource(HttpService)
+    routes = re.findall(r'web\.get\("(/debug/[a-z_]+)"', src)
+    assert routes, "no /debug routes found — did the route table move?"
+    return sorted(set(routes))
+
+
+def test_every_debug_route_in_debug_index():
+    src = inspect.getsource(HttpService._debug_index)
+    for route in debug_routes():
+        assert f'"{route}"' in src, \
+            f"{route} missing from the /debug index (_debug_index)"
+
+
+def test_every_debug_route_in_openapi():
+    src = inspect.getsource(HttpService._openapi)
+    for route in debug_routes():
+        assert f'"{route}"' in src, \
+            f"{route} missing from the openapi payload (_openapi)"
+
+
+def test_every_debug_route_has_doctor_subcommand():
+    for route in debug_routes():
+        name = route.removeprefix("/debug/")
+        sub = ROUTE_TO_SUBCOMMAND.get(name, name)
+        assert sub in SUBCOMMANDS, \
+            f"{route} has no doctor subcommand ({sub!r} not in " \
+            f"SUBCOMMANDS)"
+
+
+def test_every_debug_route_documented():
+    doc = (REPO / "docs" / "observability.md").read_text()
+    for route in debug_routes():
+        assert route in doc, \
+            f"{route} not mentioned in docs/observability.md"
